@@ -1,0 +1,44 @@
+"""dlrm_flexflow_tpu — a TPU-native distributed DNN training framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capabilities of
+Efrainq07/DLRM-FlexFlow (FlexFlow forked for DLRM training): graph-builder
+model API, full operator set, SOAP per-operator parallelization strategies
+(sample/operator/attribute/parameter) compiled to ``jax.sharding`` over a
+TPU mesh, an execution simulator + MCMC strategy search, DLRM and the other
+reference applications, plus first-class long-context (ring attention /
+sequence parallelism) which the reference lacks.
+
+Quick start::
+
+    import dlrm_flexflow_tpu as ff
+    model = ff.FFModel(ff.FFConfig(batch_size=256))
+    x = model.create_tensor((256, 64), name="x")
+    y = model.dense(x, 16, activation="relu")
+    ...
+    model.compile(optimizer=ff.SGDOptimizer(0.01), loss_type="mean_squared_error")
+    state = model.init()
+    state, metrics = model.train_step(state, {"x": batch}, labels)
+"""
+
+from .config import FFConfig
+from .initializers import (ConstantInitializer, GlorotUniform,
+                           NormInitializer, UniformInitializer,
+                           ZeroInitializer)
+from .losses import get_loss
+from .metrics import MetricsAccumulator, compute_metrics
+from .model import FFModel, TrainState
+from .optim import AdamOptimizer, SGDOptimizer
+from .parallel.mesh import make_mesh
+from .parallel.parallel_config import ParallelConfig, Strategy
+from .tensor import Tensor
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig", "FFModel", "TrainState", "Tensor",
+    "SGDOptimizer", "AdamOptimizer",
+    "ParallelConfig", "Strategy", "make_mesh",
+    "GlorotUniform", "ZeroInitializer", "UniformInitializer",
+    "NormInitializer", "ConstantInitializer",
+    "get_loss", "compute_metrics", "MetricsAccumulator",
+]

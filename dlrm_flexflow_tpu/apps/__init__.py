@@ -1,0 +1,3 @@
+from .dlrm import DLRMConfig, build_dlrm
+
+__all__ = ["DLRMConfig", "build_dlrm"]

@@ -1,0 +1,3 @@
+from .loader import ArrayDataLoader, SyntheticDLRMLoader, load_criteo_h5
+
+__all__ = ["ArrayDataLoader", "SyntheticDLRMLoader", "load_criteo_h5"]

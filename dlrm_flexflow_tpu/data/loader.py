@@ -1,0 +1,117 @@
+"""Data loading.
+
+TPU-native equivalent of the reference's dataloader design
+(reference: examples/cpp/DLRM/dlrm.cc:266-484 — HDF5 Criteo read into
+zero-copy host regions, then per-batch GPU scatter tasks dlrm.cc:486-589;
+python/flexflow_dataloader.{h,cc,cu} for the generic 2D/4D loaders).
+
+The design maps cleanly: the full dataset lives in host RAM as numpy
+arrays (the ZC-region analogue); each ``next_batch`` slices a batch and the
+model's ``shard_batch`` device_puts it onto the mesh's data axis — the
+scatter-to-each-device-partition step the reference implements with custom
+Legion index tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class ArrayDataLoader:
+    """Batched iterator over in-host-memory arrays.
+
+    ``inputs`` maps input-tensor name -> full array (num_samples, ...).
+    Mirrors SingleDataLoader/ImgDataLoader semantics: sequential batches,
+    wrap at epoch end (reference flexflow_dataloader.h:26-107).
+    """
+
+    def __init__(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
+                 batch_size: int, drop_last: bool = True, shuffle: bool = False,
+                 seed: int = 0):
+        self.inputs = inputs
+        self.labels = labels
+        self.batch_size = int(batch_size)
+        n = labels.shape[0]
+        for k, v in inputs.items():
+            assert v.shape[0] == n, f"input {k} has {v.shape[0]} != {n} samples"
+        self.num_samples = n
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def num_batches(self) -> int:
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def peek(self):
+        idx = np.arange(min(self.batch_size, self.num_samples))
+        return ({k: v[idx] for k, v in self.inputs.items()}, self.labels[idx])
+
+    def __iter__(self) -> Iterator[Tuple[Dict[str, np.ndarray], np.ndarray]]:
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for b in range(self.num_batches):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            yield ({k: v[idx] for k, v in self.inputs.items()},
+                   self.labels[idx])
+
+    def __len__(self):
+        return self.num_batches
+
+
+class SyntheticDLRMLoader(ArrayDataLoader):
+    """Random Criteo-like data (reference dlrm.cc "synthetic" mode,
+    run_random.sh) — dense float features, per-table int64 multi-hot ids,
+    binary labels.
+
+    Input names follow the DLRM app: "dense" (B, num_dense), "sparse"
+    (B, T, bag) for the stacked-table path or "sparse_<i>" per table, and
+    labels (B, 1) float.
+    """
+
+    def __init__(self, num_samples: int, num_dense: int, table_sizes,
+                 bag_size: int, batch_size: int, stacked: bool = True,
+                 seed: int = 0):
+        rng = np.random.default_rng(seed)
+        dense = rng.standard_normal((num_samples, num_dense), dtype=np.float32)
+        inputs = {"dense": dense}
+        if stacked:
+            sizes = set(int(s) for s in table_sizes)
+            assert len(sizes) == 1, "stacked path needs uniform table sizes"
+            rows = sizes.pop()
+            t = len(table_sizes)
+            inputs["sparse"] = rng.integers(
+                0, rows, size=(num_samples, t, bag_size), dtype=np.int64)
+        else:
+            for i, rows in enumerate(table_sizes):
+                inputs[f"sparse_{i}"] = rng.integers(
+                    0, int(rows), size=(num_samples, bag_size), dtype=np.int64)
+        labels = rng.integers(0, 2, size=(num_samples, 1)).astype(np.float32)
+        super().__init__(inputs, labels, batch_size)
+
+
+def load_criteo_h5(path: str, stacked: bool = False):
+    """Read a Criteo-format HDF5 file (reference dlrm.cc:266-382:
+    datasets ``X_int`` float dense, ``X_cat`` int64 sparse, ``y`` labels).
+
+    Returns (inputs dict, labels) suitable for ArrayDataLoader.
+    """
+    import h5py  # gated: optional dependency
+
+    with h5py.File(path, "r") as f:
+        x_int = np.asarray(f["X_int"], dtype=np.float32)
+        x_cat = np.asarray(f["X_cat"], dtype=np.int64)
+        y = np.asarray(f["y"], dtype=np.float32).reshape(-1, 1)
+    inputs = {"dense": x_int}
+    if stacked:
+        # (N, T) single-hot -> (N, T, 1) bag layout
+        inputs["sparse"] = x_cat[:, :, None]
+    else:
+        for i in range(x_cat.shape[1]):
+            inputs[f"sparse_{i}"] = x_cat[:, i:i + 1]
+    return inputs, y

@@ -1,0 +1,96 @@
+"""Weight initializers.
+
+TPU-native equivalent of the reference initializer subsystem
+(reference: include/initializer.h:26-101, src/runtime/initializer_kernel.cu:20-147).
+The reference runs one Legion GPU task per weight with cuRAND; here each
+initializer is a pure function of a JAX PRNG key, so initialization is
+deterministic, reproducible across meshes, and can be jitted/sharded.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    """Xavier/Glorot uniform (reference initializer_kernel.cu:20-54).
+
+    The reference computes fan-in/fan-out from the last two logical dims
+    (out-channel, in-channel) of the weight; we do the same: for a 2-D
+    (in, out) weight fan_in=shape[0], fan_out=shape[1]; conv weights
+    (kh, kw, cin, cout) use receptive-field scaling like cuDNN.
+    """
+
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) >= 2:
+            receptive = 1
+            for d in shape[:-2]:
+                receptive *= d
+            fan_in = shape[-2] * receptive
+            fan_out = shape[-1] * receptive
+        else:
+            fan_in = fan_out = shape[0]
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+class ZeroInitializer(Initializer):
+    """reference initializer.h:49-56 / initializer_kernel.cu zero fill."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class UniformInitializer(Initializer):
+    """reference initializer.h:58-70 (min/max uniform via cuRAND)."""
+
+    def __init__(self, minval: float = -0.05, maxval: float = 0.05, seed: int = 0):
+        self.minval = minval
+        self.maxval = maxval
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.fold_in(key, self.seed)
+        return jax.random.uniform(key, shape, dtype, minval=self.minval, maxval=self.maxval)
+
+
+class NormInitializer(Initializer):
+    """Gaussian init (reference initializer.h:72-84)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, seed: int = 0):
+        self.mean = mean
+        self.stddev = stddev
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.fold_in(key, self.seed)
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    """reference initializer.h:86-101."""
+
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+# Convenience registry (mirrors how FFModel picks defaults for dense/conv:
+# glorot for kernels, zero for bias — reference linear.cu:83-99).
+DEFAULT_KERNEL_INIT = GlorotUniform()
+DEFAULT_BIAS_INIT = ZeroInitializer()

@@ -1,0 +1,75 @@
+"""Loss functions.
+
+TPU-native equivalent of the reference loss subsystem
+(reference: src/loss_functions/loss_functions.cu — CCE/sparse-CCE/MSE
+backward kernels loss_functions.cu:36-74, launched over the logit partition
+with ``scale_factor = 1/batch`` loss_functions.cu:146).
+
+The reference only implements *backward* kernels (the scalar loss value is
+never materialized); here each loss is a scalar-valued pure function whose
+JAX gradient reproduces the reference backward exactly, including the
+1/batch scaling:
+  sparse-CCE grad: (softmax(logits) - onehot) / batch  == loss_functions.cu:36-50
+  CCE grad       : (probs - labels) / batch            == loss_functions.cu:52-62
+  MSE grad       : 2 (pred - label) / batch            == loss_functions.cu:64-74
+which correspond to mean-over-batch of (sum-over-class CE) and mean-over-
+batch *sum-over-feature* squared error respectively.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LOSS_FUNCTIONS = {}
+
+
+def _register(name):
+    def deco(f):
+        LOSS_FUNCTIONS[name] = f
+        return f
+    return deco
+
+
+@_register("sparse_categorical_crossentropy")
+def sparse_categorical_crossentropy(logits, labels):
+    """labels: int (batch,) or (batch, 1). Softmax applied internally
+    (matching the reference's softmax-fused backward)."""
+    if labels.ndim == logits.ndim:
+        labels = jnp.squeeze(labels, axis=-1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return jnp.mean(logz - picked)
+
+
+@_register("categorical_crossentropy")
+def categorical_crossentropy(probs, labels):
+    """Dense labels, probabilities already softmaxed (the reference applies
+    CCE to a Softmax op output, loss_functions.cu:52-62)."""
+    eps = 1e-12
+    ce = -jnp.sum(labels * jnp.log(probs + eps), axis=-1)
+    return jnp.mean(ce)
+
+
+@_register("mean_squared_error")
+def mean_squared_error(preds, labels):
+    """Mean over batch of sum-over-features squared error — this matches the
+    reference gradient 2*(y-t)/batch per element (loss_functions.cu:64-74),
+    NOT numpy's mean-over-all-elements."""
+    se = jnp.sum(jnp.square(preds - labels), axis=tuple(range(1, preds.ndim)))
+    return jnp.mean(se)
+
+
+# aliases matching reference LossType enum spellings
+LOSS_FUNCTIONS["sparse_crossentropy"] = sparse_categorical_crossentropy
+LOSS_FUNCTIONS["crossentropy"] = categorical_crossentropy
+LOSS_FUNCTIONS["mse"] = mean_squared_error
+
+
+def get_loss(name):
+    if callable(name):
+        return name
+    if name not in LOSS_FUNCTIONS:
+        raise ValueError(f"unknown loss {name!r}; have {sorted(LOSS_FUNCTIONS)}")
+    return LOSS_FUNCTIONS[name]

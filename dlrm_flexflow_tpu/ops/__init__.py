@@ -1,0 +1,21 @@
+"""Operator library (TPU-native equivalents of reference src/ops/)."""
+
+from .base import Op, activation_fn, matmul
+from .linear import Linear
+from .embedding import Embedding, StackedEmbedding
+from .elementwise import ElementBinary, ElementUnary
+from .shape_ops import (BatchMatmul, Concat, Flat, Reshape, Reverse, Split,
+                        Transpose)
+from .conv import BatchNorm, Conv2D, Pool2D
+from .softmax import Dropout, Softmax
+from .attention import MultiHeadAttention, sdpa
+
+__all__ = [
+    "Op", "activation_fn", "matmul",
+    "Linear", "Embedding", "StackedEmbedding",
+    "ElementBinary", "ElementUnary",
+    "BatchMatmul", "Concat", "Flat", "Reshape", "Reverse", "Split", "Transpose",
+    "BatchNorm", "Conv2D", "Pool2D",
+    "Dropout", "Softmax",
+    "MultiHeadAttention", "sdpa",
+]

@@ -1,0 +1,119 @@
+"""Multi-head attention with sequence-parallel (ring) execution.
+
+The reference has **no attention op** (SURVEY §5.7) — its closest analogue
+is NMT's per-timestep-block device placement (nmt/rnn.h:58-63).  This
+framework treats the sequence axis as a first-class shardable dim of the
+SOAP space, so long-context training is native:
+
+- single-device path: fused scaled-dot-product attention (XLA fuses the
+  softmax into the two MXU matmuls);
+- sequence-parallel path: **ring attention** via ``shard_map`` +
+  ``lax.ppermute`` over the mesh's "seq" axis — each chip holds a query
+  block and streams K/V blocks around the ICI ring, accumulating with an
+  online-softmax (flash-style) update, so memory stays O(seq/devices).
+
+See parallel/ring_attention.py for the ring kernel itself.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import DEFAULT_KERNEL_INIT
+from ..tensor import ParameterSpec
+from .base import Op
+
+
+def sdpa(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Scaled dot-product attention, (B, H, S, D) layout."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+class MultiHeadAttention(Op):
+    """Self/cross attention: inputs (B, S, E) -> (B, S, E).
+
+    ``seq_parallel=True`` asks the compiler to run the core via ring
+    attention over the mesh "seq"/"context" axis (parallel/ring_attention).
+    """
+
+    op_type = "MultiHeadAttention"
+
+    def __init__(self, name, query, key, value, embed_dim: int, num_heads: int,
+                 causal: bool = False, kernel_initializer=None,
+                 seq_parallel: bool = False, compute_dtype=None):
+        super().__init__(name, [query, key, value])
+        assert embed_dim % num_heads == 0
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.seq_parallel = seq_parallel
+        self.compute_dtype = compute_dtype
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT
+        b, s, _ = query.shape
+        self.outputs = [self._make_output((b, s, embed_dim), query.dtype)]
+
+    def param_specs(self):
+        e = self.embed_dim
+        qdim = self.inputs[0].shape[-1]
+        kdim = self.inputs[1].shape[-1]
+        vdim = self.inputs[2].shape[-1]
+        return [
+            ParameterSpec(self.name, "wq", (qdim, e),
+                          initializer=self.kernel_initializer, sharded_dim=1),
+            ParameterSpec(self.name, "wk", (kdim, e),
+                          initializer=self.kernel_initializer, sharded_dim=1),
+            ParameterSpec(self.name, "wv", (vdim, e),
+                          initializer=self.kernel_initializer, sharded_dim=1),
+            ParameterSpec(self.name, "wo", (e, e),
+                          initializer=self.kernel_initializer, sharded_dim=0),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        q_in, k_in, v_in = xs
+        cd = jnp.bfloat16 if self.compute_dtype in ("bfloat16", jnp.bfloat16) else None
+
+        def proj(x, w):
+            if cd is not None:
+                x, w = x.astype(cd), w.astype(cd)
+            return jnp.einsum("bse,ef->bsf", x, w,
+                              preferred_element_type=jnp.float32)
+
+        b, s, _ = q_in.shape
+        h, d = self.num_heads, self.head_dim
+        q = proj(q_in, params["wq"]).reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        k = proj(k_in, params["wk"]).reshape(b, -1, h, d).transpose(0, 2, 1, 3)
+        v = proj(v_in, params["wv"]).reshape(b, -1, h, d).transpose(0, 2, 1, 3)
+        if cd is not None:
+            q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
+        mesh = self._mesh
+        if (self.seq_parallel and mesh is not None
+                and "seq" in mesh.axis_names and mesh.shape["seq"] > 1):
+            from ..parallel.ring_attention import ring_attention_sharded
+            o = ring_attention_sharded(q, k, v, mesh, seq_axis="seq",
+                                       causal=self.causal)
+        else:
+            o = sdpa(q, k, v, causal=self.causal)  # (b, h, s, d)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.embed_dim)
+        out = proj(o, params["wo"]).astype(self.outputs[0].dtype)
+        return [out]
+
+    def flops(self, batch):
+        s = self.inputs[0].shape[1]
+        e = self.embed_dim
+        # 4 projections + 2 attention matmuls
+        return batch * (4 * 2 * s * e * e + 2 * 2 * s * s * e)

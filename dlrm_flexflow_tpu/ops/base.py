@@ -1,0 +1,125 @@
+"""Operator base class for the graph-builder.
+
+TPU-native analogue of the reference's abstract ``Op``
+(reference: include/model.h:240-281).  The reference Op owns Legion
+regions/partitions and exposes init/forward/backward task launchers; here an
+Op is a *pure-functional* node: it declares its parameters (ParameterSpec)
+and implements ``forward`` as a jnp function.  Backward comes for free from
+JAX autodiff (custom_vjp where the reference hand-writes kernels).
+
+Parallelization: each op carries a ``ParallelConfig`` (parallel/) that the
+compiler translates into ``PartitionSpec`` sharding constraints — the moral
+equivalent of the reference's per-op strategy map consumed by the FFMapper
+(src/mapper/mapper.cc:33-97).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..tensor import ParameterSpec, Tensor
+
+
+class Op:
+    """One graph node.
+
+    Subclasses set ``self.outputs`` in ``__init__`` and implement
+    ``forward``.  ``params`` is a dict param_name -> array, stored in the
+    model-level pytree under ``self.name``.
+    """
+
+    #: class-level default op-type string (reference uses OperatorType enum)
+    op_type: str = "op"
+
+    def __init__(self, name: str, inputs: Sequence[Tensor]):
+        self.name = name
+        self.inputs: List[Tensor] = list(inputs)
+        self.outputs: List[Tensor] = []
+        # SOAP per-op strategy; None = inherit model default (data-parallel),
+        # mirroring FFConfig::find_parallel_config fallback (strategy.cc:28-94).
+        self.parallel_config = None
+        self.profiling = False
+        # set by FFModel.compile: the active mesh, for ops that issue manual
+        # collectives (e.g. ring attention over the "seq" axis)
+        self._mesh = None
+
+    # ---- graph construction -------------------------------------------------
+    def _make_output(self, shape, dtype=jnp.float32, idx: int = 0) -> Tensor:
+        t = Tensor(shape=shape, dtype=dtype, owner_op=self, owner_idx=idx,
+                   name=f"{self.name}:out{idx}")
+        return t
+
+    # ---- parameters ---------------------------------------------------------
+    def param_specs(self) -> List[ParameterSpec]:
+        """Declare weights (reference Op::create_weights)."""
+        return []
+
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        specs = self.param_specs()
+        out = {}
+        import jax
+
+        keys = jax.random.split(key, max(1, len(specs)))
+        for k, spec in zip(keys, specs):
+            init = spec.initializer
+            out[spec.param_name] = init(k, spec.shape, spec.dtype)
+        return out
+
+    # ---- execution ----------------------------------------------------------
+    def forward(self, params: Dict[str, jnp.ndarray], xs: List[jnp.ndarray], *,
+                training: bool = False, rng=None) -> List[jnp.ndarray]:
+        raise NotImplementedError
+
+    # ---- cost model hooks (used by sim/) -----------------------------------
+    def flops(self, batch: int) -> int:
+        """Approximate forward FLOPs for the simulator's cost model
+        (the reference instead times real kernels, simulator.cc:235-273;
+        we support both measured and analytic costs)."""
+        return 0
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+def activation_fn(name: Optional[str]):
+    """Shared activation table (reference fuses these via cuDNN activation
+    descriptors in linear/conv kernels, e.g. linear.cu:432-441)."""
+    if name is None or name == "none" or name == "linear":
+        return lambda x: x
+    import jax
+
+    table = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "elu": jax.nn.elu,
+        "gelu": jax.nn.gelu,
+        "exp": jnp.exp,
+        "softmax": jax.nn.softmax,
+        "identity": lambda x: x,
+    }
+    if name not in table:
+        raise ValueError(f"unknown activation {name!r}")
+    return table[name]
+
+
+def matmul(x, w, compute_dtype=None):
+    """Matmul helper routed at the MXU.
+
+    On TPU the MXU natively multiplies bf16 with f32 accumulation; when
+    ``compute_dtype='bfloat16'`` we cast operands down but keep f32
+    accumulation via ``preferred_element_type`` — the TPU-idiomatic
+    replacement for the reference's cublasSgemm calls (linear.cu:432-441).
+    """
+    import jax
+
+    if compute_dtype in ("bfloat16", jnp.bfloat16):
+        x = x.astype(jnp.bfloat16)
+        w = w.astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        x, w,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )

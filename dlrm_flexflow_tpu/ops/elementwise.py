@@ -1,0 +1,100 @@
+"""Elementwise unary/binary operators.
+
+TPU-native equivalents of the reference ElementUnary / ElementBinary ops
+(reference: src/ops/element_unary.cu:112+ — cuDNN activation descriptors or
+custom kernels for exp/relu/sigmoid/tanh/elu + scalar add/sub/mul/div;
+src/ops/element_binary.cu — cuDNN OpTensor add/sub/mul/div, same-shape
+only, include/model.h:519-525).
+
+On TPU all of these are single VPU-mapped XLA HLO ops that the compiler
+fuses into neighbouring matmuls, so there is nothing to hand-optimise; the
+value of these classes is graph-building parity + per-op strategy hooks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Op
+
+_UNARY = {
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+    "rsqrt": jax.lax.rsqrt,
+    "sqrt": jnp.sqrt,
+    "negative": jnp.negative,
+}
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "subtract": jnp.subtract,
+    "mul": jnp.multiply,
+    "multiply": jnp.multiply,
+    "div": jnp.divide,
+    "divide": jnp.divide,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+}
+
+
+class ElementUnary(Op):
+    """Unary pointwise op, optionally scalar-parameterised.
+
+    ``scalar`` covers the reference's scalar_add/sub/mul/truediv variants
+    (element_unary.cu scalar op codes).
+    """
+
+    op_type = "ElementUnary"
+
+    def __init__(self, name, input_tensor, fn: str, scalar: float = None,
+                 inplace: bool = True):
+        super().__init__(name, [input_tensor])
+        self.fn = fn
+        self.scalar = scalar
+        if fn not in _UNARY and fn not in ("scalar_add", "scalar_sub",
+                                           "scalar_mul", "scalar_truediv",
+                                           "pow"):
+            raise ValueError(f"unknown unary fn {fn!r}")
+        self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        if self.fn == "scalar_add":
+            return [x + self.scalar]
+        if self.fn == "scalar_sub":
+            return [x - self.scalar]
+        if self.fn == "scalar_mul":
+            return [x * self.scalar]
+        if self.fn == "scalar_truediv":
+            return [x / self.scalar]
+        if self.fn == "pow":
+            return [jnp.power(x, self.scalar)]
+        return [_UNARY[self.fn](x)]
+
+
+class ElementBinary(Op):
+    """Binary pointwise op.  The reference requires identical shapes
+    (element_binary.cu shape asserts); we additionally allow NumPy
+    broadcasting since XLA supports it natively."""
+
+    op_type = "ElementBinary"
+
+    def __init__(self, name, a, b, fn: str):
+        super().__init__(name, [a, b])
+        if fn not in _BINARY:
+            raise ValueError(f"unknown binary fn {fn!r}")
+        self.fn = fn
+        out_shape = jnp.broadcast_shapes(a.shape, b.shape)
+        self.outputs = [self._make_output(out_shape, a.dtype)]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        a, b = xs
+        return [_BINARY[self.fn](a, b)]

@@ -1,0 +1,70 @@
+"""Dense / Linear operator.
+
+TPU-native equivalent of the reference Linear op (reference:
+src/ops/linear.cu — cuBLAS sgemm forward linear.cu:432-441, fused cuDNN
+activation, 3-gemm backward with beta=1 accumulation linear.cu:616-634, and
+channel-parallel TP via replica tensors + LINEAR_BWD2 saxpy reduction
+linear.cu:766-794).
+
+On TPU: forward is one MXU matmul; the TP input-grad all-reduce that the
+reference emulates with replica regions is produced automatically by the XLA
+SPMD partitioner when the weight is sharded over its out-channel dim — see
+parallel/parallel_config.py for how ``num_par_c`` maps to the "model" mesh
+axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..initializers import DEFAULT_BIAS_INIT, DEFAULT_KERNEL_INIT
+from ..tensor import ParameterSpec
+from .base import Op, activation_fn, matmul
+
+
+class Linear(Op):
+    op_type = "Dense"
+
+    def __init__(self, name, input_tensor, out_dim: int,
+                 activation: Optional[str] = None, use_bias: bool = True,
+                 kernel_initializer=None, bias_initializer=None,
+                 compute_dtype=None):
+        super().__init__(name, [input_tensor])
+        assert len(input_tensor.shape) >= 2, "Linear expects (batch, ..., in_dim)"
+        self.in_dim = input_tensor.shape[-1]
+        self.out_dim = int(out_dim)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT
+        self.bias_initializer = bias_initializer or DEFAULT_BIAS_INIT
+        self.compute_dtype = compute_dtype
+        out_shape = tuple(input_tensor.shape[:-1]) + (self.out_dim,)
+        self.outputs = [self._make_output(out_shape, input_tensor.dtype)]
+
+    def param_specs(self):
+        # Weight layout (in, out): out-channel last => TP shards dim 1,
+        # matching the reference's out-channel weight sharding
+        # (linear.cu:153-157, model.cc:677-689).
+        specs = [ParameterSpec(self.name, "kernel", (self.in_dim, self.out_dim),
+                               initializer=self.kernel_initializer, sharded_dim=1)]
+        if self.use_bias:
+            specs.append(ParameterSpec(self.name, "bias", (self.out_dim,),
+                                       initializer=self.bias_initializer,
+                                       sharded_dim=0))
+        return specs
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        y = matmul(x, params["kernel"], self.compute_dtype)
+        if self.use_bias:
+            y = y + params["bias"]
+        y = activation_fn(self.activation)(y)
+        return [y.astype(self.outputs[0].dtype)]
+
+    def flops(self, batch):
+        rows = batch
+        for d in self.inputs[0].shape[1:-1]:
+            rows *= d
+        return 2 * rows * self.in_dim * self.out_dim

@@ -1,0 +1,52 @@
+"""Softmax and Dropout operators.
+
+TPU-native equivalents of (reference):
+  Softmax src/ops/softmax.cu:301 — cuDNN softmax forward; backward fused
+          with sparse-CCE assumptions (the loss subsystem here keeps the
+          same fusion by computing CCE from logits with stable logsumexp).
+  Dropout src/ops/dropout.cu:329 — cuDNN dropout with per-device reserve
+          space; here the mask comes from the functional PRNG key the model
+          threads to each dropout op, so repeated steps are reproducible
+          and trace-safe under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import Op
+
+
+class Softmax(Op):
+    op_type = "Softmax"
+
+    def __init__(self, name, input_tensor, axis: int = -1):
+        super().__init__(name, [input_tensor])
+        self.axis = axis
+        self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        return [jax.nn.softmax(xs[0], axis=self.axis)]
+
+
+class Dropout(Op):
+    op_type = "Dropout"
+
+    def __init__(self, name, input_tensor, rate: float = 0.5, seed: int = 0):
+        super().__init__(name, [input_tensor])
+        assert 0.0 <= rate < 1.0
+        self.rate = rate
+        self.seed = seed
+        self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        (x,) = xs
+        if not training or self.rate == 0.0:
+            return [x]
+        assert rng is not None, "training-mode dropout needs an rng key"
+        if self.seed:
+            rng = jax.random.fold_in(rng, self.seed)
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

@@ -1,0 +1,109 @@
+"""Device mesh construction and ParallelConfig -> PartitionSpec translation.
+
+TPU-native replacement for the reference's Legion mapper
+(reference: src/mapper/mapper.cc — ``FFMapper::slice_task`` mapper.cc:33-97
+routes each index-task point to the ParallelConfig's device; memory
+selection mapper.cc:156-179).  On TPU there is no per-task routing: we
+declare a ``jax.sharding.Mesh`` once and translate each op's
+ParallelConfig into a ``PartitionSpec``; the XLA SPMD partitioner then
+"maps" every op by construction and inserts ICI collectives where tensor
+layouts change between producer and consumer — the analogue of Legion's
+implicit repartition DMAs (linear.cu:266-292).
+
+Mesh axes:
+  "data"  — sample/batch dim partitions (reference DP, model.cc:282-293)
+  "model" — channel / table / parameter partitions (reference TP,
+            linear.cu:153-157; per-table placement dlrm_strategy.cc:251-256)
+Extra axes (e.g. "seq" for context parallelism, "expert") can be added via
+``make_mesh``; ParallelConfig dims beyond batch/channel map positionally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .parallel_config import ParallelConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh. Default: all devices on the "data" axis.
+
+    ``shape`` e.g. {"data": 4, "model": 2}. Axis sizes must multiply to the
+    device count used.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if shape is None:
+        shape = {DATA_AXIS: len(devices)}
+    names = tuple(shape.keys())
+    sizes = tuple(int(shape[n]) for n in names)
+    n = int(np.prod(sizes))
+    assert n <= len(devices), f"mesh {shape} needs {n} devices, have {len(devices)}"
+    arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def pspec_for_config(pc: Optional[ParallelConfig], ndim: int,
+                     mesh: Mesh) -> PartitionSpec:
+    """Translate an op's output ParallelConfig into a PartitionSpec.
+
+    Rules (covering the reference's strategy vocabulary):
+      dims[0]   > 1  -> shard batch dim over "data"      (sample parallel)
+      dims[-1]  > 1  -> shard last dim over "model"      (channel parallel,
+                        linear num_par_c, linear.cu:153-157)
+      dims[i] > 1 for middle dims -> "seq" axis if present, else "model"
+                        (attribute/spatial parallelism, conv h/w parts)
+    Unpartitioned dims -> None (replicated).
+    """
+    if pc is None:
+        return PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))
+    axes = [None] * ndim
+    dims = list(pc.dims) + [1] * (ndim - len(pc.dims))
+    have = set(mesh.axis_names)
+    if dims[0] > 1 and DATA_AXIS in have:
+        axes[0] = DATA_AXIS
+    used_model = False
+    for i in range(1, ndim):
+        if dims[i] > 1:
+            if i == ndim - 1 and MODEL_AXIS in have and not used_model:
+                axes[i] = MODEL_AXIS
+                used_model = True
+            elif SEQ_AXIS in have and axes.count(SEQ_AXIS) == 0:
+                axes[i] = SEQ_AXIS
+            elif MODEL_AXIS in have and not used_model:
+                axes[i] = MODEL_AXIS
+                used_model = True
+    return PartitionSpec(*axes)
+
+
+def param_pspec(sharded_dim: Optional[int], ndim: int, mesh: Mesh,
+                tensor_parallel: bool) -> PartitionSpec:
+    """Weight sharding: replicated for DP (the reference keeps one logical
+    weight region with per-replica grad slices, model.cc:634-726); sharded
+    over "model" on ``sharded_dim`` when the owning op is tensor-parallel."""
+    axes = [None] * ndim
+    if tensor_parallel and sharded_dim is not None and MODEL_AXIS in mesh.axis_names:
+        axes[sharded_dim] = MODEL_AXIS
+    return PartitionSpec(*axes)
+
+
+def sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def constrain(x, mesh: Optional[Mesh], spec: PartitionSpec):
+    """Apply a sharding constraint if a mesh is active (the per-op analogue
+    of the mapper's placement decision)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

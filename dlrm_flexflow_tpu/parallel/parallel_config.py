@@ -1,0 +1,115 @@
+"""SOAP parallelization strategies.
+
+TPU-native equivalent of the reference strategy system (reference:
+include/config.h:41-50 ``ParallelConfig`` {device_type, nDims, dim[],
+device_ids[]}; src/runtime/strategy.proto:5-23 serialized schema;
+src/runtime/strategy.cc:28-94 default data-parallel fallback;
+src/runtime/strategy.cc:96-172 load/save).
+
+Semantics mapping:
+  reference dim[] is innermost-first with the sample dim LAST (Legion
+  layout); here ``dims`` is batch-first, matching the tensor shapes of this
+  framework.  ``from_reference_dims`` converts.
+
+  device_ids[] in the reference routes each task point to a physical GPU
+  via the FFMapper (mapper.cc:33-97).  On TPU, placement is expressed as a
+  mapping of partitioned tensor dims onto named mesh axes; the XLA SPMD
+  partitioner then owns per-chip placement.  ``device_ids`` is retained for
+  strategy-file compatibility and for the simulator's cost model.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEVICE_TYPES = ("tpu", "cpu")
+
+
+@dataclass
+class ParallelConfig:
+    """Per-op N-D output partitioning (reference config.h:41-50)."""
+
+    dims: Tuple[int, ...] = (1,)
+    device_type: str = "tpu"
+    device_ids: Optional[List[int]] = None
+
+    def __post_init__(self):
+        self.dims = tuple(int(d) for d in self.dims)
+        assert self.device_type in DEVICE_TYPES
+
+    @property
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @staticmethod
+    def data_parallel(ndim: int, num_devices: int) -> "ParallelConfig":
+        """Partition the sample (first) dim over all devices — the
+        reference default (``Op::get_data_parallel_config``,
+        model.cc:282-293, which splits the LAST Legion dim = sample)."""
+        dims = (num_devices,) + (1,) * (ndim - 1)
+        return ParallelConfig(dims=dims, device_ids=list(range(num_devices)))
+
+    @staticmethod
+    def from_reference_dims(ref_dims: Sequence[int], **kw) -> "ParallelConfig":
+        """Convert a reference innermost-first dim[] (sample last) to
+        batch-first order."""
+        return ParallelConfig(dims=tuple(reversed(list(ref_dims))), **kw)
+
+    def to_json(self) -> dict:
+        return {"dims": list(self.dims), "device_type": self.device_type,
+                "device_ids": self.device_ids}
+
+    @staticmethod
+    def from_json(d: dict) -> "ParallelConfig":
+        return ParallelConfig(dims=tuple(d["dims"]),
+                              device_type=d.get("device_type", "tpu"),
+                              device_ids=d.get("device_ids"))
+
+
+@dataclass
+class Strategy:
+    """A full model strategy: op name -> ParallelConfig
+    (reference: map<MappingTagID, ParallelConfig> keyed by hashed op name,
+    strategy.cc:96-135)."""
+
+    configs: Dict[str, ParallelConfig] = field(default_factory=dict)
+
+    def find(self, op_name: str, ndim: int,
+             num_devices: int) -> ParallelConfig:
+        """Lookup with default-DP fallback (reference
+        FFConfig::find_parallel_config, strategy.cc:28-94)."""
+        if op_name in self.configs:
+            return self.configs[op_name]
+        return ParallelConfig.data_parallel(ndim, num_devices)
+
+    def __setitem__(self, k, v):
+        self.configs[k] = v
+
+    def __getitem__(self, k):
+        return self.configs[k]
+
+    def __contains__(self, k):
+        return k in self.configs
+
+    # ---- serialization (JSON superset of strategy.proto's fields) ---------
+    def save(self, path: str):
+        """reference save_strategies_to_file (strategy.cc:137-172)."""
+        data = {"ops": [{"name": k, **v.to_json()}
+                        for k, v in sorted(self.configs.items())]}
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+
+    @staticmethod
+    def load(path: str) -> "Strategy":
+        """reference load_strategies_from_file (strategy.cc:96-135)."""
+        with open(path) as f:
+            data = json.load(f)
+        s = Strategy()
+        for op in data["ops"]:
+            s.configs[op["name"]] = ParallelConfig.from_json(op)
+        return s

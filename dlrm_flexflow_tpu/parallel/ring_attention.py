@@ -1,0 +1,119 @@
+"""Ring attention: sequence-parallel attention over the ICI ring.
+
+No reference analogue (the reference has no attention and no sequence-dim
+sharding, SURVEY §5.7) — this is the long-context capability the TPU
+framework treats as first-class.  Design:
+
+- K/V blocks circulate around the mesh's "seq" axis with ``lax.ppermute``
+  (one neighbour hop per step — rides the bidirectional ICI ring);
+- each device keeps its query block resident and folds every incoming K/V
+  block with an **online softmax** (flash-attention style running max /
+  running denominator), so peak memory is O(S/devices) and the full S x S
+  score matrix is never materialized;
+- the loop is a ``lax.fori_loop`` so XLA overlaps the ppermute DMA of block
+  i+1 with the matmuls of block i.
+
+Used via shard_map with sequence-sharded q/k/v; see
+``ring_attention_sharded``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """Unnormalized block attention: returns (acc, row_max, row_sum).
+
+    ``row_max`` is the TRUE block max (-inf for fully-masked rows) so the
+    online merge can tell "saw nothing" apart from "saw logits near 0".
+    """
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # (b,h,s); -inf when fully masked
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    acc = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return acc, m, jnp.sum(p, axis=-1)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None,
+                   q_offset: Optional[jnp.ndarray] = None):
+    """Attention where q/k/v hold only this device's sequence block.
+
+    Args:
+      q, k, v: (B, H, S_local, D) — this shard's blocks.
+      axis_name: mesh axis carrying the sequence shards.
+      causal: causal masking using global positions.
+      q_offset: global start position of this device's q block; defaults to
+        axis_index * S_local (contiguous layout).
+    Returns (B, H, S_local, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if q_offset is None:
+        q_offset = idx * s_local
+    qpos = q_offset + jnp.arange(s_local)  # global q positions
+
+    acc0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+
+    def body(i, carry):
+        acc, m, l, k_blk, v_blk = carry
+        # k block i came from device (idx - i) mod n
+        src = (idx - i) % n
+        kpos = src * s_local + jnp.arange(s_local)
+        mask = None
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]  # (s, t)
+            mask = mask[None, None, :, :]
+        blk_acc, blk_m, blk_l = _block_attn(q, k_blk, v_blk, scale, mask)
+        # online-softmax merge; -inf maxima mean "no unmasked key seen"
+        new_m = jnp.maximum(m, blk_m)
+        # new_m is -inf only when both inputs are -inf (nothing seen yet AND
+        # fully masked block) — exp(-inf - -inf) would be nan; guard:
+        safe_new_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_new_m), 0.0)
+        beta = jnp.where(jnp.isfinite(blk_m), jnp.exp(blk_m - safe_new_m), 0.0)
+        acc = acc * alpha[..., None] + blk_acc * beta[..., None]
+        l = l * alpha + blk_l * beta
+        # rotate k/v to the next device (one ICI hop)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return acc, new_m, l, k_blk, v_blk
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                           causal: bool = False):
+    """shard_map wrapper: q/k/v are global (B, H, S, D) arrays sharded on S.
+
+    The data axis (if present in the mesh) shards B as usual; S is sharded
+    over ``seq_axis``; heads/dim replicated.
+    """
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, None, seq_axis, None)
+
+    f = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,105 @@
+"""Symbolic tensors of the graph-builder API.
+
+TPU-native analogue of the reference ``Tensor``/``Parameter`` structs
+(reference: include/model.h:181-231).  The reference tensor carries Legion
+regions and partitions; here a tensor is pure metadata — shape, dtype and
+provenance (owner op) — because actual storage is managed functionally by
+JAX and placement is expressed with ``jax.sharding`` at compile time.
+
+Axis convention: **batch-first** (NumPy/JAX idiom).  The reference stores
+``adim[]`` innermost-first with the sample dim last (Legion layout,
+model.h:188); we present shapes the standard Python way and translate when
+mapping ``ParallelConfig`` dims (parallel/parallel_config.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+_counter = itertools.count()
+
+# dtype table — reference DataType enum (model.h dtypes via DT_FLOAT etc.)
+DTYPES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+
+def as_dtype(dt):
+    if isinstance(dt, str):
+        return DTYPES[dt]
+    return dt
+
+
+@dataclass
+class Tensor:
+    """A node edge in the op graph (reference model.h:181-217).
+
+    ``owner_op``/``owner_idx`` mirror the reference's provenance fields so
+    the model can walk producers during compile.
+    """
+
+    shape: Tuple[int, ...]
+    dtype: object = jnp.float32
+    owner_op: Optional[object] = None  # Op that produced it
+    owner_idx: int = 0
+    name: Optional[str] = None
+    uid: int = field(default_factory=lambda: next(_counter))
+
+    def __post_init__(self):
+        self.shape = tuple(int(d) for d in self.shape)
+        self.dtype = as_dtype(self.dtype)
+        if self.name is None:
+            self.name = f"tensor_{self.uid}"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def batch(self) -> int:
+        return self.shape[0]
+
+    def numel(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    def __hash__(self):
+        return self.uid
+
+    def __eq__(self, other):
+        return isinstance(other, Tensor) and other.uid == self.uid
+
+    def __repr__(self):
+        return f"Tensor({self.name}, shape={self.shape}, dtype={jnp.dtype(self.dtype).name})"
+
+
+@dataclass
+class ParameterSpec:
+    """Weight metadata (reference Parameter, model.h:219-231).
+
+    Keyed by ``(op_name, param_name)`` in the params pytree; ``sharded_dim``
+    records which dim a tensor-parallel strategy splits (e.g. the
+    out-channel of a Linear weight, linear.cu:153-157).
+    """
+
+    op_name: str
+    param_name: str
+    shape: Tuple[int, ...]
+    dtype: object = jnp.float32
+    initializer: Optional[object] = None
+    sharded_dim: Optional[int] = None
+
+    def __post_init__(self):
+        self.shape = tuple(int(d) for d in self.shape)
+        self.dtype = as_dtype(self.dtype)

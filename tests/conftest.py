@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding
+and collectives are exercised without TPU hardware (the analogue of the
+reference's same-host multi-GPU test runs, src/ops/tests/test_harness.py
+``-ll:gpu {1,2,4,8}``)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# A sitecustomize may have force-registered a TPU backend (overriding the
+# env var), so pin the platform via jax.config as well.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture()
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)

@@ -1,0 +1,114 @@
+"""End-to-end DLRM training tests (tier-2 of SURVEY §4: example-driven
+integration) — the minimum end-to-end slice of SURVEY §7 step 3.
+"""
+
+import numpy as np
+
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+
+
+def small_cfg(**kw):
+    d = dict(sparse_feature_size=8,
+             embedding_size=[100] * 4,
+             embedding_bag_size=2,
+             mlp_bot=[13, 32, 8],
+             mlp_top=[8 * 4 + 8, 32, 1])
+    d.update(kw)
+    return DLRMConfig(**d)
+
+
+def test_dlrm_builds_and_shapes():
+    cfg = small_cfg()
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=16))
+    assert m.final_tensor.shape == (16, 1)
+
+
+def test_dlrm_train_loss_decreases():
+    cfg = small_cfg()
+    fc = ff.FFConfig(batch_size=32, learning_rate=0.05)
+    m = build_dlrm(cfg, fc)
+    m.compile(optimizer=ff.AdamOptimizer(lr=0.01),
+              loss_type="mean_squared_error",
+              metrics=("accuracy", "mean_squared_error"))
+    state = m.init(seed=0)
+    # learnable labels: a function of the dense features (pure-random labels
+    # would leave MSE pinned at its 0.25 floor)
+    loader = SyntheticDLRMLoader(256, 13, cfg.embedding_size, 2, 32, seed=1)
+    dense = loader.inputs["dense"]
+    loader.labels = (dense[:, :4].sum(axis=1, keepdims=True) > 0).astype(
+        np.float32)
+    losses = []
+    for epoch in range(6):
+        tot, nb = 0.0, 0
+        for inputs, labels in loader:
+            state, mets = m.train_step(state, inputs, labels)
+            tot += float(mets["loss"])
+            nb += 1
+        losses.append(tot / nb)
+    assert losses[-1] < losses[0] * 0.7, f"no learning: {losses}"
+
+
+def test_dlrm_dot_interaction():
+    cfg = small_cfg(arch_interaction_op="dot",
+                    mlp_top=[8 + (4 + 1) ** 2, 16, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8))
+    m.compile(loss_type="mean_squared_error", metrics=("accuracy",))
+    state = m.init()
+    loader = SyntheticDLRMLoader(32, 13, cfg.embedding_size, 2, 8)
+    inputs, labels = loader.peek()
+    state, mets = m.train_step(state, inputs, labels)
+    assert np.isfinite(float(mets["loss"]))
+
+
+def test_dlrm_separate_tables_nonuniform():
+    cfg = small_cfg(embedding_size=[50, 100, 150, 200])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8), stacked_embeddings=False)
+    m.compile(loss_type="mean_squared_error", metrics=())
+    state = m.init()
+    loader = SyntheticDLRMLoader(16, 13, cfg.embedding_size, 2, 8,
+                                 stacked=False)
+    inputs, labels = loader.peek()
+    state, mets = m.train_step(state, inputs, labels)
+    assert np.isfinite(float(mets["loss"]))
+
+
+def test_dlrm_fit_reports_throughput(capsys):
+    cfg = small_cfg()
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=16, epochs=1))
+    m.compile(loss_type="mean_squared_error",
+              metrics=("accuracy", "mean_squared_error"))
+    state = m.init()
+    loader = SyntheticDLRMLoader(64, 13, cfg.embedding_size, 2, 16)
+    state, thpt = m.fit(state, loader, epochs=1)
+    assert thpt > 0
+    out = capsys.readouterr().out
+    assert "THROUGHPUT" in out
+
+
+def test_deterministic_init_and_step():
+    cfg = small_cfg()
+    loader = SyntheticDLRMLoader(32, 13, cfg.embedding_size, 2, 16, seed=3)
+    inputs, labels = loader.peek()
+    results = []
+    for _ in range(2):
+        m = build_dlrm(cfg, ff.FFConfig(batch_size=16))
+        m.compile(loss_type="mean_squared_error", metrics=())
+        state = m.init(seed=42)
+        state, mets = m.train_step(state, inputs, labels)
+        results.append(float(mets["loss"]))
+    assert results[0] == results[1]
+
+
+def test_weights_roundtrip():
+    cfg = small_cfg()
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8))
+    m.compile(loss_type="mean_squared_error", metrics=())
+    state = m.init()
+    w = m.get_weights(state, "bot_0", "kernel")
+    w2 = np.ones_like(w)
+    state = m.set_weights(state, "bot_0", "kernel", w2)
+    np.testing.assert_allclose(m.get_weights(state, "bot_0", "kernel"), w2)

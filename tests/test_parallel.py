@@ -1,0 +1,183 @@
+"""Distributed-execution tests on the 8-device virtual CPU mesh.
+
+Tier-1 multi-device coverage (the reference runs the same binaries with
+``-ll:gpu {1,2,4,8}`` on one host, test_harness.py:246-287; here a forced
+8-CPU platform plays that role).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+from dlrm_flexflow_tpu.ops import sdpa
+from dlrm_flexflow_tpu.parallel import (ParallelConfig, ring_attention_sharded)
+from dlrm_flexflow_tpu.parallel.mesh import make_mesh, pspec_for_config
+
+
+def small_dlrm(batch=32, mesh_shape=None, table_parallel=False):
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64] * 4,
+                     embedding_bag_size=2, mlp_bot=[13, 32, 8],
+                     mlp_top=[8 * 4 + 8, 32, 1])
+    fc = ff.FFConfig(batch_size=batch, mesh_shape=mesh_shape)
+    m = build_dlrm(cfg, fc, table_parallel=table_parallel)
+    return cfg, m
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self):
+        mesh = make_mesh({"data": 4, "model": 2})
+        assert mesh.shape == {"data": 4, "model": 2}
+        mesh = make_mesh()
+        assert mesh.shape == {"data": 8}
+
+    def test_pspec_translation(self):
+        mesh = make_mesh({"data": 4, "model": 2})
+        # pure DP: batch dim over data
+        pc = ParallelConfig.data_parallel(2, 8)
+        assert pspec_for_config(pc, 2, mesh) == P("data", None)
+        # channel-parallel last dim -> model
+        pc = ParallelConfig(dims=(1, 2))
+        assert pspec_for_config(pc, 2, mesh) == P(None, "model")
+        # hybrid 2-D
+        pc = ParallelConfig(dims=(4, 2))
+        assert pspec_for_config(pc, 2, mesh) == P("data", "model")
+        # reference innermost-first dims convert (sample last)
+        pc = ParallelConfig.from_reference_dims([2, 4])  # c=2, n=4
+        assert pc.dims == (4, 2)
+
+
+class TestDataParallelNumerics:
+    def test_mesh_matches_single_device(self):
+        """Sharded training must be numerically identical to single-device
+        (the reference guarantee: strategy changes never change results,
+        SURVEY §7 hard part (d))."""
+        loader = SyntheticDLRMLoader(64, 13, [64] * 4, 2, 32, seed=5)
+        inputs, labels = loader.peek()
+        losses = {}
+        for mode in ("single", "mesh"):
+            cfg, m = small_dlrm(batch=32)
+            if mode == "single":
+                m.compile(loss_type="mean_squared_error", metrics=(),
+                          mesh=False)
+            else:
+                m.compile(loss_type="mean_squared_error", metrics=(),
+                          mesh=make_mesh({"data": 8}))
+            state = m.init(seed=7)
+            state, mets = m.train_step(state, inputs, labels)
+            state, mets2 = m.train_step(state, inputs, labels)
+            losses[mode] = (float(mets["loss"]), float(mets2["loss"]))
+        np.testing.assert_allclose(losses["single"], losses["mesh"],
+                                   rtol=1e-5)
+
+
+class TestTableParallel:
+    def test_embedding_sharded_over_model_axis(self):
+        cfg, m = small_dlrm(batch=32, table_parallel=True)
+        mesh = make_mesh({"data": 2, "model": 4})
+        m.compile(loss_type="mean_squared_error", metrics=(), mesh=mesh)
+        state = m.init()
+        emb = state.params["emb"]["embedding"]
+        spec = emb.sharding.spec
+        assert spec[0] == "model", f"table axis not sharded: {spec}"
+        loader = SyntheticDLRMLoader(64, 13, cfg.embedding_size, 2, 32)
+        inputs, labels = loader.peek()
+        state, mets = m.train_step(state, inputs, labels)
+        assert np.isfinite(float(mets["loss"]))
+
+    def test_table_parallel_matches_replicated(self):
+        loader = SyntheticDLRMLoader(64, 13, [64] * 4, 2, 32, seed=9)
+        inputs, labels = loader.peek()
+        out = {}
+        for tp in (False, True):
+            cfg, m = small_dlrm(batch=32, table_parallel=tp)
+            mesh = make_mesh({"data": 2, "model": 4}) if tp else \
+                make_mesh({"data": 8})
+            m.compile(loss_type="mean_squared_error", metrics=(), mesh=mesh)
+            state = m.init(seed=3)
+            state, mets = m.train_step(state, inputs, labels)
+            out[tp] = float(mets["loss"])
+        np.testing.assert_allclose(out[False], out[True], rtol=1e-5)
+
+
+class TestTensorParallelLinear:
+    def test_tp_dense_weight_sharded_and_correct(self):
+        """Channel-parallel Linear (reference linear.cu num_par_c>1):
+        weight sharded over out-channel; numerics match replicated."""
+        x = np.random.default_rng(0).standard_normal((16, 32)).astype(np.float32)
+        y = np.random.default_rng(1).standard_normal((16, 8)).astype(np.float32)
+        results = {}
+        for tp in (False, True):
+            m = ff.FFModel(ff.FFConfig(batch_size=16))
+            t = m.create_tensor((16, 32), name="x")
+            h = m.dense(t, 64, activation="relu", name="fc1")
+            m.dense(h, 8, name="fc2")
+            if tp:
+                m.get_op("fc1").parallel_config = ParallelConfig(dims=(1, 4))
+            mesh = make_mesh({"data": 2, "model": 4})
+            m.compile(loss_type="mean_squared_error", metrics=(), mesh=mesh)
+            state = m.init(seed=11)
+            if tp:
+                spec = state.params["fc1"]["kernel"].sharding.spec
+                assert spec[1] == "model", spec
+            state, mets = m.train_step(state, {"x": x}, y)
+            results[tp] = float(mets["loss"])
+        np.testing.assert_allclose(results[False], results[True], rtol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_sdpa(self, causal):
+        rng = np.random.default_rng(0)
+        b, h, s, d = 2, 2, 32, 8
+        q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        mesh = make_mesh({"data": 2, "seq": 4})
+        out = ring_attention_sharded(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), mesh, causal=causal)
+        ref = sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                   causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_seq_parallel_mha_op(self):
+        """MultiHeadAttention(seq_parallel=True) must route through ring
+        attention and match the dense path."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 16, 32)).astype(np.float32)
+        outs = {}
+        for sp in (False, True):
+            m = ff.FFModel(ff.FFConfig(batch_size=4))
+            t = m.create_tensor((4, 16, 32), name="x")
+            m.multihead_attention(t, t, t, embed_dim=32, num_heads=4,
+                                  causal=True, seq_parallel=sp)
+            mesh = make_mesh({"data": 2, "seq": 4}) if sp else False
+            m.compile(loss_type="mean_squared_error", metrics=(), mesh=mesh)
+            state = m.init(seed=2)
+            outs[sp] = np.asarray(m.forward(state, {"x": x}))
+        np.testing.assert_allclose(outs[False], outs[True], atol=2e-5,
+                                   rtol=2e-5)
+
+
+class TestStrategyIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        s = ff.Strategy()
+        s["emb"] = ParallelConfig(dims=(1, 8, 1), device_ids=list(range(8)))
+        s["fc1"] = ParallelConfig(dims=(4, 2))
+        path = str(tmp_path / "strategy.json")
+        s.save(path)
+        s2 = ff.Strategy.load(path)
+        assert s2["emb"].dims == (1, 8, 1)
+        assert s2["fc1"].dims == (4, 2)
+        assert s2["emb"].device_ids == list(range(8))
+
+    def test_default_dp_fallback(self):
+        s = ff.Strategy()
+        pc = s.find("unknown_op", 3, 8)
+        assert pc.dims == (8, 1, 1)
