@@ -1,0 +1,100 @@
+"""Headline benchmark: DLRM synthetic training throughput (samples/s).
+
+Mirrors the reference's synthetic benchmark configuration
+(reference: examples/cpp/DLRM/run_random.sh — 8 tables x 1M rows,
+sparse-feature 64, MLP bot 64-512-512-64, top 576-1024-1024-1024-1,
+batch 256/GPU) and its timing protocol (dlrm.cc:154-198: warmup epoch,
+execution fence, wall-clock over the remaining epochs, THROUGHPUT print).
+
+The epoch runs as one on-device ``lax.scan`` (the analogue of Legion
+tracing with ``-dm:memoize``), so host dispatch is off the critical path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference repo publishes no numbers (BASELINE.md) — vs_baseline is
+computed against the last recorded value in bench_history.json when
+present, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    num_batches = int(os.environ.get("BENCH_BATCHES", 64))
+    epochs = int(os.environ.get("BENCH_EPOCHS", 3))
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+
+    cfg = DLRMConfig()  # run_random.sh architecture
+    cfg.embedding_size = [rows] * 8
+    ffconfig = ff.FFConfig(batch_size=batch)
+    model = build_dlrm(cfg, ffconfig)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy", "mean_squared_error"),
+                  mesh=False if jax.device_count() == 1 else None)
+    state = model.init(seed=0)
+
+    rng = np.random.default_rng(0)
+    inputs = {
+        "dense": rng.standard_normal(
+            (num_batches, batch, cfg.mlp_bot[0])).astype(np.float32),
+        "sparse": rng.integers(
+            0, rows, size=(num_batches, batch, 8, cfg.embedding_bag_size),
+            dtype=np.int64),
+    }
+    labels = rng.integers(0, 2,
+                          size=(num_batches, batch, 1)).astype(np.float32)
+
+    # warmup epoch = compile (reference runs epoch 0 untimed, dlrm.cc:178)
+    state, _ = model.train_epoch(state, inputs, labels)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        state, mets = model.train_epoch(state, inputs, labels)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0
+
+    samples = epochs * num_batches * batch
+    thpt = samples / elapsed
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_history.json")
+    vs = 1.0
+    prev = None
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        if hist:
+            prev = hist[-1]["value"]
+            vs = thpt / prev
+    except (OSError, ValueError):
+        hist = []
+    hist.append({"ts": time.time(), "value": thpt,
+                 "batch": batch, "num_batches": num_batches,
+                 "epochs": epochs, "rows": rows})
+    try:
+        with open(hist_path, "w") as f:
+            json.dump(hist, f, indent=1)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": "dlrm_synthetic_samples_per_sec",
+        "value": round(thpt, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

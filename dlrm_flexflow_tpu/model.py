@@ -391,8 +391,29 @@ class FFModel:
                                     bn_state=bn_state or {})
             return values[final_uid]
 
+        def train_epoch(state: TrainState, inputs, labels):
+            """Scan a whole epoch on device — one dispatch for nb steps.
+
+            The TPU analogue of Legion tracing around the iteration body
+            (reference dlrm.cc:178-185 begin_trace/end_trace): the repeated
+            step is captured once and replayed without per-step host
+            dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
+            batches resident on device; ``labels``: (nb, batch, ...).
+            """
+            def body(st, batch):
+                binputs, blabels = batch
+                new_st, mets = train_step(st, binputs, blabels)
+                return new_st, mets
+
+            state, mets = jax.lax.scan(body, state, (inputs, labels))
+            # fold per-step metrics into epoch sums (loss: mean)
+            folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
+                      for k, v in mets.items()}
+            return state, folded
+
         donate = (0,) if donate_state else ()
         self._train_step = jax.jit(train_step, donate_argnums=donate)
+        self._train_epoch = jax.jit(train_epoch, donate_argnums=donate)
         self._eval_step = jax.jit(eval_step)
         self._forward_fn = jax.jit(forward)
         return self
@@ -488,6 +509,26 @@ class FFModel:
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
         labels = self.shard_batch(labels)
         return self._train_step(state, inputs, labels)
+
+    def train_epoch(self, state: TrainState, inputs: Dict[str, Any], labels):
+        """Run all batches in one on-device scan.  ``inputs`` arrays have a
+        leading (num_batches, batch, ...) layout; they are placed with the
+        batch dim (axis 1) on the data axis."""
+        def place(arr):
+            if self.mesh is None:
+                return jnp.asarray(arr)
+            from jax.sharding import PartitionSpec
+            dsize = self.mesh.shape.get(DATA_AXIS, 1)
+            if dsize > 1 and arr.shape[1] % dsize == 0:
+                spec = PartitionSpec(None, DATA_AXIS,
+                                     *([None] * (arr.ndim - 2)))
+            else:
+                spec = PartitionSpec(*([None] * arr.ndim))
+            return jax.device_put(arr, sharding(self.mesh, spec))
+
+        inputs = {k: place(v) for k, v in inputs.items()}
+        labels = place(labels)
+        return self._train_epoch(state, inputs, labels)
 
     def eval_step(self, state: TrainState, inputs, labels):
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
