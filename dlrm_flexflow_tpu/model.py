@@ -333,6 +333,16 @@ class FFModel:
             self.strategy = strategy
         if self.config.import_strategy_file:
             self.strategy = Strategy.load(self.config.import_strategy_file)
+        elif self.config.search_budget > 0 and not self.strategy.configs:
+            # SOAP search at compile time (reference model.cc:1010-1016
+            # STRATEGY_SEARCH task -> FFModel::optimize)
+            from .sim.search import mcmc_search
+            n = self.config.resolved_num_devices()
+            self.strategy = mcmc_search(
+                self, n, budget=self.config.search_budget,
+                alpha=self.config.search_alpha, verbose=True)
+            if self.config.export_strategy_file:
+                self.strategy.save(self.config.export_strategy_file)
         for op in self.layers:
             if op.name in self.strategy:
                 op.parallel_config = self.strategy[op.name]

@@ -1,0 +1,5 @@
+from .cost_model import CostModel, TPUMachineModel
+from .simulator import Simulator
+from .search import mcmc_search
+
+__all__ = ["CostModel", "TPUMachineModel", "Simulator", "mcmc_search"]

@@ -1,0 +1,197 @@
+"""Per-op cost measurement and the TPU machine model.
+
+TPU-native equivalent of the reference's simulator measurement layer
+(reference: src/runtime/simulator.cu:21-76 — device/link graph with
+hard-coded bandwidths (inter-GPU 20 MB/ms, inter-node 12 MB/ms / nodes,
+GPU<->DRAM 16 MB/ms, simulator.cu:27-29); memoized real-kernel timing
+``measure_op_forward/backward_time`` simulator.cc:235-273 calling each op's
+``measure_compute_time`` e.g. linear.cu:973-1049).
+
+Two cost sources, both memoized:
+  * measured  — jit-compile the op's forward/backward on the real device
+                and wall-clock it (the reference's approach);
+  * analytic  — roofline estimate max(FLOPs/peak, bytes/HBM-bw), used on
+                CPU test meshes and as a fast fallback.
+
+The machine model replaces the GPU constants with TPU numbers: per-chip
+HBM bandwidth, MXU peak, ICI link bandwidth (bidirectional ring per mesh
+axis), and DCN bandwidth for multi-host hops.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class TPUMachineModel:
+    """TPU chip/interconnect constants (defaults ~ v5e).
+
+    Replaces reference simulator.cu:27-29.  All bandwidths bytes/sec,
+    compute FLOP/sec.
+    """
+
+    name: str = "tpu-v5e"
+    peak_flops_bf16: float = 197e12
+    peak_flops_f32: float = 49e12
+    hbm_bandwidth: float = 819e9
+    hbm_bytes: float = 16e9
+    ici_bandwidth: float = 45e9       # per link per direction
+    ici_links_per_chip: int = 4
+    dcn_bandwidth: float = 12.5e9     # per host
+    kernel_launch_overhead: float = 2e-6  # fused-step dispatch amortized
+
+    def matmul_time(self, flops: float, dtype: str = "bfloat16") -> float:
+        peak = (self.peak_flops_bf16 if dtype in ("bfloat16", "bf16")
+                else self.peak_flops_f32)
+        # MXU utilisation falls off for small ops; simple 60% efficiency
+        return flops / (0.6 * peak)
+
+    def memory_time(self, bytes_moved: float) -> float:
+        return bytes_moved / self.hbm_bandwidth
+
+    def ici_time(self, bytes_moved: float, hops: int = 1) -> float:
+        """One neighbour transfer on the ICI ring (per-axis bidirectional)."""
+        return hops * bytes_moved / self.ici_bandwidth
+
+    def all_reduce_time(self, bytes_per_chip: float, n: int) -> float:
+        """Ring all-reduce: 2(n-1)/n * bytes over one ICI link."""
+        if n <= 1:
+            return 0.0
+        return self.ici_time(2.0 * (n - 1) / n * bytes_per_chip)
+
+    def all_gather_time(self, bytes_per_chip: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return self.ici_time((n - 1) / n * bytes_per_chip * n)
+
+    def all_to_all_time(self, bytes_per_chip: float, n: int) -> float:
+        """All-to-all over the ring: each chip sends (n-1)/n of its shard."""
+        if n <= 1:
+            return 0.0
+        return self.ici_time(bytes_per_chip * (n - 1) / n)
+
+    def dcn_time(self, bytes_moved: float) -> float:
+        return bytes_moved / self.dcn_bandwidth
+
+
+class CostModel:
+    """Memoized per-op timing (reference simulator.cc:235-273).
+
+    ``measure=True`` wall-clocks the op's jitted forward and backward on the
+    current default JAX device; otherwise analytic roofline from op.flops()
+    and tensor byte counts.
+    """
+
+    def __init__(self, machine: Optional[TPUMachineModel] = None,
+                 measure: bool = False, measure_iters: int = 5):
+        self.machine = machine or TPUMachineModel()
+        self.measure = measure
+        self.measure_iters = measure_iters
+        self._cache: Dict[Tuple, Tuple[float, float]] = {}
+
+    # ---- helpers -----------------------------------------------------------
+    @staticmethod
+    def _op_key(op, num_parts: int) -> Tuple:
+        import jax.numpy as jnp
+
+        return (type(op).__name__,
+                tuple(t.shape for t in op.inputs),
+                tuple(t.shape for t in op.outputs),
+                tuple((s.param_name, s.shape) for s in op.param_specs()),
+                num_parts)
+
+    def op_times(self, op, num_parts: int = 1) -> Tuple[float, float]:
+        """Return (forward_s, backward_s) for one partition of the op when
+        its output is split into ``num_parts`` equal parts."""
+        key = self._op_key(op, num_parts)
+        if key in self._cache:
+            return self._cache[key]
+        if self.measure:
+            try:
+                fwd, bwd = self._measure_op(op, num_parts)
+            except Exception:
+                fwd, bwd = self._analytic_op(op, num_parts)
+        else:
+            fwd, bwd = self._analytic_op(op, num_parts)
+        self._cache[key] = (fwd, bwd)
+        return fwd, bwd
+
+    # ---- analytic ----------------------------------------------------------
+    def _analytic_op(self, op, num_parts: int) -> Tuple[float, float]:
+        m = self.machine
+        batch = op.outputs[0].shape[0] if op.outputs[0].ndim else 1
+        flops = op.flops(batch) / max(num_parts, 1)
+        in_bytes = sum(4 * t.numel() for t in op.inputs) / max(num_parts, 1)
+        out_bytes = sum(4 * t.numel() for t in op.outputs) / max(num_parts, 1)
+        w_bytes = sum(4 * int(np.prod(s.shape)) for s in op.param_specs())
+        fwd = max(m.matmul_time(flops),
+                  m.memory_time(in_bytes + out_bytes + w_bytes))
+        fwd += m.kernel_launch_overhead
+        # backward ~ 2x forward FLOPs (dgrad+wgrad), same traffic + grads
+        bwd = max(m.matmul_time(2 * flops),
+                  m.memory_time(2 * (in_bytes + out_bytes) + 2 * w_bytes))
+        bwd += m.kernel_launch_overhead
+        return fwd, bwd
+
+    # ---- measured ----------------------------------------------------------
+    def _measure_op(self, op, num_parts: int) -> Tuple[float, float]:
+        """Time the real op kernels under jit (reference runs the real CUDA
+        kernels on simulator scratch, linear.cu:973-1049)."""
+        import jax
+        import jax.numpy as jnp
+
+        def part_shape(shape):
+            if not shape:
+                return shape
+            b = max(shape[0] // num_parts, 1)
+            return (b,) + tuple(shape[1:])
+
+        rng = np.random.default_rng(0)
+        xs = []
+        for t in op.inputs:
+            shp = part_shape(t.shape)
+            if "int" in str(np.dtype(t.dtype)):
+                hi = getattr(op, "num_entries", 2)
+                xs.append(jnp.asarray(rng.integers(0, hi, size=shp),
+                                      dtype=t.dtype))
+            else:
+                xs.append(jnp.asarray(
+                    rng.standard_normal(shp).astype(np.float32)))
+        params = op.init_params(jax.random.PRNGKey(0))
+
+        def fwd_fn(params, xs):
+            return op.forward(params, list(xs), training=False)[0]
+
+        jfwd = jax.jit(fwd_fn)
+
+        def loss_fn(params, xs):
+            outs = op.forward(params, list(xs), training=False)
+            return sum(jnp.sum(o * o) for o in outs
+                       if jnp.issubdtype(o.dtype, jnp.floating))
+
+        diff_x = [i for i, t in enumerate(op.inputs)
+                  if not np.issubdtype(np.dtype(t.dtype), np.integer)]
+
+        def bwd_fn(params, xs):
+            grads = jax.grad(loss_fn, argnums=0)(params, xs)
+            return grads
+
+        jbwd = jax.jit(bwd_fn)
+
+        def timeit(f, *args):
+            out = f(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_iters):
+                out = f(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / self.measure_iters
+
+        fwd = timeit(jfwd, params, xs)
+        bwd = timeit(jbwd, params, xs) if params else fwd
+        return fwd, bwd

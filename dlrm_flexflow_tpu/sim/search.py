@@ -1,0 +1,123 @@
+"""MCMC strategy search (simulated annealing over per-op ParallelConfigs).
+
+TPU-native equivalent of the reference search
+(reference: ``FFModel::optimize`` model.cc:1093-1144 — start from
+data-parallel, random single-op rewrite, accept with prob
+``exp(-alpha * delta)``, budget iterations, keep best;
+``FFModel::rewrite`` model.cc:1082-1091;
+``Op::get_random_parallel_config`` model.cc:295-324 which samples a random
+legal factorization of the device count over the op's output dims).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, List, Optional
+
+from ..parallel.parallel_config import ParallelConfig, Strategy
+from .simulator import Simulator
+
+
+def _factorizations(n: int, ndim: int) -> List[tuple]:
+    """All ways to write n as an ordered product of ndim factors."""
+    if ndim == 1:
+        return [(n,)]
+    out = []
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, ndim - 1):
+                out.append((d,) + rest)
+    return out
+
+
+def legal_configs(op, num_devices: int,
+                  max_dims: Optional[int] = None) -> List[ParallelConfig]:
+    """Candidate ParallelConfigs for an op (reference model.cc:295-324
+    samples one; we enumerate to give the chain a uniform proposal set).
+
+    Legality: every partition count must divide the corresponding output
+    dim; device counts are divisors of num_devices.
+    """
+    shape = op.outputs[0].shape
+    ndim = len(shape)
+    if max_dims is not None:
+        ndim = min(ndim, max_dims)
+    cands = []
+    n = 1
+    divisors = [d for d in range(1, num_devices + 1) if num_devices % d == 0]
+    seen = set()
+    for n in divisors:
+        for dims in _factorizations(n, ndim):
+            full = dims + (1,) * (len(shape) - ndim)
+            if any(s % d != 0 or d > s for s, d in zip(shape, full)):
+                continue
+            if full in seen:
+                continue
+            seen.add(full)
+            cands.append(ParallelConfig(
+                dims=full, device_ids=list(range(n))))
+    return cands
+
+
+def mcmc_search(model, num_devices: int, budget: int = 1000,
+                alpha: float = 0.05,
+                simulator: Optional[Simulator] = None,
+                seed: int = 0,
+                verbose: bool = False,
+                on_iteration: Optional[Callable] = None) -> Strategy:
+    """Simulated-annealing search (reference model.cc:1093-1144).
+
+    Returns the best Strategy found; ``model.strategy`` is not mutated.
+    """
+    rng = random.Random(seed)
+    sim = simulator or Simulator(model, num_devices)
+
+    # start from data-parallel (reference model.cc:1102)
+    current = Strategy()
+    for op in model.layers:
+        current[op.name] = ParallelConfig.data_parallel(
+            op.outputs[0].ndim, num_devices)
+        # fall back to no partitioning when batch doesn't divide
+        if op.outputs[0].shape[0] % num_devices != 0:
+            current[op.name] = ParallelConfig(
+                dims=(1,) * op.outputs[0].ndim, device_ids=[0])
+
+    candidates = {op.name: legal_configs(op, num_devices)
+                  for op in model.layers}
+    ops = [op for op in model.layers if len(candidates[op.name]) > 1]
+
+    def copy_strategy(s: Strategy) -> Strategy:
+        out = Strategy()
+        out.configs = dict(s.configs)
+        return out
+
+    current_time = sim.simulate(current)
+    best, best_time = copy_strategy(current), current_time
+    if verbose:
+        print(f"[search] start (data-parallel): {current_time*1e3:.3f} ms")
+
+    for it in range(budget):
+        if not ops:
+            break
+        # random single-op rewrite (reference rewrite, model.cc:1082-1091)
+        op = rng.choice(ops)
+        prev_pc = current.configs[op.name]
+        new_pc = rng.choice(candidates[op.name])
+        current.configs[op.name] = new_pc
+        t = sim.simulate(current)
+        delta = t - current_time
+        if delta <= 0 or rng.random() < math.exp(-alpha * delta * 1e3):
+            current_time = t  # accept
+            if t < best_time:
+                best, best_time = copy_strategy(current), t
+                if verbose:
+                    print(f"[search] it {it}: best {t*1e3:.3f} ms "
+                          f"({op.name} -> {new_pc.dims})")
+        else:
+            current.configs[op.name] = prev_pc  # reject
+        if on_iteration is not None:
+            on_iteration(it, current_time, best_time)
+
+    best.best_simulated_time = best_time
+    return best
