@@ -1,0 +1,66 @@
+"""AlexNet application.
+
+TPU-native equivalent of reference examples/cpp/AlexNet/alexnet.cc
+(graph at alexnet.cc:54-88: conv 64/11x11/s4/p2 + relu, pool 3x3/s2,
+conv 192/5x5/p2, pool, conv 384/3x3/p1, conv 256/3x3/p1, conv 256/3x3/p1,
+pool, flat, dense 4096 relu x2, dense 10, softmax; SGD lr 0.001,
+sparse-CCE loss, accuracy + sparse-CCE metrics; input (B, 3, 229, 229)).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..optim import SGDOptimizer
+
+
+def build_alexnet(ffconfig: Optional[FFConfig] = None,
+                  num_classes: int = 10, image_size: int = 229) -> FFModel:
+    ffconfig = ffconfig or FFConfig()
+    model = FFModel(ffconfig)
+    b = ffconfig.batch_size
+    x = model.create_tensor((b, 3, image_size, image_size), "float32",
+                            name="input")
+    t = model.conv2d(x, 64, 11, 11, 4, 4, 2, 2, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 4096, activation="relu")
+    t = model.dense(t, 4096, activation="relu")
+    t = model.dense(t, num_classes)
+    model.softmax(t)
+    return model
+
+
+def run(argv: Sequence[str] = ()):  # pragma: no cover - CLI
+    ffconfig = FFConfig.parse_args(argv)
+    model = build_alexnet(ffconfig)
+    model.compile(optimizer=SGDOptimizer(lr=0.001),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=("accuracy", "sparse_categorical_crossentropy"))
+    state = model.init()
+    from ..data.loader import ArrayDataLoader
+
+    n = 4 * ffconfig.batch_size
+    rng = np.random.default_rng(0)
+    loader = ArrayDataLoader(
+        {"input": rng.standard_normal((n, 3, 229, 229)).astype(np.float32)},
+        rng.integers(0, 10, size=(n, 1)).astype(np.int32),
+        ffconfig.batch_size)
+    state, thpt = model.fit(state, loader, epochs=ffconfig.epochs)
+    return thpt
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    run(sys.argv[1:])

@@ -1,0 +1,135 @@
+"""InceptionV3 application.
+
+TPU-native equivalent of reference examples/cpp/InceptionV3/inception.cc
+(InceptionA inception.cc:26-41, B :43-54, C :56-73, D :75-88, E :90-108;
+stem + block sequence inception.cc:152-174; input (B, 3, 299, 299),
+avg-pool 8x8, flat, dense 10, softmax; SGD 0.001 + sparse-CCE).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..optim import SGDOptimizer
+
+
+def inception_a(m: FFModel, x, pool_features: int):
+    t1 = m.conv2d(x, 64, 1, 1, 1, 1, 0, 0, activation="relu")
+    t2 = m.conv2d(x, 48, 1, 1, 1, 1, 0, 0, activation="relu")
+    t2 = m.conv2d(t2, 64, 5, 5, 1, 1, 2, 2, activation="relu")
+    t3 = m.conv2d(x, 64, 1, 1, 1, 1, 0, 0, activation="relu")
+    t3 = m.conv2d(t3, 96, 3, 3, 1, 1, 1, 1, activation="relu")
+    t3 = m.conv2d(t3, 96, 3, 3, 1, 1, 1, 1, activation="relu")
+    t4 = m.pool2d(x, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    t4 = m.conv2d(t4, pool_features, 1, 1, 1, 1, 0, 0, activation="relu")
+    return m.concat([t1, t2, t3, t4], axis=1)
+
+
+def inception_b(m: FFModel, x):
+    t1 = m.conv2d(x, 384, 3, 3, 2, 2, 0, 0)
+    t2 = m.conv2d(x, 64, 1, 1, 1, 1, 0, 0)
+    t2 = m.conv2d(t2, 96, 3, 3, 1, 1, 1, 1)
+    t2 = m.conv2d(t2, 96, 3, 3, 2, 2, 0, 0)
+    t3 = m.pool2d(x, 3, 3, 2, 2, 0, 0)
+    return m.concat([t1, t2, t3], axis=1)
+
+
+def inception_c(m: FFModel, x, channels: int):
+    t1 = m.conv2d(x, 192, 1, 1, 1, 1, 0, 0)
+    t2 = m.conv2d(x, channels, 1, 1, 1, 1, 0, 0)
+    t2 = m.conv2d(t2, channels, 1, 7, 1, 1, 0, 3)
+    t2 = m.conv2d(t2, 192, 7, 1, 1, 1, 3, 0)
+    t3 = m.conv2d(x, channels, 1, 1, 1, 1, 0, 0)
+    t3 = m.conv2d(t3, channels, 7, 1, 1, 1, 3, 0)
+    t3 = m.conv2d(t3, channels, 1, 7, 1, 1, 0, 3)
+    t3 = m.conv2d(t3, channels, 7, 1, 1, 1, 3, 0)
+    t3 = m.conv2d(t3, 192, 1, 7, 1, 1, 0, 3)
+    t4 = m.pool2d(x, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    t4 = m.conv2d(t4, 192, 1, 1, 1, 1, 0, 0)
+    return m.concat([t1, t2, t3, t4], axis=1)
+
+
+def inception_d(m: FFModel, x):
+    t1 = m.conv2d(x, 192, 1, 1, 1, 1, 0, 0)
+    t1 = m.conv2d(t1, 320, 3, 3, 2, 2, 0, 0)
+    t2 = m.conv2d(x, 192, 1, 1, 1, 1, 0, 0)
+    t2 = m.conv2d(t2, 192, 1, 7, 1, 1, 0, 3)
+    t2 = m.conv2d(t2, 192, 7, 1, 1, 1, 3, 0)
+    t2 = m.conv2d(t2, 192, 3, 3, 2, 2, 0, 0)
+    t3 = m.pool2d(x, 3, 3, 2, 2, 0, 0)
+    return m.concat([t1, t2, t3], axis=1)
+
+
+def inception_e(m: FFModel, x):
+    t1 = m.conv2d(x, 320, 1, 1, 1, 1, 0, 0)
+    t2i = m.conv2d(x, 384, 1, 1, 1, 1, 0, 0)
+    t2 = m.conv2d(t2i, 384, 1, 3, 1, 1, 0, 1)
+    t3 = m.conv2d(t2i, 384, 3, 1, 1, 1, 1, 0)
+    t3i = m.conv2d(x, 448, 1, 1, 1, 1, 0, 0)
+    t3i = m.conv2d(t3i, 384, 3, 3, 1, 1, 1, 1)
+    t4 = m.conv2d(t3i, 384, 1, 3, 1, 1, 0, 1)
+    t5 = m.conv2d(t3i, 384, 3, 1, 1, 1, 1, 0)
+    t6 = m.pool2d(x, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    t6 = m.conv2d(t6, 192, 1, 1, 1, 1, 0, 0)
+    return m.concat([t1, t2, t3, t4, t5, t6], axis=1)
+
+
+def build_inception(ffconfig: Optional[FFConfig] = None,
+                    num_classes: int = 10, image_size: int = 299) -> FFModel:
+    ffconfig = ffconfig or FFConfig()
+    m = FFModel(ffconfig)
+    b = ffconfig.batch_size
+    x = m.create_tensor((b, 3, image_size, image_size), "float32",
+                        name="input")
+    t = m.conv2d(x, 32, 3, 3, 2, 2, 0, 0, activation="relu")
+    t = m.conv2d(t, 32, 3, 3, 1, 1, 0, 0, activation="relu")
+    t = m.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = m.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = m.conv2d(t, 80, 1, 1, 1, 1, 0, 0, activation="relu")
+    t = m.conv2d(t, 192, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = m.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = inception_a(m, t, 32)
+    t = inception_a(m, t, 64)
+    t = inception_a(m, t, 64)
+    t = inception_b(m, t)
+    t = inception_c(m, t, 128)
+    t = inception_c(m, t, 160)
+    t = inception_c(m, t, 160)
+    t = inception_c(m, t, 192)
+    t = inception_d(m, t)
+    t = inception_e(m, t)
+    t = inception_e(m, t)
+    t = m.pool2d(t, 8, 8, 1, 1, 0, 0, pool_type="avg")
+    t = m.flat(t)
+    t = m.dense(t, num_classes)
+    m.softmax(t)
+    return m
+
+
+def run(argv: Sequence[str] = ()):  # pragma: no cover - CLI
+    ffconfig = FFConfig.parse_args(argv)
+    model = build_inception(ffconfig)
+    model.compile(optimizer=SGDOptimizer(lr=0.001),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=("accuracy", "sparse_categorical_crossentropy"))
+    state = model.init()
+    from ..data.loader import ArrayDataLoader
+
+    n = 2 * ffconfig.batch_size
+    rng = np.random.default_rng(0)
+    loader = ArrayDataLoader(
+        {"input": rng.standard_normal((n, 3, 299, 299)).astype(np.float32)},
+        rng.integers(0, 10, size=(n, 1)).astype(np.int32),
+        ffconfig.batch_size)
+    state, thpt = model.fit(state, loader, epochs=ffconfig.epochs)
+    return thpt
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    run(sys.argv[1:])

@@ -1,0 +1,77 @@
+"""ResNet-50 application (bottleneck blocks with residual adds).
+
+TPU-native equivalent of reference examples/cpp/ResNet/resnet.cc
+(BottleneckBlock resnet.cc:34-55: 1x1 conv, 3x3 stride conv, 1x1 4x conv,
+projection shortcut when stride>1 or channels change, ff.add + relu;
+stem conv 64/7x7/s2/p3 + pool resnet.cc:89-91; stages 3/4/6/3 at
+64/128/256/512 resnet.cc:93-106; avg-pool 7x7, flat, dense 10, softmax).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..optim import SGDOptimizer
+
+
+def bottleneck_block(model: FFModel, t, out_channels: int, stride: int):
+    inp = t
+    in_channels = t.shape[1]
+    t = model.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0)
+    t = model.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1)
+    t = model.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0)
+    if stride > 1 or in_channels != 4 * out_channels:
+        inp = model.conv2d(inp, 4 * out_channels, 1, 1, stride, stride, 0, 0)
+    t = model.add(inp, t)
+    return model.relu(t)
+
+
+def build_resnet(ffconfig: Optional[FFConfig] = None,
+                 num_classes: int = 10, image_size: int = 224,
+                 stages=(3, 4, 6, 3)) -> FFModel:
+    ffconfig = ffconfig or FFConfig()
+    model = FFModel(ffconfig)
+    b = ffconfig.batch_size
+    x = model.create_tensor((b, 3, image_size, image_size), "float32",
+                            name="input")
+    t = model.conv2d(x, 64, 7, 7, 2, 2, 3, 3)
+    t = model.pool2d(t, 3, 3, 2, 2, 1, 1)
+    widths = (64, 128, 256, 512)
+    for si, (n_blocks, w) in enumerate(zip(stages, widths)):
+        for i in range(n_blocks):
+            stride = 2 if (si > 0 and i == 0) else 1
+            t = bottleneck_block(model, t, w, stride)
+    t = model.pool2d(t, t.shape[2], t.shape[3], 1, 1, 0, 0, pool_type="avg")
+    t = model.flat(t)
+    t = model.dense(t, num_classes)
+    model.softmax(t)
+    return model
+
+
+def run(argv: Sequence[str] = ()):  # pragma: no cover - CLI
+    ffconfig = FFConfig.parse_args(argv)
+    model = build_resnet(ffconfig)
+    model.compile(optimizer=SGDOptimizer(lr=0.001),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=("accuracy", "sparse_categorical_crossentropy"))
+    state = model.init()
+    from ..data.loader import ArrayDataLoader
+
+    n = 2 * ffconfig.batch_size
+    rng = np.random.default_rng(0)
+    loader = ArrayDataLoader(
+        {"input": rng.standard_normal((n, 3, 224, 224)).astype(np.float32)},
+        rng.integers(0, 10, size=(n, 1)).astype(np.int32),
+        ffconfig.batch_size)
+    state, thpt = model.fit(state, loader, epochs=ffconfig.epochs)
+    return thpt
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    run(sys.argv[1:])

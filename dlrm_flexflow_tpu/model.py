@@ -194,6 +194,18 @@ class FFModel:
                          trans_b, self._op_compute_dtype())
         return self._add(op)
 
+    def lstm(self, input_tensor, hidden_dim, return_sequences=True,
+             reverse=False, initial_state=None, return_state=False,
+             name=None):
+        from .ops.rnn import LSTM
+        op = LSTM(self._name("lstm", name), input_tensor, hidden_dim,
+                  return_sequences, reverse, initial_state=initial_state,
+                  return_state=return_state)
+        self.layers.append(op)
+        if return_state:
+            return op.outputs
+        return op.outputs[0]
+
     def dropout(self, input_tensor, rate=0.5, seed=0, name=None):
         op = Dropout(self._name("dropout", name), input_tensor, rate, seed)
         return self._add(op)

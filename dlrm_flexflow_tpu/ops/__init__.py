@@ -9,6 +9,7 @@ from .shape_ops import (BatchMatmul, Concat, Flat, Reshape, Reverse, Split,
 from .conv import BatchNorm, Conv2D, Pool2D
 from .softmax import Dropout, Softmax
 from .attention import MultiHeadAttention, sdpa
+from .rnn import LSTM
 
 __all__ = [
     "Op", "activation_fn", "matmul",
@@ -18,4 +19,5 @@ __all__ = [
     "BatchNorm", "Conv2D", "Pool2D",
     "Dropout", "Softmax",
     "MultiHeadAttention", "sdpa",
+    "LSTM",
 ]

@@ -1,0 +1,118 @@
+"""LSTM operator (for the NMT application).
+
+TPU-native equivalent of the reference's cuDNN LSTM
+(reference: nmt/lstm.cu — cuDNN RNN descriptors lstm.cu:160-187, forward
+lstm.cu:323, backward lstm.cu:489-498; weights packed in one region as
+cuDNN does; the reference splits long sequences into per-device timestep
+blocks, nmt/rnn.h:22 LSTM_PER_NODE_LENGTH).
+
+Here the recurrence is a ``lax.scan`` over time — XLA compiles it into a
+single fused loop with the four gate matmuls batched into one MXU call
+(weights concatenated, the standard JAX LSTM layout).  Sequence-axis
+device placement (the reference's attribute-parallel trick) is subsumed by
+the framework's per-op ParallelConfig on the time dimension.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import DEFAULT_KERNEL_INIT, ZeroInitializer
+from ..tensor import ParameterSpec
+from .base import Op
+
+
+class LSTM(Op):
+    """Single-layer LSTM: (B, T, I) -> (B, T, H).
+
+    ``return_sequences=False`` yields only the final hidden state (B, H).
+    Initial state is zeros (matching the reference's init, lstm.cu).
+    """
+
+    op_type = "LSTM"
+
+    def __init__(self, name, input_tensor, hidden_dim: int,
+                 return_sequences: bool = True, reverse: bool = False,
+                 kernel_initializer=None, initial_state=None,
+                 return_state: bool = False):
+        inputs = [input_tensor]
+        if initial_state is not None:
+            h0, c0 = initial_state
+            inputs += [h0, c0]
+        super().__init__(name, inputs)
+        b, t, i = input_tensor.shape
+        self.hidden_dim = int(hidden_dim)
+        self.input_dim = i
+        self.seq_len = t
+        self.return_sequences = return_sequences
+        self.return_state = return_state
+        self.has_initial_state = initial_state is not None
+        self.reverse = reverse
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT
+        out_shape = (b, t, hidden_dim) if return_sequences else (b, hidden_dim)
+        self.outputs = [self._make_output(out_shape, input_tensor.dtype)]
+        if return_state:
+            self.outputs.append(self._make_output((b, hidden_dim),
+                                                  input_tensor.dtype, idx=1))
+            self.outputs.append(self._make_output((b, hidden_dim),
+                                                  input_tensor.dtype, idx=2))
+
+    def param_specs(self):
+        h, i = self.hidden_dim, self.input_dim
+        # gate order (i, f, g, o), concatenated for one fused matmul
+        return [
+            ParameterSpec(self.name, "wx", (i, 4 * h),
+                          initializer=self.kernel_initializer, sharded_dim=1),
+            ParameterSpec(self.name, "wh", (h, 4 * h),
+                          initializer=self.kernel_initializer, sharded_dim=1),
+            ParameterSpec(self.name, "bias", (4 * h,),
+                          initializer=ZeroInitializer(), sharded_dim=0),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        x = xs[0]  # (B, T, I)
+        init = (xs[1], xs[2]) if self.has_initial_state else None
+        h_dim = self.hidden_dim
+        wx, wh, bias = params["wx"], params["wh"], params["bias"]
+        b = x.shape[0]
+
+        if self.reverse:
+            x = jnp.flip(x, axis=1)
+
+        # hoist the input projection out of the scan: one big (B*T, I)x(I,4H)
+        # MXU matmul instead of T small ones
+        x_proj = jnp.einsum("bti,ij->btj", x, wx,
+                            preferred_element_type=jnp.float32) + bias
+
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + h @ wh
+            i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
+            i_g = jax.nn.sigmoid(i_g)
+            f_g = jax.nn.sigmoid(f_g)
+            g_g = jnp.tanh(g_g)
+            o_g = jax.nn.sigmoid(o_g)
+            c = f_g * c + i_g * g_g
+            h = o_g * jnp.tanh(c)
+            return (h, c), h
+
+        if init is not None:
+            h0, c0 = init
+        else:
+            h0 = jnp.zeros((b, h_dim), x.dtype)
+            c0 = jnp.zeros((b, h_dim), x.dtype)
+        (h_f, c_f), hs = jax.lax.scan(step, (h0, c0),
+                                      jnp.swapaxes(x_proj, 0, 1))  # (T, B, H)
+        hs = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
+        if self.reverse:
+            hs = jnp.flip(hs, axis=1)
+        dt = self.outputs[0].dtype
+        out = hs.astype(dt) if self.return_sequences else hs[:, -1].astype(dt)
+        if self.return_state:
+            return [out, h_f.astype(dt), c_f.astype(dt)]
+        return [out]
+
+    def flops(self, batch):
+        t, i, h = self.seq_len, self.input_dim, self.hidden_dim
+        return 2 * batch * t * (i * 4 * h + h * 4 * h)
