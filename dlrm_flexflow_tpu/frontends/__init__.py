@@ -1,0 +1,2 @@
+"""Frontends: Keras-compatible API, PyTorch fx importer, ONNX importer
+(TPU-native equivalents of reference python/flexflow/{keras,torch,onnx})."""

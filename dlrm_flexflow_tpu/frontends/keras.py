@@ -1,0 +1,360 @@
+"""Keras-compatible frontend: Sequential and functional Model.
+
+TPU-native equivalent of the reference Keras frontend
+(reference: python/flexflow/keras/ — BaseModel/Sequential/functional Model
+keras/models/base_model.py:30-509, model.py:54 (BFS over the layer DAG at
+compile); layer classes keras/layers/: Dense, Flatten, Embedding,
+Activation, Dropout, Reshape, Conv2D, Concatenate, Add, Subtract,
+Multiply, BatchNormalization, MaxPooling2D, AveragePooling2D; optimizer/
+loss/metric string resolution; fit/evaluate driving the dataloader loop
+base_model.py:367+).
+
+Layers here are thin declarative records; ``compile`` lowers the DAG onto
+an FFModel graph (the same lowering the reference does by calling the C++
+factories) and defers execution to the core jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel, TrainState
+from ..optim import AdamOptimizer, Optimizer, SGDOptimizer
+from ..data.loader import ArrayDataLoader
+
+# --------------------------------------------------------------------- layers
+
+
+class Layer:
+    """Declarative layer node; ``lower(model, inputs)`` emits core ops."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self._inbound: List["Layer"] = []
+        self._node: Optional[object] = None  # symbolic KTensor
+
+    def __call__(self, *inputs):
+        ins = []
+        for i in inputs:
+            ins.extend(i if isinstance(i, (list, tuple)) else [i])
+        out = KTensor(self, ins)
+        return out
+
+    def lower(self, model: FFModel, xs):
+        raise NotImplementedError
+
+    def output_steps(self):  # number of core tensors produced
+        return 1
+
+
+class KTensor:
+    """Symbolic output of a keras layer call (functional API edge)."""
+
+    def __init__(self, layer: Layer, inputs: List["KTensor"]):
+        self.layer = layer
+        self.inputs = inputs
+
+
+class Input(Layer):
+    def __init__(self, shape: Tuple[int, ...], dtype="float32",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.shape = tuple(shape)  # per-sample shape (no batch dim)
+        self.dtype = dtype
+
+    def __call__(self):
+        return KTensor(self, [])
+
+
+def InputTensor(shape, dtype="float32", name=None):
+    """keras.Input equivalent: returns the symbolic tensor directly."""
+    return Input(shape, dtype, name)()
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias=True,
+                 name=None):
+        super().__init__(name)
+        self.units = units
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def lower(self, model, xs):
+        return model.dense(xs[0], self.units, activation=self.activation,
+                           use_bias=self.use_bias, name=self.name)
+
+
+class Flatten(Layer):
+    def lower(self, model, xs):
+        return model.flat(xs[0], name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, name=None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def lower(self, model, xs):
+        return model.embedding(xs[0], self.input_dim, self.output_dim,
+                               aggr="none", name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, fn: str, name=None):
+        super().__init__(name)
+        self.fn = fn
+
+    def lower(self, model, xs):
+        if self.fn == "softmax":
+            return model.softmax(xs[0], name=self.name)
+        return model._unary(self.fn, xs[0], self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        self.rate = rate
+
+    def lower(self, model, xs):
+        return model.dropout(xs[0], self.rate, name=self.name)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape, name=None):
+        super().__init__(name)
+        self.target_shape = tuple(target_shape)
+
+    def lower(self, model, xs):
+        b = xs[0].shape[0]
+        return model.reshape(xs[0], (b,) + self.target_shape, name=self.name)
+
+
+class Conv2D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, use_bias=True, name=None):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel = (kernel_size if isinstance(kernel_size, (tuple, list))
+                       else (kernel_size, kernel_size))
+        self.strides = (strides if isinstance(strides, (tuple, list))
+                        else (strides, strides))
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+
+    def lower(self, model, xs):
+        kh, kw = self.kernel
+        if self.padding == "same":
+            ph, pw = kh // 2, kw // 2
+        elif self.padding == "valid":
+            ph = pw = 0
+        else:
+            ph, pw = self.padding
+        return model.conv2d(xs[0], self.filters, kh, kw, self.strides[0],
+                            self.strides[1], ph, pw,
+                            activation=self.activation,
+                            use_bias=self.use_bias, name=self.name)
+
+
+class _Pool2D(Layer):
+    pool_type = "max"
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name=None):
+        super().__init__(name)
+        self.pool = (pool_size if isinstance(pool_size, (tuple, list))
+                     else (pool_size, pool_size))
+        strides = strides or self.pool
+        self.strides = (strides if isinstance(strides, (tuple, list))
+                        else (strides, strides))
+        self.padding = padding
+
+    def lower(self, model, xs):
+        kh, kw = self.pool
+        if self.padding == "same":
+            ph, pw = kh // 2, kw // 2
+        elif self.padding == "valid":
+            ph = pw = 0
+        else:
+            ph, pw = self.padding
+        return model.pool2d(xs[0], kh, kw, self.strides[0], self.strides[1],
+                            ph, pw, pool_type=self.pool_type, name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = "max"
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = "avg"
+
+
+class BatchNormalization(Layer):
+    def lower(self, model, xs):
+        return model.batch_norm(xs[0], name=self.name)
+
+
+class Concatenate(Layer):
+    def __init__(self, axis: int = 1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def lower(self, model, xs):
+        return model.concat(xs, self.axis, name=self.name)
+
+
+class Add(Layer):
+    def lower(self, model, xs):
+        return model.add(xs[0], xs[1], name=self.name)
+
+
+class Subtract(Layer):
+    def lower(self, model, xs):
+        return model.subtract(xs[0], xs[1], name=self.name)
+
+
+class Multiply(Layer):
+    def lower(self, model, xs):
+        return model.multiply(xs[0], xs[1], name=self.name)
+
+
+# --------------------------------------------------------------------- models
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGDOptimizer(lr=0.01),
+    "adam": lambda: AdamOptimizer(lr=0.001),
+}
+
+_LOSSES = {
+    "categorical_crossentropy": "categorical_crossentropy",
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "mean_squared_error": "mean_squared_error",
+    "mse": "mean_squared_error",
+}
+
+
+class BaseModel:
+    """Shared compile/fit/evaluate (reference base_model.py:30-509)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.ffmodel: Optional[FFModel] = None
+        self.state: Optional[TrainState] = None
+        self._input_names: List[str] = []
+        self.batch_size: Optional[int] = None
+
+    # built by subclasses: populate self.ffmodel + self._input_names
+    def _build(self, batch_size: int):
+        raise NotImplementedError
+
+    def compile(self, optimizer="sgd", loss="categorical_crossentropy",
+                metrics=("accuracy",), batch_size: int = 32):
+        if isinstance(optimizer, str):
+            optimizer = _OPTIMIZERS[optimizer.lower()]()
+        assert isinstance(optimizer, Optimizer)
+        self.batch_size = batch_size
+        self._build(batch_size)
+        loss = _LOSSES.get(loss, loss)
+        self.ffmodel.compile(optimizer=optimizer, loss_type=loss,
+                             metrics=tuple(metrics))
+        self.state = self.ffmodel.init()
+        return self
+
+    def _as_input_dict(self, x) -> Dict[str, np.ndarray]:
+        if isinstance(x, dict):
+            return x
+        if isinstance(x, (list, tuple)):
+            assert len(x) == len(self._input_names)
+            return dict(zip(self._input_names, x))
+        return {self._input_names[0]: x}
+
+    def fit(self, x, y, epochs: int = 1, verbose: bool = True):
+        """reference base_model.py:194 fit -> _train loop :367."""
+        inputs = self._as_input_dict(x)
+        loader = ArrayDataLoader(inputs, np.asarray(y), self.batch_size)
+        self.state, thpt = self.ffmodel.fit(self.state, loader,
+                                            epochs=epochs, verbose=verbose)
+        return thpt
+
+    def evaluate(self, x, y):
+        inputs = self._as_input_dict(x)
+        loader = ArrayDataLoader(inputs, np.asarray(y), self.batch_size)
+        from ..metrics import MetricsAccumulator
+        acc = MetricsAccumulator(self.ffmodel.metrics)
+        losses = []
+        for binputs, blabels in loader:
+            mets = self.ffmodel.eval_step(self.state, binputs, blabels)
+            losses.append(float(mets.pop("loss")))
+            acc.update(mets)
+        print(acc.report())
+        return float(np.mean(losses))
+
+    def predict(self, x):
+        inputs = self._as_input_dict(x)
+        return np.asarray(self.ffmodel.forward(self.state, inputs))
+
+    def summary(self) -> str:
+        lines = [f"Model: {self.name or type(self).__name__}"]
+        for op in self.ffmodel.layers:
+            lines.append(f"  {op.name:24s} {op.op_type:16s} "
+                         f"out={op.outputs[0].shape}")
+        return "\n".join(lines)
+
+
+class Sequential(BaseModel):
+    """reference keras/models/sequential API."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name=None):
+        super().__init__(name)
+        self._layers: List[Layer] = list(layers or [])
+
+    def add(self, layer: Layer):
+        self._layers.append(layer)
+
+    def _build(self, batch_size: int):
+        assert self._layers and isinstance(self._layers[0], Input), (
+            "Sequential model needs an Input layer first")
+        inp = self._layers[0]
+        self.ffmodel = FFModel(FFConfig(batch_size=batch_size))
+        t = self.ffmodel.create_tensor((batch_size,) + inp.shape, inp.dtype,
+                                       name=inp.name or "input")
+        self._input_names = [t.name]
+        for layer in self._layers[1:]:
+            t = layer.lower(self.ffmodel, [t])
+
+
+class Model(BaseModel):
+    """Functional model over KTensor DAG (reference model.py:54 BFS)."""
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name)
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._outputs = (outputs if isinstance(outputs, (list, tuple))
+                         else [outputs])
+
+    def _build(self, batch_size: int):
+        self.ffmodel = FFModel(FFConfig(batch_size=batch_size))
+        lowered: Dict[int, object] = {}
+        self._input_names = []
+
+        def visit(kt: KTensor):
+            key = id(kt)
+            if key in lowered:
+                return lowered[key]
+            if isinstance(kt.layer, Input):
+                t = self.ffmodel.create_tensor(
+                    (batch_size,) + kt.layer.shape, kt.layer.dtype,
+                    name=kt.layer.name)
+                self._input_names.append(t.name)
+            else:
+                xs = [visit(i) for i in kt.inputs]
+                t = kt.layer.lower(self.ffmodel, xs)
+            lowered[key] = t
+            return t
+
+        for out in self._outputs:
+            visit(out)

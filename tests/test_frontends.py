@@ -1,0 +1,159 @@
+"""Frontend tests: Keras-compatible API, torch.fx importer, ONNX importer
+(reference §2.5 python stack parity)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.frontends import keras as K
+from dlrm_flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+
+class TestKerasSequential:
+    def test_mlp_compile_fit_evaluate(self):
+        m = K.Sequential([
+            K.Input((20,)),
+            K.Dense(32, activation="relu"),
+            K.Dropout(0.1),
+            K.Dense(4),
+            K.Activation("softmax"),
+        ])
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=("accuracy",), batch_size=16)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 20)).astype(np.float32)
+        y = rng.integers(0, 4, size=(64, 1)).astype(np.int32)
+        m.fit(x, y, epochs=1, verbose=False)
+        loss = m.evaluate(x, y)
+        assert np.isfinite(loss)
+        preds = m.predict(x[:16])
+        assert preds.shape == (16, 4)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_cnn_layers(self):
+        m = K.Sequential([
+            K.Input((3, 16, 16)),
+            K.Conv2D(8, 3, padding="same", activation="relu"),
+            K.MaxPooling2D(),
+            K.BatchNormalization(),
+            K.Flatten(),
+            K.Dense(10),
+            K.Activation("softmax"),
+        ])
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  batch_size=8)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 3, 16, 16)).astype(np.float32)
+        y = rng.integers(0, 10, size=(16, 1)).astype(np.int32)
+        m.fit(x, y, epochs=1, verbose=False)
+
+    def test_summary(self):
+        m = K.Sequential([K.Input((8,)), K.Dense(4)])
+        m.compile(batch_size=4, loss="mse", metrics=())
+        s = m.summary()
+        assert "Dense" in s
+
+
+class TestKerasFunctional:
+    def test_multi_input_concat(self):
+        a = K.InputTensor((8,), name="a")
+        b = K.InputTensor((4,), name="b")
+        ha = K.Dense(16, activation="relu")(a)
+        hb = K.Dense(16, activation="relu")(b)
+        merged = K.Concatenate(axis=1)(ha, hb)
+        out = K.Dense(1)(merged)
+        m = K.Model(inputs=[a, b], outputs=out)
+        m.compile(optimizer="adam", loss="mse", metrics=(), batch_size=8)
+        rng = np.random.default_rng(0)
+        xa = rng.standard_normal((32, 8)).astype(np.float32)
+        xb = rng.standard_normal((32, 4)).astype(np.float32)
+        y = rng.standard_normal((32, 1)).astype(np.float32)
+        m.fit([xa, xb], y, epochs=1, verbose=False)
+        assert m.predict([xa[:8], xb[:8]]).shape == (8, 1)
+
+    def test_residual_add(self):
+        x = K.InputTensor((16,), name="x")
+        h = K.Dense(16, activation="relu")(x)
+        s = K.Add()(x, h)
+        m = K.Model(inputs=x, outputs=K.Dense(2)(s))
+        m.compile(batch_size=4, loss="mse", metrics=())
+        assert m.predict(np.zeros((4, 16), np.float32)).shape == (4, 2)
+
+
+class TorchMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(12, 24)
+        self.fc2 = nn.Linear(24, 3)
+
+    def forward(self, x):
+        h = torch.relu(self.fc1(x))
+        return self.fc2(h)
+
+
+class TorchCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.fc = nn.Linear(8 * 8 * 8, 5)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(torch.relu(self.conv(x)))))
+
+
+class TestTorchFX:
+    def test_mlp_numerics_match_torch(self):
+        torch.manual_seed(0)
+        tm = TorchMLP().eval()
+        conv = PyTorchModel(tm)
+        model = conv.apply(ff.FFConfig(batch_size=8), {"x": (12,)})
+        model.compile(loss_type="mean_squared_error", metrics=(), mesh=False)
+        state = model.init(seed=0)
+        state = conv.import_weights(model, state)
+        x = np.random.default_rng(0).standard_normal((8, 12)).astype(np.float32)
+        out = np.asarray(model.forward(state, {"x": x}))
+        ref = tm(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_cnn_numerics_match_torch(self):
+        torch.manual_seed(0)
+        tm = TorchCNN().eval()
+        conv = PyTorchModel(tm)
+        model = conv.apply(ff.FFConfig(batch_size=4), {"x": (3, 16, 16)})
+        model.compile(loss_type="mean_squared_error", metrics=(), mesh=False)
+        state = model.init(seed=0)
+        state = conv.import_weights(model, state)
+        x = np.random.default_rng(1).standard_normal(
+            (4, 3, 16, 16)).astype(np.float32)
+        out = np.asarray(model.forward(state, {"x": x}))
+        ref = tm(torch.from_numpy(x)).detach().numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+    def test_converted_model_trains(self):
+        tm = TorchMLP()
+        conv = PyTorchModel(tm)
+        model = conv.apply(ff.FFConfig(batch_size=8), {"x": (12,)})
+        model.compile(optimizer=ff.SGDOptimizer(0.01),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
+        state = model.init(seed=0)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 12)).astype(np.float32)
+        y = rng.standard_normal((8, 3)).astype(np.float32)
+        state, mets = model.train_step(state, {"x": x}, y)
+        assert np.isfinite(float(mets["loss"]))
+
+
+class TestONNX:
+    def test_import_gated(self):
+        onnx = pytest.importorskip("onnx")
+        # exercised only where onnx is installed
+        from dlrm_flexflow_tpu.frontends.onnx_model import ONNXModel  # noqa
+
+    def test_module_importable_without_onnx(self):
+        import dlrm_flexflow_tpu.frontends.onnx_model as om
+        assert hasattr(om, "ONNXModel")
