@@ -1,0 +1,117 @@
+"""Checkpoint / resume.
+
+The reference has **no training checkpointing** (SURVEY §5.4): the only
+weight IO is ``Parameter::set_weights/get_weights`` (model.h:219-231).
+This module supplies the TPU-native superset: full TrainState
+(params + optimizer slots + batchnorm state + PRNG + step) save/restore
+via orbax when available, with a portable numpy ``.npz`` fallback — so a
+run can actually resume, not just import weights.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .model import TrainState
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten(flat):
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = tree
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return tree
+
+
+def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
+                    use_orbax: Optional[bool] = None) -> str:
+    """Write a checkpoint directory; returns the path written."""
+    os.makedirs(path, exist_ok=True)
+    if use_orbax is None:
+        use_orbax = _orbax_available()
+    meta = {"step": int(state.step) if step is None else step,
+            "format": "orbax" if use_orbax else "npz"}
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckpt = {"params": state.params, "opt_state": state.opt_state,
+                "bn_state": state.bn_state, "rng": state.rng,
+                "step": state.step}
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, "state"), ckpt, force=True)
+    else:
+        flat = {}
+        flat.update({f"params/{k}": v for k, v in
+                     _flatten(state.params).items()})
+        flat.update({f"opt_state/{k}": v for k, v in
+                     _flatten(state.opt_state).items()})
+        flat.update({f"bn_state/{k}": v for k, v in
+                     _flatten(state.bn_state).items()})
+        flat["rng"] = state.rng
+        flat["step"] = state.step
+        np.savez(os.path.join(path, "state.npz"),
+                 **{k: np.asarray(v) for k, v in flat.items()})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def restore_checkpoint(path: str, model=None) -> TrainState:
+    """Read a checkpoint back into a TrainState; if ``model`` has an active
+    mesh, parameters are re-placed with their strategy shardings."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta["format"] == "orbax":
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckpt = ckptr.restore(os.path.join(path, "state"))
+        state = TrainState(ckpt["params"], ckpt["opt_state"],
+                           ckpt["bn_state"], jnp.asarray(ckpt["rng"]),
+                           jnp.asarray(ckpt["step"]))
+    else:
+        data = np.load(os.path.join(path, "state.npz"))
+        groups: dict = {"params": {}, "opt_state": {}, "bn_state": {}}
+        rng = step = None
+        for k in data.files:
+            if k == "rng":
+                rng = jnp.asarray(data[k])
+            elif k == "step":
+                step = jnp.asarray(data[k])
+            else:
+                head, rest = k.split("/", 1)
+                groups[head][rest] = jnp.asarray(data[k])
+        state = TrainState(_unflatten(groups["params"]),
+                           _unflatten(groups["opt_state"]),
+                           _unflatten(groups["bn_state"]), rng, step)
+    if model is not None and getattr(model, "mesh", None) is not None:
+        state = model._place_state(state)
+    return state
+
+
+def _orbax_available() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except Exception:
+        return False

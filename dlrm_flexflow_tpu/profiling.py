@@ -1,0 +1,77 @@
+"""Profiling / tracing utilities.
+
+TPU-native equivalent of the reference's profiling stack (SURVEY §5.1):
+  Legion tracing (-dm:memoize)        -> jit compilation cache +
+                                         FFModel.train_epoch scan
+  Legion profiler (-lg:prof)          -> jax.profiler traces (XPlane,
+                                         viewable in TensorBoard/Perfetto)
+  per-op cudaEvent timing (--profiling,
+    linear.cu:499-531)               -> per-op wall-clock via OpTimer
+  execution fence + TimingLauncher    -> block_until_ready + perf_counter
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler trace for the enclosed block
+    (the -lg:prof analogue)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Fenced wall-clock timing (reference dlrm.cc:154-198 protocol)."""
+
+    def __init__(self):
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        return False
+
+    @staticmethod
+    def fence(x):
+        jax.block_until_ready(x)
+
+
+class OpTimer:
+    """Per-op forward timing (reference --profiling flag wrapping kernels
+    with cudaEvents, linear.cu:499-531).  Times each op's jitted forward
+    in isolation — useful for cost-model calibration and hot-spot lists."""
+
+    def __init__(self, model, iters: int = 10):
+        self.model = model
+        self.iters = iters
+
+    def profile(self, state, inputs) -> Dict[str, float]:
+        from .sim.cost_model import CostModel
+
+        cm = CostModel(measure=True, measure_iters=self.iters)
+        out = {}
+        for op in self.model.layers:
+            fwd, bwd = cm.op_times(op, 1)
+            out[op.name] = {"forward_s": fwd, "backward_s": bwd}
+        return out
+
+    def report(self, times: Dict[str, dict]) -> str:
+        lines = ["op                        forward(us)  backward(us)"]
+        for name, t in sorted(times.items(),
+                              key=lambda kv: -kv[1]["forward_s"]):
+            lines.append(f"{name:24s} {t['forward_s']*1e6:12.1f} "
+                         f"{t['backward_s']*1e6:12.1f}")
+        return "\n".join(lines)
