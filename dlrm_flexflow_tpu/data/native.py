@@ -1,0 +1,191 @@
+"""ctypes bindings for the native runtime (native/ffruntime.cpp).
+
+TPU-native equivalent of the reference's C++ host glue: the cffi binding
+layer (python/flexflow/core/flexflow_cbinding.py) reduced to the pieces
+that still need native code on TPU — batch gather, prefetching loader,
+CPU embedding kernels.  Auto-builds the .so from source if missing (the
+ffcompile.sh analogue).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _build_if_needed() -> str:
+    so = os.path.join(_NATIVE_DIR, "libffruntime.so")
+    src = os.path.join(_NATIVE_DIR, "ffruntime.cpp")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+    return so
+
+
+def get_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(_build_if_needed())
+        i64 = ctypes.c_int64
+        p = ctypes.c_void_p
+        lib.ff_embedding_bag_fwd_f32.argtypes = [p, p, p, i64, i64, i64,
+                                                 ctypes.c_int]
+        lib.ff_embedding_bag_bwd_f32.argtypes = [p, p, p, i64, i64, i64,
+                                                 ctypes.c_int]
+        lib.ff_gather_rows_f32.argtypes = [p, p, p, i64, i64]
+        lib.ff_gather_rows_i64.argtypes = [p, p, p, i64, i64]
+        lib.ff_loader_create.argtypes = [i64, i64]
+        lib.ff_loader_create.restype = p
+        lib.ff_loader_add_tensor.argtypes = [p, p, p, p, i64, ctypes.c_int32]
+        lib.ff_loader_start.argtypes = [p, p]
+        lib.ff_loader_next.argtypes = [p]
+        lib.ff_loader_next.restype = ctypes.c_int32
+        lib.ff_loader_destroy.argtypes = [p]
+        _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+# ------------------------------------------------------------- CPU embedding
+def embedding_bag_cpu(weight: np.ndarray, indices: np.ndarray,
+                      mode: str = "sum") -> np.ndarray:
+    """Native CPU bag lookup (reference embedding_avx2.cc path)."""
+    lib = get_lib()
+    weight = np.ascontiguousarray(weight, np.float32)
+    indices = np.ascontiguousarray(indices, np.int64)
+    b, bag = indices.shape
+    dim = weight.shape[1]
+    out = np.empty((b, dim), np.float32)
+    lib.ff_embedding_bag_fwd_f32(_ptr(weight), _ptr(indices), _ptr(out),
+                                 b, bag, dim, 1 if mode == "avg" else 0)
+    return out
+
+
+def embedding_bag_cpu_grad(grad_out: np.ndarray, indices: np.ndarray,
+                           num_rows: int, mode: str = "sum") -> np.ndarray:
+    lib = get_lib()
+    grad_out = np.ascontiguousarray(grad_out, np.float32)
+    indices = np.ascontiguousarray(indices, np.int64)
+    b, bag = indices.shape
+    dim = grad_out.shape[1]
+    gw = np.zeros((num_rows, dim), np.float32)
+    lib.ff_embedding_bag_bwd_f32(_ptr(grad_out), _ptr(indices), _ptr(gw),
+                                 b, bag, dim, 1 if mode == "avg" else 0)
+    return gw
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Parallel batch gather (the dataloader scatter-task core)."""
+    lib = get_lib()
+    idx = np.ascontiguousarray(idx, np.int64)
+    src = np.ascontiguousarray(src)
+    row_elems = int(np.prod(src.shape[1:], dtype=np.int64))
+    out = np.empty((idx.shape[0],) + src.shape[1:], src.dtype)
+    if src.dtype == np.float32:
+        lib.ff_gather_rows_f32(_ptr(src), _ptr(idx), _ptr(out),
+                               idx.shape[0], row_elems)
+    elif src.dtype == np.int64:
+        lib.ff_gather_rows_i64(_ptr(src), _ptr(idx), _ptr(out),
+                               idx.shape[0], row_elems)
+    else:
+        return src[idx]
+    return out
+
+
+# --------------------------------------------------------- prefetching loader
+class NativeDataLoader:
+    """Double-buffered background-prefetch loader over host arrays
+    (reference flexflow_dataloader + Legion async launch pipeline).
+
+    Yielded arrays are zero-copy VIEWS into the two staging buffers: they
+    are valid only until the next iteration step (by then the prefetcher
+    reuses the buffer).  Consume or copy each batch before advancing —
+    ``jax.device_put``/``train_step`` copies synchronously, so the normal
+    training loop is safe.
+    """
+
+    def __init__(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
+                 batch_size: int, shuffle: bool = False, seed: int = 0):
+        self.lib = get_lib()
+        self.batch_size = int(batch_size)
+        self.num_samples = labels.shape[0]
+        self.num_batches = self.num_samples // self.batch_size
+        assert self.num_batches > 0
+        self._arrays = dict(inputs)
+        self._arrays["__labels__"] = labels
+        self._arrays = {k: np.ascontiguousarray(v)
+                        for k, v in self._arrays.items()}
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._staging = {}
+        self.handle = self.lib.ff_loader_create(self.num_samples,
+                                                self.batch_size)
+        for name, arr in self._arrays.items():
+            kind = 1 if arr.dtype == np.int64 else 0
+            assert arr.dtype in (np.float32, np.int64), (
+                f"{name}: unsupported dtype {arr.dtype}")
+            s0 = np.empty((self.batch_size,) + arr.shape[1:], arr.dtype)
+            s1 = np.empty_like(s0)
+            self._staging[name] = (s0, s1)
+            row = int(np.prod(arr.shape[1:], dtype=np.int64))
+            self.lib.ff_loader_add_tensor(self.handle, _ptr(arr), _ptr(s0),
+                                          _ptr(s1), row, kind)
+        self._order = None
+        self._started = False
+
+    def _new_order(self):
+        order = np.arange(self.num_samples, dtype=np.int64)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        return np.ascontiguousarray(order)
+
+    def __iter__(self):
+        if not self._started:
+            self._order = self._new_order()  # keep alive: worker reads it
+            self.lib.ff_loader_start(self.handle, _ptr(self._order))
+            self._started = True
+        for _ in range(self.num_batches):
+            slot = self.lib.ff_loader_next(self.handle)
+            batch = {k: st[slot] for k, st in self._staging.items()}
+            labels = batch.pop("__labels__")
+            yield batch, labels
+
+    def peek(self):
+        idx = np.arange(self.batch_size, dtype=np.int64)
+        batch = {k: gather_rows(v, idx) for k, v in self._arrays.items()}
+        labels = batch.pop("__labels__")
+        return batch, labels
+
+    def __len__(self):
+        return self.num_batches
+
+    def close(self):
+        if self.handle:
+            self.lib.ff_loader_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,199 @@
+// Native runtime components for dlrm_flexflow_tpu.
+//
+// TPU-native equivalents of the reference's native host-side code:
+//   - batch gather / dataloader  (reference python/flexflow_dataloader.{cc,cu}
+//     and examples/cpp/DLRM/dlrm.cc:486-589: full dataset resident in host
+//     "zero-copy" memory, per-batch gather into staging buffers scattered to
+//     devices).  Here: a multithreaded gather into double-buffered staging
+//     arrays with a background prefetch thread, so host batch prep overlaps
+//     device compute.
+//   - CPU embedding-bag kernels  (reference src/ops/embedding_avx2.cc:
+//     AVX2+FMA EmbeddingLookup specialized by block size).  Here: OpenMP-
+//     parallel, compiler-vectorized (#pragma omp simd) bag lookup fwd/bwd
+//     for the heterogeneous CPU-placement path.
+//
+// Exposed with a plain C ABI for ctypes (no pybind11 in this environment).
+// Build: native/Makefile -> libffruntime.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Embedding-bag CPU kernels (embedding_avx2.cc equivalent)
+// ---------------------------------------------------------------------------
+
+// out[b, :] = sum/avg over j of weight[indices[b * bag + j], :]
+void ff_embedding_bag_fwd_f32(const float* weight, const int64_t* indices,
+                              float* out, int64_t batch, int64_t bag,
+                              int64_t dim, int normalize) {
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < batch; ++b) {
+    float* op = out + b * dim;
+    std::memset(op, 0, sizeof(float) * dim);
+    for (int64_t j = 0; j < bag; ++j) {
+      const float* row = weight + indices[b * bag + j] * dim;
+#pragma omp simd
+      for (int64_t d = 0; d < dim; ++d) op[d] += row[d];
+    }
+    if (normalize && bag > 0) {
+      const float inv = 1.0f / static_cast<float>(bag);
+#pragma omp simd
+      for (int64_t d = 0; d < dim; ++d) op[d] *= inv;
+    }
+  }
+}
+
+// grad_weight[indices[b*bag+j], :] += grad_out[b, :]   (scatter-add; the
+// deterministic CPU analogue of embedding.cu:199-224)
+void ff_embedding_bag_bwd_f32(const float* grad_out, const int64_t* indices,
+                              float* grad_weight, int64_t batch, int64_t bag,
+                              int64_t dim, int normalize) {
+  // serial over batch to stay deterministic; vectorized over dim
+  const float scale = normalize && bag > 0
+                          ? 1.0f / static_cast<float>(bag)
+                          : 1.0f;
+  for (int64_t b = 0; b < batch; ++b) {
+    const float* g = grad_out + b * dim;
+    for (int64_t j = 0; j < bag; ++j) {
+      float* row = grad_weight + indices[b * bag + j] * dim;
+#pragma omp simd
+      for (int64_t d = 0; d < dim; ++d) row[d] += g[d] * scale;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Batch gather (dataloader core): out[i, ...] = src[idx[i], ...]
+// ---------------------------------------------------------------------------
+
+void ff_gather_rows_f32(const float* src, const int64_t* idx, float* out,
+                        int64_t n, int64_t row_elems) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                sizeof(float) * row_elems);
+}
+
+void ff_gather_rows_i64(const int64_t* src, const int64_t* idx, int64_t* out,
+                        int64_t n, int64_t row_elems) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                sizeof(int64_t) * row_elems);
+}
+
+// ---------------------------------------------------------------------------
+// Prefetching dataloader: background thread fills the next batch's staging
+// buffers while the caller consumes the current ones (double buffering, the
+// host-side pipeline the reference gets from Legion's async index launches).
+// ---------------------------------------------------------------------------
+
+struct FFTensorSpec {
+  const void* data;     // full dataset, host resident
+  void* staging[2];     // two staging buffers, caller-allocated
+  int64_t row_elems;    // elements per sample
+  int32_t elem_kind;    // 0 = f32, 1 = i64
+};
+
+struct FFLoader {
+  std::vector<FFTensorSpec> tensors;
+  const int64_t* order = nullptr;  // epoch sample order
+  int64_t num_samples = 0;
+  int64_t batch = 0;
+  int64_t next_batch_idx = 0;      // batch index being prefetched
+  int slot = 0;                    // staging slot being written
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;              // prefetched slot available
+  bool want = false;               // request outstanding
+  std::atomic<bool> stop{false};
+
+  void fill(int s) {
+    const int64_t* idx = order + next_batch_idx * batch;
+    for (auto& t : tensors) {
+      if (t.elem_kind == 0)
+        ff_gather_rows_f32(static_cast<const float*>(t.data), idx,
+                           static_cast<float*>(t.staging[s]), batch,
+                           t.row_elems);
+      else
+        ff_gather_rows_i64(static_cast<const int64_t*>(t.data), idx,
+                           static_cast<int64_t*>(t.staging[s]), batch,
+                           t.row_elems);
+    }
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv.wait(lk, [&] { return want || stop.load(); });
+      if (stop.load()) return;
+      want = false;
+      int s = slot;
+      lk.unlock();
+      fill(s);
+      lk.lock();
+      ready = true;
+      cv.notify_all();
+    }
+  }
+};
+
+void* ff_loader_create(int64_t num_samples, int64_t batch) {
+  auto* l = new FFLoader();
+  l->num_samples = num_samples;
+  l->batch = batch;
+  return l;
+}
+
+void ff_loader_add_tensor(void* handle, const void* data, void* staging0,
+                          void* staging1, int64_t row_elems,
+                          int32_t elem_kind) {
+  auto* l = static_cast<FFLoader*>(handle);
+  l->tensors.push_back({data, {staging0, staging1}, row_elems, elem_kind});
+}
+
+// start the worker and prefetch batch 0 into slot 0
+void ff_loader_start(void* handle, const int64_t* order) {
+  auto* l = static_cast<FFLoader*>(handle);
+  l->order = order;
+  l->next_batch_idx = 0;
+  l->slot = 0;
+  l->ready = false;
+  l->want = true;
+  l->worker = std::thread([l] { l->run(); });
+  l->cv.notify_all();
+}
+
+// block until the prefetched batch is in its staging slot; returns the slot
+// and kicks off the prefetch of the following batch into the other slot.
+int32_t ff_loader_next(void* handle) {
+  auto* l = static_cast<FFLoader*>(handle);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv.wait(lk, [&] { return l->ready; });
+  l->ready = false;
+  int got = l->slot;
+  int64_t nb = l->num_samples / l->batch;
+  l->next_batch_idx = (l->next_batch_idx + 1) % nb;
+  l->slot = 1 - got;
+  l->want = true;
+  l->cv.notify_all();
+  return got;
+}
+
+void ff_loader_destroy(void* handle) {
+  auto* l = static_cast<FFLoader*>(handle);
+  l->stop.store(true);
+  l->cv.notify_all();
+  if (l->worker.joinable()) l->worker.join();
+  delete l;
+}
+
+}  // extern "C"
