@@ -96,9 +96,15 @@ class Strategy:
     def __contains__(self, k):
         return k in self.configs
 
-    # ---- serialization (JSON superset of strategy.proto's fields) ---------
+    # ---- serialization (JSON superset of strategy.proto's fields; ``.pb``
+    # paths use the reference-compatible proto2 wire format) -----------------
     def save(self, path: str):
         """reference save_strategies_to_file (strategy.cc:137-172)."""
+        if path.endswith(".pb"):
+            from .strategy_pb import save_strategy_pb
+
+            save_strategy_pb(path, self)
+            return
         data = {"ops": [{"name": k, **v.to_json()}
                         for k, v in sorted(self.configs.items())]}
         with open(path, "w") as f:
@@ -107,6 +113,10 @@ class Strategy:
     @staticmethod
     def load(path: str) -> "Strategy":
         """reference load_strategies_from_file (strategy.cc:96-135)."""
+        if path.endswith(".pb"):
+            from .strategy_pb import load_strategy_pb
+
+            return load_strategy_pb(path)
         with open(path) as f:
             data = json.load(f)
         s = Strategy()
