@@ -206,6 +206,13 @@ class FFModel:
             return op.outputs
         return op.outputs[0]
 
+    def moe(self, input_tensor, num_experts, hidden_dim, top_k=2,
+            activation="relu", name=None):
+        from .ops.moe import MixtureOfExperts
+        op = MixtureOfExperts(self._name("moe", name), input_tensor,
+                              num_experts, hidden_dim, top_k, activation)
+        return self._add(op)
+
     def dropout(self, input_tensor, rate=0.5, seed=0, name=None):
         op = Dropout(self._name("dropout", name), input_tensor, rate, seed)
         return self._add(op)
@@ -315,9 +322,13 @@ class FFModel:
                 new_bn[op.name] = op._last_state
             # per-op placement constraint — the strategy's imprint on XLA
             if self.mesh is not None and op.parallel_config is not None:
-                spec = pspec_for_config(op.parallel_config,
-                                        op.outputs[0].ndim, self.mesh)
-                outs = [constrain(outs[0], self.mesh, spec)] + list(outs[1:])
+                if hasattr(op, "output_pspec"):
+                    spec = op.output_pspec(op.parallel_config, self.mesh)
+                else:
+                    spec = pspec_for_config(op.parallel_config,
+                                            op.outputs[0].ndim, self.mesh)
+                if spec is not None:
+                    outs = [constrain(outs[0], self.mesh, spec)] + list(outs[1:])
             for o, t in zip(outs, op.outputs):
                 values[t.uid] = o
         return values, new_bn

@@ -10,6 +10,7 @@ from .conv import BatchNorm, Conv2D, Pool2D
 from .softmax import Dropout, Softmax
 from .attention import MultiHeadAttention, sdpa
 from .rnn import LSTM
+from .moe import MixtureOfExperts
 
 __all__ = [
     "Op", "activation_fn", "matmul",
@@ -19,5 +20,5 @@ __all__ = [
     "BatchNorm", "Conv2D", "Pool2D",
     "Dropout", "Softmax",
     "MultiHeadAttention", "sdpa",
-    "LSTM",
+    "LSTM", "MixtureOfExperts",
 ]

@@ -1,0 +1,107 @@
+"""Mixture-of-Experts operator (expert parallelism).
+
+No reference analogue (SURVEY §2.3: "no expert routing" — EP is absent in
+the reference); included because expert sharding is a first-class axis of
+this framework's SOAP space.
+
+Design: E expert MLPs with stacked weights (E, d, h), (E, h, d) and a
+learned router.  Computation is the dense-dispatch formulation — every
+expert processes the full token batch, masked/combined by the top-k gate
+weights — expressed as batched einsums over the expert axis.  Sharding the
+expert axis of the weights over the mesh's "model"/"expert" axis gives
+expert parallelism: XLA partitions the einsum over experts and inserts the
+gather/reduce collectives (at large scale a capacity-based all-to-all
+dispatch is cheaper; that variant can reuse this op's parameters).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..initializers import DEFAULT_KERNEL_INIT, ZeroInitializer
+from ..tensor import ParameterSpec
+from .base import Op
+
+
+class MixtureOfExperts(Op):
+    """(B, d) -> (B, d) with E gated expert MLPs (d -> hidden -> d)."""
+
+    op_type = "MixtureOfExperts"
+
+    def __init__(self, name, input_tensor, num_experts: int, hidden_dim: int,
+                 top_k: int = 2, activation: str = "relu",
+                 kernel_initializer=None):
+        super().__init__(name, [input_tensor])
+        assert 1 <= top_k <= num_experts
+        self.num_experts = int(num_experts)
+        self.hidden_dim = int(hidden_dim)
+        self.top_k = int(top_k)
+        self.activation = activation
+        self.model_dim = input_tensor.shape[-1]
+        self.kernel_initializer = kernel_initializer or DEFAULT_KERNEL_INIT
+        self.outputs = [self._make_output(input_tensor.shape,
+                                          input_tensor.dtype)]
+
+    def param_specs(self):
+        e, d, h = self.num_experts, self.model_dim, self.hidden_dim
+        return [
+            ParameterSpec(self.name, "router", (d, e),
+                          initializer=self.kernel_initializer),
+            ParameterSpec(self.name, "w_in", (e, d, h),
+                          initializer=self.kernel_initializer, sharded_dim=0),
+            ParameterSpec(self.name, "b_in", (e, h),
+                          initializer=ZeroInitializer(), sharded_dim=0),
+            ParameterSpec(self.name, "w_out", (e, h, d),
+                          initializer=self.kernel_initializer, sharded_dim=0),
+            ParameterSpec(self.name, "b_out", (e, d),
+                          initializer=ZeroInitializer(), sharded_dim=0),
+        ]
+
+    def forward(self, params, xs, *, training=False, rng=None):
+        (x,) = xs  # (..., d)
+        from .base import activation_fn
+
+        logits = x @ params["router"]  # (..., E)
+        gates = jax.nn.softmax(logits, axis=-1)
+        if self.top_k < self.num_experts:
+            top_vals, _ = jax.lax.top_k(gates, self.top_k)
+            thresh = top_vals[..., -1:]
+            masked = jnp.where(gates >= thresh, gates, 0.0)
+            gates = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        # dense dispatch: every expert runs the batch; experts sharded ->
+        # XLA partitions the einsum over e
+        h = jnp.einsum("...d,edh->e...h", x, params["w_in"],
+                       preferred_element_type=jnp.float32)
+        h = h + params["b_in"][(slice(None),) + (None,) * (x.ndim - 1)]
+        h = activation_fn(self.activation)(h)
+        y = jnp.einsum("e...h,ehd->e...d", h, params["w_out"],
+                       preferred_element_type=jnp.float32)
+        y = y + params["b_out"][(slice(None),) + (None,) * (x.ndim - 1)]
+        out = jnp.einsum("e...d,...e->...d", y, gates)
+        self._last_aux_loss = self._load_balance_loss(gates)
+        return [out.astype(self.outputs[0].dtype)]
+
+    def output_pspec(self, pc, mesh):
+        """The expert axis lives in the WEIGHTS, not the output: a non-batch
+        partition in this op's config means expert parallelism, and the
+        combined output stays data-sharded/replicated."""
+        from ..parallel.mesh import DATA_AXIS
+        from jax.sharding import PartitionSpec
+        ndim = self.outputs[0].ndim
+        axes = [None] * ndim
+        if pc.dims and pc.dims[0] > 1 and DATA_AXIS in mesh.axis_names:
+            axes[0] = DATA_AXIS
+        return PartitionSpec(*axes)
+
+    @staticmethod
+    def _load_balance_loss(gates):
+        """Standard importance/load loss (mean squared coefficient of
+        variation of per-expert gate mass)."""
+        importance = jnp.sum(gates.reshape(-1, gates.shape[-1]), axis=0)
+        mean = jnp.mean(importance)
+        return jnp.mean(jnp.square(importance / (mean + 1e-9) - 1.0))
+
+    def flops(self, batch):
+        e, d, h = self.num_experts, self.model_dim, self.hidden_dim
+        return 2 * batch * e * (d * h + h * d) + 2 * batch * d * e

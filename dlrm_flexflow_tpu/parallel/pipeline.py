@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style microbatched SPMD pipeline.
+
+The reference's closest analogue is per-op device placement (NMT's
+per-layer per-timestep-block GlobalConfig, nmt/rnn.h:58-63; SURVEY §2.3
+calls PP "absent").  On TPU, pipelining is expressed the SPMD way:
+
+- the mesh gets a "pipe" axis; stage s's parameters live on pipe-coordinate
+  s (params are stacked on a leading stage axis and sharded over "pipe");
+- ``shard_map`` runs the same program on every stage; activations flow to
+  the next stage with one-hop ``lax.ppermute`` (neighbour ICI transfers —
+  the cheapest collective on the torus);
+- microbatches are fed in over M + S - 1 ticks (GPipe schedule); the
+  steady-state keeps every stage busy, and XLA overlaps each tick's
+  ppermute with the next tick's compute.
+
+Requires homogeneous stages (same params/activation shapes per stage) —
+the standard TPU pipeline regime (transformer blocks, stacked MLP layers).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def spmd_pipeline(stage_fn: Callable, mesh: Mesh, num_microbatches: int,
+                  axis: str = PIPE_AXIS):
+    """Build a pipelined apply: (stacked_params, x) -> y.
+
+    ``stage_fn(params_s, x) -> y`` is one stage's computation; activations
+    must keep the same shape across stages.  ``stacked_params`` is a pytree
+    whose leaves have a leading stage axis of size S = mesh.shape[axis].
+    ``x`` is (M, mb, ...) microbatched input; returns (M, mb, ...) outputs.
+    """
+    s = mesh.shape[axis]
+
+    def per_device(params, x):
+        # params: this stage's slice (leading axis 1); x: full (M, mb, ...)
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis)
+        m = x.shape[0]
+        mb_shape = x.shape[1:]
+        ticks = m + s - 1
+
+        buf = jnp.zeros(mb_shape, x.dtype)          # current activation
+        outs = jnp.zeros((m,) + mb_shape, x.dtype)  # collected at last stage
+
+        def tick(t, carry):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (if any) — others take the
+            # activation ppermuted from the previous stage last tick
+            feed = jnp.where(t < m, t, 0)
+            x_in = jnp.where(stage == 0, x[feed], buf)
+            y = stage_fn(params, x_in)
+            # last stage emits its result for microbatch (t - s + 1)
+            out_idx = t - (s - 1)
+            valid = (stage == s - 1) & (out_idx >= 0)
+            outs = jax.lax.cond(
+                valid,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outs)
+            # shift activations one stage forward on the ICI ring
+            buf = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % s) for i in range(s)])
+            return buf, outs
+
+        _, outs = jax.lax.fori_loop(0, ticks, tick, (buf, outs))
+        # only the last stage holds real outputs (zeros elsewhere); psum
+        # broadcasts them so the out spec is genuinely replicated
+        return jax.lax.psum(outs, axis)
+
+    def apply(stacked_params, x):
+        pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(pspec, P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stacked_params, x)
+
+    return apply
+
+
+def place_stage_params(stacked_params, mesh: Mesh, axis: str = PIPE_AXIS):
+    """device_put the stacked per-stage params onto the pipe axis."""
+    def put(p):
+        spec = P(axis, *([None] * (p.ndim - 1)))
+        return jax.device_put(p, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, stacked_params)
+
+
+def pipeline_loss_and_grad(stage_fn, loss_fn, mesh: Mesh,
+                           num_microbatches: int, axis: str = PIPE_AXIS):
+    """Convenience: value_and_grad of mean loss over microbatches through
+    the pipeline (grads flow back through the ppermutes automatically —
+    reverse-mode AD of a ppermute is the reverse ppermute, so the backward
+    schedule is the mirrored pipeline)."""
+    fwd = spmd_pipeline(stage_fn, mesh, num_microbatches, axis)
+
+    def total_loss(stacked_params, x_mb, y_mb):
+        preds = fwd(stacked_params, x_mb)
+        return loss_fn(preds, y_mb)
+
+    return jax.value_and_grad(total_loss)
