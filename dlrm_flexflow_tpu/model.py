@@ -90,6 +90,7 @@ class FFModel:
         self._train_step = None
         self._eval_step = None
         self._forward_fn = None
+        self._hetero_ops: List[Op] = []
 
     # ------------------------------------------------------------------ utils
     def _name(self, base: str, name: Optional[str] = None) -> str:
@@ -366,9 +367,17 @@ class FFModel:
                 alpha=self.config.search_alpha, verbose=True)
             if self.config.export_strategy_file:
                 self.strategy.save(self.config.export_strategy_file)
+        self._hetero_ops = []
         for op in self.layers:
             if op.name in self.strategy:
                 op.parallel_config = self.strategy[op.name]
+            pc = op.parallel_config
+            if (pc is not None and pc.device_type == "cpu"
+                    and hasattr(op, "placement")):
+                # heterogeneous CPU placement (dlrm_strategy_hetero.cc):
+                # table lives in host RAM, updated host-side post-step
+                op.placement = "cpu"
+                self._hetero_ops.append(op)
         if mesh is False:  # explicit single-device request
             self.mesh = None
         elif mesh is not None:
@@ -541,7 +550,17 @@ class FFModel:
         (dlrm.cc:166-187)."""
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
         labels = self.shard_batch(labels)
-        return self._train_step(state, inputs, labels)
+        out = self._train_step(state, inputs, labels)
+        if self._hetero_ops:
+            # host-side optimizer step for CPU-placed tables (their grads
+            # were deposited by the backward callback this step)
+            from .ops.hetero import apply_host_sgd
+            jax.block_until_ready(out[0].params)  # ensure callbacks ran
+            lr = getattr(self.optimizer, "lr", 0.01)
+            for op in self._hetero_ops:
+                if hasattr(op, "host_table"):
+                    apply_host_sgd(op.host_table, lr)
+        return out
 
     def train_epoch(self, state: TrainState, inputs: Dict[str, Any], labels):
         """Run all batches in one on-device scan.  ``inputs`` arrays have a

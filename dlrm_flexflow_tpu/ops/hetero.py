@@ -1,0 +1,118 @@
+"""Heterogeneous CPU placement: run an op on the host inside a jitted step.
+
+TPU-native equivalent of the reference's CPU device placement
+(reference: ParallelConfig::device_type CPU config.h:42-45; CPU embedding
+kernels embedding_avx2.cc; hetero strategy generator
+dlrm_strategy_hetero.cc — embeddings on CPU, MLPs on GPU, used when
+embedding tables exceed device memory).
+
+Mechanism: ``jax.pure_callback`` escapes the compiled graph to the host,
+where the native OpenMP/SIMD kernels (native/ffruntime.cpp) do the bag
+lookup; a ``custom_vjp`` routes the backward scatter-add through the
+native kernel too, so CPU-placed embeddings train.  The host table array
+is kept out of HBM entirely — the point of the hetero strategy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class HostEmbeddingTable:
+    """A table resident in host RAM (never device_put).  Registered as a
+    side store keyed by name because jit traces cannot close over
+    mutable host arrays through the params pytree."""
+
+    _tables = {}
+
+    def __init__(self, name: str, array: np.ndarray):
+        self.name = name
+        HostEmbeddingTable._tables[name] = np.ascontiguousarray(
+            array, np.float32)
+
+    @property
+    def array(self) -> np.ndarray:
+        return HostEmbeddingTable._tables[self.name]
+
+    @array.setter
+    def array(self, v):
+        HostEmbeddingTable._tables[self.name] = np.ascontiguousarray(
+            v, np.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def host_embedding_bag(ids, handle, table_name: str, dim: int,
+                       mode: str = "sum"):
+    """(B, bag) int ids -> (B, dim) via the host-resident table.
+
+    ``handle`` is a differentiable scalar (keep it in the params pytree,
+    value 1.0): integer ids carry no gradient, so without it autodiff
+    would prune the backward and the host table would never receive its
+    scatter-add.  The forward multiplies by ``handle`` (=1, a no-op); the
+    cotangent path through it forces the backward callback to run.
+    """
+    return _host_fwd_impl(ids, table_name, dim, mode) * handle
+
+
+def _host_fwd_impl(ids, table_name, dim, mode):
+    def cb(ids_np):
+        from ..data import native as N
+
+        table = HostEmbeddingTable._tables[table_name]
+        if N.native_available():
+            return N.embedding_bag_cpu(table, ids_np, mode)
+        rows = table[ids_np]
+        return rows.sum(1) if mode == "sum" else rows.mean(1)
+
+    out_shape = jax.ShapeDtypeStruct((ids.shape[0], dim), jnp.float32)
+    return jax.pure_callback(cb, out_shape, ids)
+
+
+def _fwd(ids, handle, table_name, dim, mode):
+    out = _host_fwd_impl(ids, table_name, dim, mode) * handle
+    return out, (ids, handle, out)
+
+
+def _bwd(table_name, dim, mode, res, g):
+    """Deposit the scatter-add gradient for the HOST table (the hetero
+    optimizer path: CPU tables update on the host, reference
+    dlrm_strategy_hetero.cc semantics); cotangents flow only to the
+    handle."""
+    ids, handle, out = res
+    def cb(ids_np, g_np):
+        from ..data import native as N
+
+        table = HostEmbeddingTable._tables[table_name]
+        if N.native_available():
+            gw = N.embedding_bag_cpu_grad(g_np, ids_np, table.shape[0], mode)
+        else:
+            gw = np.zeros_like(table)
+            scale = 1.0 / ids_np.shape[1] if mode == "avg" else 1.0
+            for b in range(ids_np.shape[0]):
+                for j in range(ids_np.shape[1]):
+                    gw[ids_np[b, j]] += g_np[b] * scale
+        HostEmbeddingTable._tables[table_name + "/grad"] = gw
+        return np.zeros((), np.float32)
+
+    token = jax.pure_callback(cb, jax.ShapeDtypeStruct((), jnp.float32),
+                              ids, g * handle)
+    # handle cotangent: d out/d handle = raw_out; tie the callback token in
+    # so the deposit isn't DCE'd
+    d_handle = jnp.sum(g * out) / jnp.where(handle != 0, handle, 1.0)
+    return (jnp.zeros(ids.shape, ids.dtype), d_handle + 0.0 * token)
+
+
+host_embedding_bag.defvjp(_fwd, _bwd)
+
+
+def apply_host_sgd(table: HostEmbeddingTable, lr: float):
+    """Host-side SGD step for a CPU-placed table using the gradient the
+    backward callback deposited."""
+    g = HostEmbeddingTable._tables.get(table.name + "/grad")
+    if g is not None:
+        table.array = table.array - lr * g
