@@ -1,0 +1,33 @@
+"""DLRM with the hybrid table-parallel strategy on a data x model mesh
+(the reference's dlrm_strategy.cc placement: tables spread over devices,
+MLPs data-parallel).
+
+Runs on any device count: set XLA_FLAGS=--xla_force_host_platform_device_count=8
+with JAX_PLATFORMS=cpu to try it without TPUs.
+"""
+import numpy as np
+import jax
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+
+n_dev = jax.device_count()
+model_ax = 2 if n_dev % 2 == 0 and n_dev >= 2 else 1
+mesh = ff.make_mesh({"data": n_dev // model_ax, "model": model_ax})
+print("mesh:", dict(mesh.shape))
+
+cfg = DLRMConfig(sparse_feature_size=64, embedding_size=[100000] * 8,
+                 embedding_bag_size=1, mlp_bot=[13, 512, 64],
+                 mlp_top=[64 * 8 + 64, 512, 1])
+fc = ff.FFConfig(batch_size=256)
+model = build_dlrm(cfg, fc, table_parallel=model_ax > 1)
+model.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error",
+              metrics=("accuracy", "mean_squared_error"), mesh=mesh)
+state = model.init()
+print("embedding sharding:",
+      state.params["emb"]["embedding"].sharding.spec)
+
+loader = SyntheticDLRMLoader(8 * 256, 13, cfg.embedding_size, 1, 256)
+state, thpt = model.fit(state, loader, epochs=2)

@@ -1,0 +1,13 @@
+"""DLRM on synthetic data (reference run_random.sh config).
+
+Usage: python examples/dlrm_synthetic.py [-b 256] [-e 2] [--data-size 4096]
+"""
+import sys
+
+from dlrm_flexflow_tpu.apps.dlrm import run
+
+if __name__ == "__main__":
+    run(sys.argv[1:] or
+        ("-b 256 -e 2 --arch-sparse-feature-size 64 "
+         "--arch-mlp-bot 64-512-512-64 "
+         "--arch-mlp-top 576-1024-1024-1024-1 --data-size 4096").split())

@@ -1,0 +1,32 @@
+"""Long-context training with ring attention: the sequence axis sharded
+over the mesh, K/V streaming around the ICI ring (absent in the reference;
+first-class here).
+
+Try without TPUs: XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu python examples/long_context_ring_attention.py
+"""
+import numpy as np
+import jax
+
+import dlrm_flexflow_tpu as ff
+
+n = jax.device_count()
+seq_shards = max(n // 2, 1)
+mesh = ff.make_mesh({"data": n // seq_shards, "seq": seq_shards})
+print("mesh:", dict(mesh.shape))
+
+B, S, E, H = 4, 128 * seq_shards, 256, 8
+model = ff.FFModel(ff.FFConfig(batch_size=B))
+x = model.create_tensor((B, S, E), name="tokens")
+h = model.multihead_attention(x, x, x, embed_dim=E, num_heads=H,
+                              causal=True, seq_parallel=True)
+model.dense(h, E)
+model.compile(optimizer=ff.AdamOptimizer(1e-3),
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
+state = model.init()
+
+rng = np.random.default_rng(0)
+xs = rng.standard_normal((B, S, E)).astype(np.float32)
+ys = rng.standard_normal((B, S, E)).astype(np.float32)
+state, mets = model.train_step(state, {"tokens": xs}, ys)
+print(f"seq {S} over {seq_shards} shards: loss={float(mets['loss']):.4f}")

@@ -1,0 +1,27 @@
+"""SOAP strategy search on the DLRM graph: simulate, anneal, export
+(reference: --budget N --export file path through FFModel::optimize).
+"""
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.parallel.parallel_config import ParallelConfig, Strategy
+from dlrm_flexflow_tpu.sim import Simulator, mcmc_search
+
+cfg = DLRMConfig(sparse_feature_size=64, embedding_size=[1000000] * 8,
+                 embedding_bag_size=1, mlp_bot=[13, 512, 64],
+                 mlp_top=[64 * 8 + 64, 512, 1])
+model = build_dlrm(cfg, ff.FFConfig(batch_size=1024))
+
+num_devices = 8
+sim = Simulator(model, num_devices)
+dp = Strategy()
+for op in model.layers:
+    dp[op.name] = ParallelConfig.data_parallel(op.outputs[0].ndim,
+                                               num_devices)
+print(f"data-parallel: {sim.simulate(dp) * 1e3:.3f} ms/iter (simulated)")
+
+best = mcmc_search(model, num_devices, budget=500, seed=0, simulator=sim,
+                   verbose=True)
+print(f"searched     : {best.best_simulated_time * 1e3:.3f} ms/iter")
+best.save("/tmp/dlrm_searched_strategy.json")
+best.save("/tmp/dlrm_searched_strategy.pb")  # reference wire format
+print("exported /tmp/dlrm_searched_strategy.{json,pb}")

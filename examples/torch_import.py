@@ -1,0 +1,38 @@
+"""Import a PyTorch module via torch.fx and keep training it on TPU
+(reference: flexflow/torch/fx.py path)."""
+import numpy as np
+import torch
+import torch.nn as nn
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.frontends.torch_fx import PyTorchModel
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(32, 64)
+        self.fc2 = nn.Linear(64, 10)
+
+    def forward(self, x):
+        return self.fc2(torch.relu(self.fc1(x)))
+
+
+torch_model = Net()
+conv = PyTorchModel(torch_model)
+model = conv.apply(ff.FFConfig(batch_size=64), {"x": (32,)})
+model.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="sparse_categorical_crossentropy",
+              metrics=("accuracy",))
+state = model.init()
+state = conv.import_weights(model, state)  # numerics now match torch
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((64, 32)).astype(np.float32)
+out = model.forward(state, {"x": x})
+ref = torch_model(torch.from_numpy(x)).detach().numpy()
+print("max |tpu - torch| =", float(np.max(np.abs(np.asarray(out) - ref))))
+
+y = rng.integers(0, 10, size=(64, 1)).astype(np.int32)
+state, mets = model.train_step(state, {"x": x}, y)
+print("one train step, loss =", float(mets["loss"]))
