@@ -407,8 +407,16 @@ class FFModel:
             loss = self._loss_fn(preds, labels)
             return loss, (preds, new_bn)
 
+        # only Dropout consumes per-step randomness; skipping the split for
+        # deterministic graphs keeps the threefry kernel out of the hot loop
+        has_stochastic = any(isinstance(op, Dropout) and op.rate > 0.0
+                             for op in self.layers)
+
         def train_step(state: TrainState, inputs, labels):
-            rng, next_rng = jax.random.split(state.rng)
+            if has_stochastic:
+                rng, next_rng = jax.random.split(state.rng)
+            else:
+                rng, next_rng = None, state.rng
             grad_fn = jax.value_and_grad(loss_and_preds, has_aux=True)
             (loss, (preds, new_bn)), grads = grad_fn(
                 state.params, inputs, labels, rng, state.bn_state)
