@@ -10,31 +10,21 @@ ffcompile.sh analogue).
 from __future__ import annotations
 
 import ctypes
-import os
 import subprocess
 from typing import Dict, Optional
 
 import numpy as np
 
 _LIB: Optional[ctypes.CDLL] = None
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
-
-
-def _build_if_needed() -> str:
-    so = os.path.join(_NATIVE_DIR, "libffruntime.so")
-    src = os.path.join(_NATIVE_DIR, "ffruntime.cpp")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(src)):
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True)
-    return so
 
 
 def get_lib() -> ctypes.CDLL:
     global _LIB
     if _LIB is None:
-        lib = ctypes.CDLL(_build_if_needed())
+        from ..native_lib import load_native_lib
+
+        lib = load_native_lib("libffruntime.so", "ffruntime.cpp",
+                              "libffruntime.so")
         i64 = ctypes.c_int64
         p = ctypes.c_void_p
         lib.ff_embedding_bag_fwd_f32.argtypes = [p, p, p, i64, i64, i64,
@@ -58,7 +48,7 @@ def native_available() -> bool:
     try:
         get_lib()
         return True
-    except Exception:
+    except (OSError, subprocess.CalledProcessError):
         return False
 
 

@@ -65,13 +65,19 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
                 simulator: Optional[Simulator] = None,
                 seed: int = 0,
                 verbose: bool = False,
-                on_iteration: Optional[Callable] = None) -> Strategy:
+                on_iteration: Optional[Callable] = None,
+                backend: str = "auto") -> Strategy:
     """Simulated-annealing search (reference model.cc:1093-1144).
 
     Returns the best Strategy found; ``model.strategy`` is not mutated.
+
+    ``backend``: "native" runs the whole chain (DAG build + event sim +
+    annealing) in C++ (native/ffsim.cpp — the reference keeps this loop
+    in C++ too, model.cc:1093-1144); "python" forces the in-process
+    implementation; "auto" prefers native when the library builds and no
+    custom ``simulator``/``on_iteration`` hooks are requested.
     """
     rng = random.Random(seed)
-    sim = simulator or Simulator(model, num_devices)
 
     # start from data-parallel (reference model.cc:1102)
     current = Strategy()
@@ -85,6 +91,45 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
 
     candidates = {op.name: legal_configs(op, num_devices)
                   for op in model.layers}
+
+    if backend == "native" and on_iteration is not None:
+        raise ValueError("on_iteration callbacks require backend='python' "
+                         "(the native chain reports only the final best)")
+    want_native = (backend == "native"
+                   or (backend == "auto" and simulator is None
+                       and on_iteration is None))
+    if want_native:
+        import subprocess
+
+        from .native_sim import NativeSimulator
+
+        # start configs must be inside the candidate sets
+        full_cands = {name: list(cs) for name, cs in candidates.items()}
+        for op in model.layers:
+            pc = current[op.name]
+            if not any(tuple(c.dims) == tuple(pc.dims)
+                       for c in full_cands[op.name]):
+                full_cands[op.name].append(pc)
+        nsim = None
+        try:
+            nsim = NativeSimulator(
+                model, num_devices, full_cands,
+                cost_model=simulator.costs if simulator else None)
+        except (OSError, subprocess.CalledProcessError):
+            # build/load failure only — anything else is a real bug and
+            # propagates; without a toolchain fall back to Python
+            if backend == "native":
+                raise
+        if nsim is not None:
+            best, best_time = nsim.search(current, budget, alpha,
+                                          seed=seed)
+            nsim.close()
+            if verbose:
+                print(f"[search] native backend: best "
+                      f"{best_time*1e3:.3f} ms over {budget} iters")
+            return best
+
+    sim = simulator or Simulator(model, num_devices)
     ops = [op for op in model.layers if len(candidates[op.name]) > 1]
 
     def copy_strategy(s: Strategy) -> Strategy:

@@ -1,0 +1,330 @@
+// Native execution simulator + MCMC strategy search (C ABI, ctypes).
+//
+// TPU-native equivalent of the reference's C++ simulator/search stack
+// (reference: src/runtime/simulator.cc:275-448 event-driven SimTask
+// simulation; src/runtime/model.cc:1082-1144 FFModel::optimize MCMC loop).
+// The Python layer (dlrm_flexflow_tpu/sim/) measures per-op costs and
+// enumerates legal ParallelConfig candidates; this engine owns the hot
+// loop: per-iteration task-DAG construction + event simulation + the
+// annealing chain.  Semantics mirror sim/simulator.py exactly (same task
+// creation order, same tie-breaking, double math) so the two backends are
+// parity-testable.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+constexpr int MAXD = 8;
+
+struct Candidate {
+  int64_t dims[MAXD];    // partition counts, padded with 1
+  int64_t ndim;          // logical dims length (op output ndim)
+  int64_t num_parts;
+  double fwd, bwd;       // per-part times at this partitioning
+  std::vector<int64_t> devices;  // part -> device id
+};
+
+struct OpInfo {
+  int64_t ndim;
+  int64_t shape[MAXD];
+  double wbytes;
+  bool has_params;
+  std::vector<Candidate> cands;
+  int64_t task_base = 0;  // index of (fwd0, bwd0, ...) in the task arrays
+};
+
+struct Edge {
+  int64_t src, dst;
+  int64_t ndim;
+  int64_t shape[MAXD];
+};
+
+struct Task {
+  double run_time;
+  double ready_time;
+  int64_t device;
+  int64_t counter;
+  std::vector<int32_t> next;
+};
+
+struct Model {
+  int64_t num_devices;
+  std::vector<OpInfo> ops;
+  std::vector<Edge> edges;
+  double ici_bw, hbm_bw;
+  // scratch reused across simulate() calls
+  std::vector<Task> tasks;
+};
+
+struct Rect {
+  int64_t lo[MAXD], hi[MAXD];
+};
+
+// sim/simulator.py:_rect_of_part — little-endian part-index decomposition
+// over the tensor dims (reference N-D block partitioning, config.h:41-50).
+inline void rect_of_part(const Candidate& c, const int64_t* shape,
+                         int64_t ndim, int64_t idx, Rect* r) {
+  int64_t rem = idx;
+  for (int64_t d = 0; d < ndim; ++d) {
+    int64_t nd = d < MAXD ? c.dims[d] : 1;
+    int64_t coord = rem % nd;
+    rem /= nd;
+    int64_t sz = shape[d] / std::max<int64_t>(nd, 1);
+    r->lo[d] = coord * sz;
+    r->hi[d] = coord < nd - 1 ? (coord + 1) * sz : shape[d];
+  }
+}
+
+// sim/simulator.py:_overlap_bytes (reference
+// add_task_dependencies_with_xfer, simulator.cc:200-233)
+inline int64_t overlap_bytes(const Rect& a, const Rect& b, int64_t ndim) {
+  int64_t n = 4;
+  for (int64_t d = 0; d < ndim; ++d) {
+    int64_t inter =
+        std::min(a.hi[d], b.hi[d]) - std::max(a.lo[d], b.lo[d]);
+    if (inter <= 0) return 0;
+    n *= inter;
+  }
+  return n;
+}
+
+inline void add_dep(std::vector<Task>& tasks, int32_t from, int32_t to) {
+  tasks[from].next.push_back(to);
+  tasks[to].counter += 1;
+}
+
+// Build the SimTask DAG for one strategy (candidate index per op) and run
+// the event-driven simulation.  Mirrors sim/simulator.py:_build_tasks +
+// simulate (reference simulator.cc:275-448).
+double simulate(Model& m, const int64_t* cand_idx) {
+  auto& tasks = m.tasks;
+  tasks.clear();
+
+  auto new_task = [&](int64_t device, double rt) -> int32_t {
+    tasks.push_back(Task{rt, 0.0, device, 0, {}});
+    return static_cast<int32_t>(tasks.size() - 1);
+  };
+
+  // forward + backward per part; task ids are (base + 2*i) fwd,
+  // (base + 2*i + 1) bwd — matching the Python append order
+  for (auto& op : m.ops) {
+    const Candidate& c = op.cands[cand_idx[&op - m.ops.data()]];
+    op.task_base = static_cast<int64_t>(tasks.size());
+    for (int64_t i = 0; i < c.num_parts; ++i) {
+      int64_t dev = c.devices[i] % m.num_devices;
+      new_task(dev, c.fwd);
+      new_task(dev, c.bwd);
+    }
+  }
+
+  auto fwd_of = [&](int64_t op, int64_t part) -> int32_t {
+    return static_cast<int32_t>(m.ops[op].task_base + 2 * part);
+  };
+  auto bwd_of = [&](int64_t op, int64_t part) -> int32_t {
+    return static_cast<int32_t>(m.ops[op].task_base + 2 * part + 1);
+  };
+
+  // dependencies + comm tasks from tensor-rectangle intersections,
+  // then fwd(op) -> bwd(op), in the same op order as the Python build
+  size_t edge_cursor = 0;
+  for (int64_t oi = 0; oi < static_cast<int64_t>(m.ops.size()); ++oi) {
+    const Candidate& dst_c = m.ops[oi].cands[cand_idx[oi]];
+    // edges are serialized grouped by destination op in input order
+    while (edge_cursor < m.edges.size() &&
+           m.edges[edge_cursor].dst == oi) {
+      const Edge& e = m.edges[edge_cursor++];
+      const Candidate& src_c = m.ops[e.src].cands[cand_idx[e.src]];
+      Rect dr, sr;
+      for (int64_t di = 0; di < dst_c.num_parts; ++di) {
+        rect_of_part(dst_c, e.shape, e.ndim, di, &dr);
+        for (int64_t si = 0; si < src_c.num_parts; ++si) {
+          rect_of_part(src_c, e.shape, e.ndim, si, &sr);
+          int64_t nbytes = overlap_bytes(sr, dr, e.ndim);
+          if (nbytes == 0) continue;
+          int64_t sdev = src_c.devices[si] % m.num_devices;
+          int64_t ddev = dst_c.devices[di] % m.num_devices;
+          int32_t sf = fwd_of(e.src, si), df = fwd_of(oi, di);
+          int32_t sb = bwd_of(e.src, si), db = bwd_of(oi, di);
+          if (sdev == ddev) {
+            add_dep(tasks, sf, df);
+            add_dep(tasks, db, sb);
+          } else {
+            double ct = static_cast<double>(nbytes) / m.ici_bw;
+            int32_t cf = new_task(ddev, ct);
+            add_dep(tasks, sf, cf);
+            add_dep(tasks, cf, df);
+            int32_t cb = new_task(sdev, ct);
+            add_dep(tasks, db, cb);
+            add_dep(tasks, cb, sb);
+          }
+        }
+      }
+    }
+    for (int64_t i = 0; i < dst_c.num_parts; ++i)
+      add_dep(tasks, fwd_of(oi, i), bwd_of(oi, i));
+  }
+
+  // weight synchronization (reference simulator.cc:327-408): ring
+  // all-reduce over the data-dim replicas + one update task
+  for (int64_t oi = 0; oi < static_cast<int64_t>(m.ops.size()); ++oi) {
+    OpInfo& op = m.ops[oi];
+    if (!op.has_params) continue;
+    const Candidate& c = op.cands[cand_idx[oi]];
+    int64_t k = c.num_parts;
+    int64_t replicas = c.ndim > 0 ? c.dims[0] : 1;
+    double shard =
+        op.wbytes /
+        static_cast<double>(std::max<int64_t>(
+            k / std::max<int64_t>(replicas, 1), 1));
+    double ar = 0.0;
+    if (replicas > 1)
+      ar = (2.0 * static_cast<double>(replicas - 1) /
+            static_cast<double>(replicas) * shard) /
+           m.ici_bw;
+    double rt = ar + (2.0 * shard) / m.hbm_bw;
+    int32_t upd = new_task(c.devices[0], rt);
+    for (int64_t i = 0; i < k; ++i) add_dep(tasks, bwd_of(oi, i), upd);
+  }
+
+  // event-driven simulation over per-device timelines (reference
+  // simulator.cc:410-447); heap ordered by (ready_time, insertion seq)
+  std::priority_queue<std::pair<double, std::pair<int64_t, int32_t>>,
+                      std::vector<std::pair<double, std::pair<int64_t,
+                                                             int32_t>>>,
+                      std::greater<>>
+      ready;
+  std::vector<double> device_free(m.num_devices, 0.0);
+  int64_t seq = 0;
+  for (int32_t t = 0; t < static_cast<int32_t>(tasks.size()); ++t)
+    if (tasks[t].counter == 0)
+      ready.push({tasks[t].ready_time, {seq++, t}});
+  size_t done = 0;
+  double makespan = 0.0;
+  while (!ready.empty()) {
+    auto [rt, st] = ready.top();
+    ready.pop();
+    Task& t = tasks[st.second];
+    int64_t dev = t.device >= 0 ? t.device % m.num_devices : 0;
+    double start = std::max(rt, device_free[dev]);
+    double end = start + t.run_time;
+    device_free[dev] = end;
+    makespan = std::max(makespan, end);
+    ++done;
+    for (int32_t ni : t.next) {
+      Task& n = tasks[ni];
+      n.counter -= 1;
+      n.ready_time = std::max(n.ready_time, end);
+      if (n.counter == 0) ready.push({n.ready_time, {seq++, ni}});
+    }
+  }
+  if (done != tasks.size()) return -1.0;  // dependency cycle
+  return makespan;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ffsim_create(int64_t num_ops, int64_t num_devices,
+                   const int64_t* op_ndim, const int64_t* op_shape,
+                   const double* op_wbytes, const int32_t* op_has_params,
+                   const int64_t* cand_off, const int64_t* cand_cnt,
+                   const int64_t* cand_dims, const double* cand_fwd,
+                   const double* cand_bwd, const int64_t* cand_dev_off,
+                   const int64_t* cand_dev_pool, int64_t num_edges,
+                   const int64_t* edge_src, const int64_t* edge_dst,
+                   const int64_t* edge_ndim, const int64_t* edge_shape,
+                   double ici_bw, double hbm_bw) {
+  Model* m = new Model();
+  m->num_devices = num_devices;
+  m->ici_bw = ici_bw;
+  m->hbm_bw = hbm_bw;
+  m->ops.resize(num_ops);
+  for (int64_t i = 0; i < num_ops; ++i) {
+    OpInfo& op = m->ops[i];
+    op.ndim = op_ndim[i];
+    std::memcpy(op.shape, op_shape + i * MAXD, sizeof(op.shape));
+    op.wbytes = op_wbytes[i];
+    op.has_params = op_has_params[i] != 0;
+    op.cands.resize(cand_cnt[i]);
+    for (int64_t j = 0; j < cand_cnt[i]; ++j) {
+      int64_t g = cand_off[i] + j;
+      Candidate& c = op.cands[j];
+      std::memcpy(c.dims, cand_dims + g * MAXD, sizeof(c.dims));
+      c.ndim = op.ndim;
+      c.num_parts = 1;
+      for (int d = 0; d < MAXD; ++d) c.num_parts *= c.dims[d];
+      c.fwd = cand_fwd[g];
+      c.bwd = cand_bwd[g];
+      c.devices.assign(cand_dev_pool + cand_dev_off[g],
+                       cand_dev_pool + cand_dev_off[g] + c.num_parts);
+    }
+  }
+  m->edges.resize(num_edges);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    m->edges[e].src = edge_src[e];
+    m->edges[e].dst = edge_dst[e];
+    m->edges[e].ndim = edge_ndim[e];
+    std::memcpy(m->edges[e].shape, edge_shape + e * MAXD,
+                sizeof(m->edges[e].shape));
+  }
+  return m;
+}
+
+double ffsim_simulate(void* handle, const int64_t* cand_idx) {
+  return simulate(*static_cast<Model*>(handle), cand_idx);
+}
+
+// MCMC simulated-annealing search (reference FFModel::optimize,
+// model.cc:1093-1144): random single-op rewrite, accept with prob
+// exp(-alpha * delta_ms), keep the best strategy seen.
+double ffsim_search(void* handle, const int64_t* start, int64_t budget,
+                    double alpha, uint64_t seed, int64_t* best_out,
+                    int64_t* accepted_out) {
+  Model& m = *static_cast<Model*>(handle);
+  int64_t n = static_cast<int64_t>(m.ops.size());
+  std::vector<int64_t> current(start, start + n), best(start, start + n);
+  std::vector<int64_t> mutable_ops;
+  for (int64_t i = 0; i < n; ++i)
+    if (m.ops[i].cands.size() > 1) mutable_ops.push_back(i);
+
+  double current_time = simulate(m, current.data());
+  double best_time = current_time;
+  int64_t accepted = 0;
+  if (!mutable_ops.empty()) {
+    std::mt19937_64 rng(seed);
+    std::uniform_real_distribution<double> unif(0.0, 1.0);
+    for (int64_t it = 0; it < budget; ++it) {
+      int64_t oi = mutable_ops[rng() % mutable_ops.size()];
+      int64_t prev = current[oi];
+      current[oi] =
+          static_cast<int64_t>(rng() % m.ops[oi].cands.size());
+      double t = simulate(m, current.data());
+      double delta = t - current_time;
+      if (t >= 0.0 &&
+          (delta <= 0.0 || unif(rng) < std::exp(-alpha * delta * 1e3))) {
+        current_time = t;
+        ++accepted;
+        if (t < best_time) {
+          best_time = t;
+          best = current;
+        }
+      } else {
+        current[oi] = prev;
+      }
+    }
+  }
+  std::copy(best.begin(), best.end(), best_out);
+  if (accepted_out) *accepted_out = accepted;
+  return best_time;
+}
+
+void ffsim_destroy(void* handle) { delete static_cast<Model*>(handle); }
+
+}  // extern "C"
