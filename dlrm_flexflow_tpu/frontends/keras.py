@@ -272,13 +272,31 @@ class BaseModel:
             return dict(zip(self._input_names, x))
         return {self._input_names[0]: x}
 
-    def fit(self, x, y, epochs: int = 1, verbose: bool = True):
-        """reference base_model.py:194 fit -> _train loop :367."""
+    def fit(self, x, y, epochs: int = 1, verbose: bool = True,
+            callbacks=None):
+        """reference base_model.py:194 fit -> _train loop :367 (callback
+        hooks included)."""
         inputs = self._as_input_dict(x)
         loader = ArrayDataLoader(inputs, np.asarray(y), self.batch_size)
-        self.state, thpt = self.ffmodel.fit(self.state, loader,
-                                            epochs=epochs, verbose=verbose)
+        for cb in callbacks or []:
+            cb.set_model(self)  # callbacks see the keras-level model
+        try:
+            self.state, thpt = self.ffmodel.fit(self.state, loader,
+                                                epochs=epochs,
+                                                verbose=verbose,
+                                                callbacks=callbacks)
+        except Exception:
+            # keep the trained weights even when a verify callback raises
+            if self.ffmodel._fit_state is not None:
+                self.state = self.ffmodel._fit_state
+            raise
         return thpt
+
+    def set_learning_rate(self, lr: float):
+        """Apply a new learning rate to the held training state (used by
+        LearningRateScheduler outside a running fit)."""
+        self.state = self.ffmodel.set_learning_rate(self.state, lr)
+        self.ffmodel.optimizer.lr = float(lr)
 
     def evaluate(self, x, y):
         inputs = self._as_input_dict(x)
@@ -358,3 +376,18 @@ class Model(BaseModel):
 
         for out in self._outputs:
             visit(out)
+
+
+# ---------------------------------------------------------------- submodules
+# keras-style namespaces (reference python/flexflow/keras/{callbacks,
+# datasets, preprocessing, utils}) so user code reads the same:
+#   keras.callbacks.LearningRateScheduler, keras.datasets.mnist.load_data,
+#   keras.preprocessing.sequence.pad_sequences, keras.utils.to_categorical
+import types as _types
+
+from . import keras_callbacks as callbacks  # noqa: E402
+from . import keras_datasets as datasets  # noqa: E402
+from . import keras_utils as utils  # noqa: E402
+
+preprocessing = _types.SimpleNamespace(
+    sequence=_types.SimpleNamespace(pad_sequences=utils.pad_sequences))

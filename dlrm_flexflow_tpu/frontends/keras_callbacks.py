@@ -1,0 +1,110 @@
+"""Keras-compatible training callbacks.
+
+TPU-native equivalent of the reference callback set (reference:
+python/flexflow/keras/callbacks.py:21-90 — Callback base,
+LearningRateScheduler, VerifyMetrics, EpochVerifyMetrics) driven by the
+hook protocol of ``FFModel.fit`` / keras ``BaseModel.fit`` (reference
+base_model.py:367-420).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Callback:
+    """reference callbacks.py:21-47."""
+
+    def __init__(self):
+        self.model = None
+        self.params = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+
+def _ffmodel_of(model):
+    """Callbacks may be attached to a keras BaseModel (which wraps an
+    FFModel) or to an FFModel directly."""
+    return getattr(model, "ffmodel", None) or model
+
+
+class LearningRateScheduler(Callback):
+    """Set lr from ``schedule(epoch)`` at each epoch start (reference
+    callbacks.py:49-62).  The new rate lands in the optimizer state, so
+    the jitted train step picks it up without recompiling."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        ff = _ffmodel_of(self.model)
+        if not hasattr(ff.optimizer, "lr"):
+            raise ValueError('Optimizer must have a "lr" attribute.')
+        lr = self.schedule(epoch)
+        if not isinstance(lr, (float, np.float32, np.float64)):
+            raise ValueError('The output of the "schedule" function '
+                             'should be float.')
+        ff.schedule_learning_rate(lr)
+        ff.optimizer.lr = float(lr)  # visible via introspection
+        print("set learning rate ", lr)
+
+
+def _target_value(accuracy) -> float:
+    """Accept either a plain float or an enum-like with .value
+    (reference passes ModelAccuracy enum members)."""
+    return float(getattr(accuracy, "value", accuracy))
+
+
+class VerifyMetrics(Callback):
+    """Assert final training accuracy >= target (reference
+    callbacks.py:64-73)."""
+
+    def __init__(self, accuracy):
+        super().__init__()
+        self.accuracy = _target_value(accuracy)
+
+    def on_train_end(self, logs=None):
+        acc = _ffmodel_of(self.model).get_perf_metrics().get_accuracy()
+        assert acc >= self.accuracy, (
+            f"Accuracy is wrong: {acc:.2f} < {self.accuracy:.2f}")
+
+
+class EpochVerifyMetrics(Callback):
+    """Early-stop once the per-epoch accuracy passes the target
+    (reference callbacks.py:75-90)."""
+
+    def __init__(self, accuracy, early_stop=True):
+        super().__init__()
+        self.accuracy = _target_value(accuracy)
+        self.early_stop = early_stop
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.early_stop:
+            return False
+        acc = _ffmodel_of(self.model).get_perf_metrics().get_accuracy()
+        # >= (not the reference's strict >) for consistency with
+        # VerifyMetrics' pass condition
+        return acc >= self.accuracy

@@ -1,0 +1,121 @@
+"""Keras-compatible utils + preprocessing.
+
+TPU-native equivalents of the reference's keras utility surface
+(reference: python/flexflow/keras/utils/np_utils.py:9-70 to_categorical/
+normalize; utils/data_utils.py:123-303 get_file/validate_file and the
+``Sequence`` batch-source protocol :305-340; preprocessing/sequence.py
+pad_sequences re-export).
+
+``get_file`` is local-cache only: this environment has no network
+egress, so a missing cache entry raises with instructions instead of
+downloading.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Sequence as _Seq
+
+import numpy as np
+
+
+# ------------------------------------------------------------------ np_utils
+def to_categorical(y, num_classes: Optional[int] = None, dtype="float32"):
+    """Class vector -> one-hot matrix (reference np_utils.py:9-56)."""
+    y = np.asarray(y, dtype="int64").ravel()
+    if not num_classes:
+        num_classes = int(np.max(y)) + 1
+    out = np.zeros((y.shape[0], num_classes), dtype=dtype)
+    out[np.arange(y.shape[0]), y] = 1
+    return out
+
+
+def normalize(x, axis=-1, order=2):
+    """L-``order`` normalization along ``axis`` (reference
+    np_utils.py:58-70)."""
+    x = np.asarray(x, dtype="float64")
+    norm = np.atleast_1d(np.linalg.norm(x, order, axis))
+    norm[norm == 0] = 1
+    return x / np.expand_dims(norm, axis)
+
+
+# ------------------------------------------------------------- preprocessing
+def pad_sequences(sequences, maxlen: Optional[int] = None, dtype="int32",
+                  padding="pre", truncating="pre", value=0.0):
+    """Pad/truncate variable-length sequences into a dense (n, maxlen)
+    array (the keras_preprocessing function the reference re-exports via
+    preprocessing/sequence.py)."""
+    lengths = [len(s) for s in sequences]
+    if maxlen is None:
+        maxlen = max(lengths) if lengths else 0
+    out = np.full((len(sequences), maxlen), value, dtype=dtype)
+    for i, s in enumerate(sequences):
+        if not len(s):
+            continue
+        if truncating == "pre":
+            trunc = s[-maxlen:]
+        elif truncating == "post":
+            trunc = s[:maxlen]
+        else:
+            raise ValueError(f"unknown truncating {truncating!r}")
+        trunc = np.asarray(trunc, dtype=dtype)
+        if padding == "post":
+            out[i, :len(trunc)] = trunc
+        elif padding == "pre":
+            out[i, -len(trunc):] = trunc
+        else:
+            raise ValueError(f"unknown padding {padding!r}")
+    return out
+
+
+# --------------------------------------------------------------- data_utils
+def _hash_file(fpath, algorithm="sha256", chunk_size=65535):
+    """reference data_utils.py:247-277."""
+    hasher = hashlib.sha256() if algorithm == "sha256" else hashlib.md5()
+    with open(fpath, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_size), b""):
+            hasher.update(chunk)
+    return hasher.hexdigest()
+
+
+def validate_file(fpath, file_hash, algorithm="auto", chunk_size=65535):
+    """reference data_utils.py:279-303."""
+    if algorithm == "auto":
+        algorithm = "sha256" if len(str(file_hash)) == 64 else "md5"
+    return _hash_file(fpath, algorithm, chunk_size) == str(file_hash)
+
+
+def get_file(fname, origin=None, cache_subdir="datasets",
+             cache_dir=None, file_hash=None, **_ignored):
+    """Resolve a dataset file from the local keras cache (reference
+    data_utils.py:123-245).  No-egress environment: if the file is not
+    already cached, raise with the manual-download instruction instead
+    of fetching ``origin``."""
+    cache_dir = cache_dir or os.path.join(os.path.expanduser("~"), ".keras")
+    path = os.path.join(cache_dir, cache_subdir, fname)
+    if os.path.exists(path):
+        if file_hash and not validate_file(path, file_hash):
+            raise IOError(f"{path} exists but its hash does not match")
+        return path
+    raise FileNotFoundError(
+        f"{path} not found and this environment has no network access; "
+        f"place the file there manually (origin: {origin})")
+
+
+class Sequence:
+    """Batch-source protocol (reference data_utils.py:305-340): implement
+    __getitem__(batch_idx) -> (x, y) and __len__."""
+
+    def __getitem__(self, index):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def on_epoch_end(self):
+        pass
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]

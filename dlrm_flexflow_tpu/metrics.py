@@ -85,9 +85,13 @@ class MetricsAccumulator:
         for k, v in batch_metrics.items():
             self.totals[k] = self.totals.get(k, 0.0) + v
 
-    def report(self) -> str:
+    def _finalized(self):
+        """Host-sync totals; returns (totals, normalizer)."""
         self.totals = {k: float(v) for k, v in self.totals.items()}
-        n = max(self.totals.get("train_all", 0.0), 1.0)
+        return self.totals, max(self.totals.get("train_all", 0.0), 1.0)
+
+    def report(self) -> str:
+        _, n = self._finalized()
         parts = []
         if "train_correct" in self.totals:
             parts.append(
@@ -104,3 +108,9 @@ class MetricsAccumulator:
         if "mae" in self.totals:
             parts.append(f"mae_loss: {self.totals['mae'] / n:.3f}")
         return "[Metrics] " + " ".join(parts) if parts else "[Metrics] (none)"
+
+    def get_accuracy(self) -> float:
+        """Training accuracy in percent (reference
+        PerfMetrics::get_accuracy used by VerifyMetrics callbacks)."""
+        totals, n = self._finalized()
+        return 100.0 * totals.get("train_correct", 0.0) / n

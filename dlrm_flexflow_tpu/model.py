@@ -91,6 +91,9 @@ class FFModel:
         self._eval_step = None
         self._forward_fn = None
         self._hetero_ops: List[Op] = []
+        self._last_metrics = MetricsAccumulator(())
+        self._pending_lr: Optional[float] = None
+        self._fit_state: Optional[TrainState] = None
 
     # ------------------------------------------------------------------ utils
     def _name(self, base: str, name: Optional[str] = None) -> str:
@@ -524,11 +527,11 @@ class FFModel:
         # optimizer slots mirror their parameter's sharding
         def place_opt(x):
             if isinstance(x, dict) and set(x) >= {"step"}:
-                out = {"step": jax.device_put(x["step"])}
-                for slot in ("m", "v"):
-                    if slot in x:
-                        out[slot] = place_params(x[slot])
-                return out
+                # m/v slots mirror the parameter shardings; every other
+                # entry (step, lr, ...) is a replicated scalar
+                return {k: (place_params(v) if k in ("m", "v")
+                            else jax.device_put(v))
+                        for k, v in x.items()}
             return x
 
         opt_state = place_opt(state.opt_state)
@@ -599,15 +602,63 @@ class FFModel:
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
         return self._forward_fn(state.params, inputs, state.bn_state)
 
+    def set_learning_rate(self, state: TrainState, lr: float) -> TrainState:
+        """Return a state with the optimizer learning rate replaced (lr
+        lives in opt_state so jitted steps pick it up without recompile;
+        states from older checkpoints gain the key here).  Also syncs
+        ``optimizer.lr`` so host-side updates (hetero CPU tables) follow."""
+        opt = dict(state.opt_state)
+        opt["lr"] = jnp.asarray(lr, jnp.float32)
+        if self.optimizer is not None:
+            self.optimizer.lr = float(lr)
+        return TrainState(state.params, opt, state.bn_state, state.rng,
+                          state.step)
+
+    def schedule_learning_rate(self, lr: float):
+        """Request an lr change to be applied at the next epoch boundary of
+        a running ``fit`` (the hook LearningRateScheduler callbacks use)."""
+        self._pending_lr = float(lr)
+
+    def get_perf_metrics(self) -> MetricsAccumulator:
+        """Running metrics of the current/last ``fit`` epoch (reference
+        ffmodel.get_perf_metrics, flexflow_cbinding.py)."""
+        return self._last_metrics
+
     def fit(self, state: TrainState, dataloader, epochs: Optional[int] = None,
-            verbose: bool = True) -> Tuple[TrainState, float]:
+            verbose: bool = True, callbacks=None) -> Tuple[TrainState, float]:
         """Epoch loop with the reference's timing protocol: fence, warmup
         epoch outside timing, throughput print (dlrm.cc:154-198).
+
+        ``callbacks``: keras-style objects (frontends.keras_callbacks) —
+        the hook protocol of reference base_model.py:367-420, including
+        early stop when on_epoch_end returns True.
 
         Returns (state, samples_per_second).
         """
         epochs = epochs or self.config.epochs
         acc = MetricsAccumulator(self.metrics)
+        self._last_metrics = acc
+        self._pending_lr = None
+        cbs = list(callbacks or [])
+        self._fit_state = state  # survives callback exceptions (keras fit)
+        for cb in cbs:
+            if getattr(cb, "model", None) is None:
+                cb.set_model(self)
+            cb.on_train_begin()
+
+        def apply_pending_lr(state):
+            if self._pending_lr is not None:
+                state = self.set_learning_rate(state, self._pending_lr)
+                self._pending_lr = None
+            return state
+
+        # epoch-0 hooks fire BEFORE the warmup step so a scheduled epoch-0
+        # lr governs the very first update (warmup trains on the first
+        # batch, like the reference's untimed epoch 0, dlrm.cc:178)
+        if epochs > 0:
+            for cb in cbs:
+                cb.on_epoch_begin(0)
+            state = apply_pending_lr(state)
         # warmup/compile batch
         first = dataloader.peek()
         state, _ = self.train_step(state, first[0], first[1])
@@ -615,18 +666,44 @@ class FFModel:
         t0 = time.perf_counter()
         samples = 0
         for epoch in range(epochs):
+            if epoch > 0:
+                for cb in cbs:
+                    cb.on_epoch_begin(epoch)
+                state = apply_pending_lr(state)
             acc.reset()
-            for inputs, labels in dataloader:
+            for it, (inputs, labels) in enumerate(dataloader):
+                for cb in cbs:
+                    cb.on_batch_begin(it)
                 state, mets = self.train_step(state, inputs, labels)
                 samples += int(labels.shape[0])
                 acc.update({k: v for k, v in mets.items() if k != "loss"})
+                for cb in cbs:
+                    cb.on_batch_end(it)
+            self._fit_state = state
             if verbose:
                 print(f"epoch {epoch}: {acc.report()}")
+            early_stop = False
+            for cb in cbs:
+                if cb.on_epoch_end(epoch) is True:
+                    early_stop = True
+            if early_stop:
+                print(f"Accuracy reached, early stop, epoch: {epoch}")
+                break
         jax.block_until_ready(state.params)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
         if verbose:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thpt:.2f} samples/s")
+        # trained state is recoverable even if a verify callback raises
+        self._fit_state = state
+        err = None
+        for cb in cbs:
+            try:
+                cb.on_train_end()
+            except Exception as e:  # run every hook, re-raise the first
+                err = err or e
+        if err is not None:
+            raise err
         return state, thpt
 
     # ---------------------------------------------- weights IO (checkpointing)

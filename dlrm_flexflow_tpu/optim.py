@@ -47,20 +47,26 @@ class SGDOptimizer(Optimizer):
         self.weight_decay = weight_decay
 
     def init(self, params):
+        # lr lives in the state so schedules can change it between steps
+        # without recompiling the jitted update (the reference mutates the
+        # host-side optimizer object, optimizer.cc SGDOptimizer fields)
+        base = {"step": jnp.zeros((), jnp.int32),
+                "lr": jnp.asarray(self.lr, jnp.float32)}
         if self.momentum == 0.0:
-            return {"step": jnp.zeros((), jnp.int32)}
-        return {"step": jnp.zeros((), jnp.int32),
-                "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+            return base
+        base["v"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return base
 
     def update(self, params, grads, opt_state):
-        lr, mu, wd = self.lr, self.momentum, self.weight_decay
+        mu, wd = self.momentum, self.weight_decay
+        lr = opt_state.get("lr", self.lr)
 
         if mu == 0.0:
             def upd(w, g):
                 gt = g + wd * w
                 return w - lr * gt
             new_params = jax.tree_util.tree_map(upd, params, grads)
-            return new_params, {"step": opt_state["step"] + 1}
+            return new_params, {**opt_state, "step": opt_state["step"] + 1}
 
         def upd(w, g, v):
             gt = g + wd * w
@@ -73,7 +79,8 @@ class SGDOptimizer(Optimizer):
                                             is_leaf=lambda t: isinstance(t, tuple))
         new_v = jax.tree_util.tree_map(lambda t: t[1], flat,
                                        is_leaf=lambda t: isinstance(t, tuple))
-        return new_params, {"step": opt_state["step"] + 1, "v": new_v}
+        return new_params, {**opt_state, "step": opt_state["step"] + 1,
+                            "v": new_v}
 
 
 class AdamOptimizer(Optimizer):
@@ -95,11 +102,14 @@ class AdamOptimizer(Optimizer):
 
     def init(self, params):
         zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
-        return {"step": jnp.zeros((), jnp.int32), "m": zeros(), "v": zeros()}
+        return {"step": jnp.zeros((), jnp.int32),
+                "lr": jnp.asarray(self.lr, jnp.float32),
+                "m": zeros(), "v": zeros()}
 
     def update(self, params, grads, opt_state):
-        b1, b2, lr, wd, eps = (self.beta1, self.beta2, self.lr,
-                               self.weight_decay, self.epsilon)
+        b1, b2, wd, eps = (self.beta1, self.beta2,
+                           self.weight_decay, self.epsilon)
+        lr = opt_state.get("lr", self.lr)
         t = opt_state["step"] + 1
         tf = t.astype(jnp.float32)
         alpha_t = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
@@ -115,4 +125,5 @@ class AdamOptimizer(Optimizer):
                                       opt_state["m"], opt_state["v"])
         pick = lambda i: jax.tree_util.tree_map(
             lambda tpl: tpl[i], flat, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), {"step": t, "m": pick(1), "v": pick(2)}
+        return pick(0), {**opt_state, "step": t, "m": pick(1),
+                         "v": pick(2)}

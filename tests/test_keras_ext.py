@@ -1,0 +1,184 @@
+"""Keras extras: callbacks, lr scheduling, np_utils/preprocessing,
+datasets (reference python/flexflow/keras/{callbacks.py, utils/,
+preprocessing/, datasets/})."""
+
+import numpy as np
+import pytest
+
+from dlrm_flexflow_tpu.frontends import keras
+from dlrm_flexflow_tpu.frontends.keras import Dense, Input, Sequential
+
+
+def small_model(batch=16, classes=4):
+    m = Sequential([Input((8,)), Dense(16, activation="relu"),
+                    Dense(classes)])
+    m.compile(optimizer="sgd", loss="categorical_crossentropy",
+              metrics=("accuracy",), batch_size=batch)
+    return m
+
+
+def xy(batch=16, classes=4, n=64):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = keras.utils.to_categorical(rng.integers(0, classes, size=n),
+                                   classes)
+    return x, y
+
+
+class TestCallbacks:
+    def test_hooks_fire_in_order(self):
+        events = []
+
+        class Recorder(keras.callbacks.Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch_begin{epoch}")
+
+            def on_batch_begin(self, batch, logs=None):
+                events.append("batch_begin")
+
+            def on_batch_end(self, batch, logs=None):
+                events.append("batch_end")
+
+            def on_epoch_end(self, epoch, logs=None):
+                events.append(f"epoch_end{epoch}")
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        m = small_model()
+        x, y = xy()
+        m.fit(x, y, epochs=2, verbose=False, callbacks=[Recorder()])
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert "epoch_begin0" in events and "epoch_end1" in events
+        assert events.index("epoch_begin0") < events.index("batch_begin")
+
+    def test_learning_rate_scheduler_updates_state(self):
+        m = small_model()
+        x, y = xy()
+        sched = keras.callbacks.LearningRateScheduler(
+            lambda epoch: 0.1 / (epoch + 1))
+        m.fit(x, y, epochs=3, verbose=False, callbacks=[sched])
+        # after epoch 2 the state lr must be 0.1/3
+        assert float(m.state.opt_state["lr"]) == pytest.approx(0.1 / 3)
+        assert m.ffmodel.optimizer.lr == pytest.approx(0.1 / 3)
+
+    def test_lr_schedule_changes_updates_without_recompile(self):
+        """lr lives in opt_state: a changed rate must affect the next
+        step's magnitude with the same jitted fn."""
+        m = small_model()
+        x, y = xy()
+        m.fit(x, y, epochs=1, verbose=False)
+        w0 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel").copy()
+        m.set_learning_rate(0.0)
+        m.fit(x, y, epochs=1, verbose=False)
+        w1 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel")
+        np.testing.assert_allclose(w0, w1)  # lr=0 -> no movement
+
+    def test_verify_metrics_raises_on_low_accuracy(self):
+        m = small_model()
+        x, y = xy()
+        w0 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel").copy()
+        with pytest.raises(AssertionError):
+            m.fit(x, y, epochs=1, verbose=False,
+                  callbacks=[keras.callbacks.VerifyMetrics(101.0)])
+        # trained weights survive the verify failure
+        w1 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel")
+        assert not np.allclose(w0, w1)
+
+    def test_epoch0_schedule_governs_warmup_step(self):
+        """schedule(0)=0 must freeze even the warmup/compile step."""
+        m = small_model()
+        x, y = xy()
+        w0 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel").copy()
+        sched = keras.callbacks.LearningRateScheduler(lambda e: 0.0)
+        m.fit(x, y, epochs=1, verbose=False, callbacks=[sched])
+        w1 = m.ffmodel.get_weights(m.state, m.ffmodel.layers[0].name,
+                                   "kernel")
+        np.testing.assert_allclose(w0, w1)
+
+    def test_epoch_verify_early_stops(self):
+        m = small_model()
+        x, y = xy()
+        seen = []
+
+        class Counter(keras.callbacks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                seen.append(epoch)
+
+        # accuracy target -1 -> first epoch always passes -> early stop
+        m.fit(x, y, epochs=5, verbose=False,
+              callbacks=[Counter(),
+                         keras.callbacks.EpochVerifyMetrics(-1.0)])
+        assert seen == [0]
+
+
+class TestNpUtils:
+    def test_to_categorical(self):
+        y = keras.utils.to_categorical([0, 2, 1], 3)
+        np.testing.assert_array_equal(
+            y, [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+    def test_to_categorical_infers_classes(self):
+        assert keras.utils.to_categorical([1, 3]).shape == (2, 4)
+
+    def test_normalize(self):
+        x = np.array([[3.0, 4.0]])
+        np.testing.assert_allclose(keras.utils.normalize(x),
+                                   [[0.6, 0.8]])
+
+    def test_pad_sequences_pre_post(self):
+        seqs = [[1, 2], [3]]
+        np.testing.assert_array_equal(
+            keras.preprocessing.sequence.pad_sequences(seqs, maxlen=3),
+            [[0, 1, 2], [0, 0, 3]])
+        np.testing.assert_array_equal(
+            keras.preprocessing.sequence.pad_sequences(
+                seqs, maxlen=3, padding="post"),
+            [[1, 2, 0], [3, 0, 0]])
+        np.testing.assert_array_equal(
+            keras.preprocessing.sequence.pad_sequences(
+                [[1, 2, 3, 4]], maxlen=2),
+            [[3, 4]])
+
+
+class TestDatasets:
+    def test_mnist_shapes(self):
+        (x, y), (xt, yt) = keras.datasets.mnist.load_data()
+        assert x.shape == (60000, 28, 28) and x.dtype == np.uint8
+        assert xt.shape == (10000, 28, 28)
+        assert y.shape == (60000,)
+
+    def test_cifar10_shapes(self):
+        (x, y), (xt, yt) = keras.datasets.cifar10.load_data(
+            num_samples=20000)
+        assert x.shape == (20000, 3, 32, 32) and x.dtype == np.uint8
+        assert y.shape == (20000, 1)
+
+    def test_reuters_split_and_vocab(self):
+        (x, y), (xt, yt) = keras.datasets.reuters.load_data(
+            num_words=1000, test_split=0.2)
+        assert len(x) + len(xt) > 0
+        assert abs(len(xt) / (len(x) + len(xt)) - 0.2) < 0.01
+        assert max(max(s) for s in x if len(s)) < 1000
+        assert 0 <= min(y) and max(y) < 46
+        idx = keras.datasets.reuters.get_word_index()
+        assert isinstance(idx, dict) and idx
+
+    def test_trains_on_mnist_subset(self):
+        (x, y), _ = keras.datasets.mnist.load_data()
+        x = (x[:256].reshape(256, 784) / 255.0).astype(np.float32)
+        y = keras.utils.to_categorical(y[:256], 10)
+        m = Sequential([Input((784,)), Dense(32, activation="relu"),
+                        Dense(10)])
+        m.compile(optimizer="sgd", loss="categorical_crossentropy",
+                  metrics=("accuracy",), batch_size=64)
+        thpt = m.fit(x, y, epochs=1, verbose=False)
+        assert thpt > 0
