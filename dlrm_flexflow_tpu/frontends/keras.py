@@ -29,10 +29,18 @@ from ..data.loader import ArrayDataLoader
 
 
 class Layer:
-    """Declarative layer node; ``lower(model, inputs)`` emits core ops."""
+    """Declarative layer node; ``lower(model, inputs)`` emits core ops.
 
-    def __init__(self, name: Optional[str] = None):
+    ``input_shape`` on the first layer of a Sequential replaces an explicit
+    Input (reference keras/layers/base_layer accepts it the same way).
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 dtype: str = "float32", **_ignored):
         self.name = name
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.input_dtype = dtype
         self._inbound: List["Layer"] = []
         self._node: Optional[object] = None  # symbolic KTensor
 
@@ -76,15 +84,21 @@ def InputTensor(shape, dtype="float32", name=None):
 
 class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias=True,
-                 name=None):
-        super().__init__(name)
+                 kernel_initializer=None, bias_initializer=None,
+                 name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.units = units
         self.activation = activation
         self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
 
     def lower(self, model, xs):
         return model.dense(xs[0], self.units, activation=self.activation,
-                           use_bias=self.use_bias, name=self.name)
+                           use_bias=self.use_bias,
+                           kernel_initializer=self.kernel_initializer,
+                           bias_initializer=self.bias_initializer,
+                           name=self.name)
 
 
 class Flatten(Layer):
@@ -93,8 +107,8 @@ class Flatten(Layer):
 
 
 class Embedding(Layer):
-    def __init__(self, input_dim: int, output_dim: int, name=None):
-        super().__init__(name)
+    def __init__(self, input_dim: int, output_dim: int, name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.input_dim = input_dim
         self.output_dim = output_dim
 
@@ -104,8 +118,8 @@ class Embedding(Layer):
 
 
 class Activation(Layer):
-    def __init__(self, fn: str, name=None):
-        super().__init__(name)
+    def __init__(self, fn: str, name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.fn = fn
 
     def lower(self, model, xs):
@@ -115,8 +129,8 @@ class Activation(Layer):
 
 
 class Dropout(Layer):
-    def __init__(self, rate: float, name=None):
-        super().__init__(name)
+    def __init__(self, rate: float, name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.rate = rate
 
     def lower(self, model, xs):
@@ -124,8 +138,8 @@ class Dropout(Layer):
 
 
 class Reshape(Layer):
-    def __init__(self, target_shape, name=None):
-        super().__init__(name)
+    def __init__(self, target_shape, name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.target_shape = tuple(target_shape)
 
     def lower(self, model, xs):
@@ -135,8 +149,12 @@ class Reshape(Layer):
 
 class Conv2D(Layer):
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
-                 padding="valid", activation=None, use_bias=True, name=None):
-        super().__init__(name)
+                 padding="valid", activation=None, use_bias=True,
+                 kernel_initializer=None, bias_initializer=None,
+                 name=None, **kwargs):
+        super().__init__(name, **kwargs)
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
         self.filters = filters
         self.kernel = (kernel_size if isinstance(kernel_size, (tuple, list))
                        else (kernel_size, kernel_size))
@@ -157,15 +175,18 @@ class Conv2D(Layer):
         return model.conv2d(xs[0], self.filters, kh, kw, self.strides[0],
                             self.strides[1], ph, pw,
                             activation=self.activation,
-                            use_bias=self.use_bias, name=self.name)
+                            use_bias=self.use_bias,
+                            kernel_initializer=self.kernel_initializer,
+                            bias_initializer=self.bias_initializer,
+                            name=self.name)
 
 
 class _Pool2D(Layer):
     pool_type = "max"
 
     def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
-                 name=None):
-        super().__init__(name)
+                 name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.pool = (pool_size if isinstance(pool_size, (tuple, list))
                      else (pool_size, pool_size))
         strides = strides or self.pool
@@ -199,8 +220,8 @@ class BatchNormalization(Layer):
 
 
 class Concatenate(Layer):
-    def __init__(self, axis: int = 1, name=None):
-        super().__init__(name)
+    def __init__(self, axis: int = 1, name=None, **kwargs):
+        super().__init__(name, **kwargs)
         self.axis = axis
 
     def lower(self, model, xs):
@@ -258,6 +279,9 @@ class BaseModel:
         assert isinstance(optimizer, Optimizer)
         self.batch_size = batch_size
         self._build(batch_size)
+        # keras loss/metric marker objects carry their registry name
+        loss = getattr(loss, "name", None) or loss
+        metrics = tuple(getattr(m, "name", None) or m for m in metrics)
         loss = _LOSSES.get(loss, loss)
         self.ffmodel.compile(optimizer=optimizer, loss_type=loss,
                              metrics=tuple(metrics))
@@ -296,7 +320,6 @@ class BaseModel:
         """Apply a new learning rate to the held training state (used by
         LearningRateScheduler outside a running fit)."""
         self.state = self.ffmodel.set_learning_rate(self.state, lr)
-        self.ffmodel.optimizer.lr = float(lr)
 
     def evaluate(self, x, y):
         inputs = self._as_input_dict(x)
@@ -334,14 +357,22 @@ class Sequential(BaseModel):
         self._layers.append(layer)
 
     def _build(self, batch_size: int):
-        assert self._layers and isinstance(self._layers[0], Input), (
-            "Sequential model needs an Input layer first")
-        inp = self._layers[0]
+        assert self._layers, "Sequential model has no layers"
+        first = self._layers[0]
+        if isinstance(first, Input):
+            inp, rest = first, self._layers[1:]
+        else:
+            # reference-style: first layer carries input_shape
+            assert first.input_shape is not None, (
+                "Sequential model needs an Input layer or input_shape= on "
+                "the first layer")
+            inp = Input(first.input_shape, first.input_dtype)
+            rest = self._layers
         self.ffmodel = FFModel(FFConfig(batch_size=batch_size))
         t = self.ffmodel.create_tensor((batch_size,) + inp.shape, inp.dtype,
                                        name=inp.name or "input")
         self._input_names = [t.name]
-        for layer in self._layers[1:]:
+        for layer in rest:
             t = layer.lower(self.ffmodel, [t])
 
 

@@ -179,9 +179,24 @@ class ONNXModel:
                 shape = tuple(int(d.dim_value) for d in list(dims)[1:])
             self.symbol_table[inp.name] = ff.create_tensor(
                 (b,) + shape, name=inp.name)
+        self.lower_onto(ff, self.symbol_table)
+        return ff
+
+    def lower_onto(self, ff, bound_inputs):
+        """Replay the onnx graph onto an existing model with graph inputs
+        pre-bound to core tensors (the reference ONNXModel.apply(ffmodel,
+        {name: tensor}) contract, onnx/model.py:23+).  Returns the graph
+        output tensors."""
+        self.symbol_table = dict(bound_inputs)
         for node in self.model.graph.node:
             handler = getattr(self, "handle" + node.op_type, None)
             if handler is None:
                 raise NotImplementedError(f"onnx op {node.op_type}")
             handler(ff, node)
-        return ff
+        outs = []
+        for o in self.model.graph.output:
+            if o.name in self.symbol_table:
+                outs.append(self.symbol_table[o.name])
+        if not outs:  # graphs without declared outputs: last value wins
+            outs = [next(reversed(self.symbol_table.values()))]
+        return outs

@@ -38,19 +38,37 @@ class PyTorchModel:
 
         model = FFModel(ffconfig)
         b = ffconfig.batch_size
-        env: Dict[str, object] = {}
+        bound: Dict[str, object] = {}
+        for node in self.graph.nodes:
+            if node.op == "placeholder":
+                shape = input_shapes[node.name]
+                dt = (dtypes or {}).get(node.name, "float32")
+                bound[node.name] = model.create_tensor(
+                    (b,) + tuple(shape), dt, name=node.name)
+        self.lower_onto(model, bound)
+        return model
+
+    def placeholder_names(self):
+        return [n.name for n in self.graph.nodes if n.op == "placeholder"]
+
+    def lower_onto(self, model: FFModel, bound_inputs: Dict[str, object]):
+        """Replay the fx graph onto an existing model, with placeholders
+        pre-bound to core tensors (the reference's PyTorchModel.apply
+        replays its op list onto a user-supplied ffmodel the same way,
+        torch/model.py:18-149).  Returns the output tensors."""
+        env: Dict[str, object] = dict(bound_inputs)
         mods = dict(self.module.named_modules())
         self._name_of: Dict[str, str] = {}  # fx node -> op name
+        outputs = []
 
         def as_tensor(a):
             return env[a.name] if hasattr(a, "name") else a
 
         for node in self.graph.nodes:
             if node.op == "placeholder":
-                shape = input_shapes[node.name]
-                dt = (dtypes or {}).get(node.name, "float32")
-                env[node.name] = model.create_tensor((b,) + tuple(shape), dt,
-                                                     name=node.name)
+                assert node.name in env, (
+                    f"placeholder {node.name!r} not bound; have "
+                    f"{sorted(bound_inputs)}")
             elif node.op == "call_module":
                 m = mods[node.target]
                 x = as_tensor(node.args[0])
@@ -59,13 +77,13 @@ class PyTorchModel:
                 env[node.name] = self._lower_function(model, node, as_tensor)
             elif node.op == "output":
                 arg = node.args[0]
-                if isinstance(arg, (tuple, list)):
-                    arg = arg[0]
-                env[node.name] = as_tensor(arg)
+                args = arg if isinstance(arg, (tuple, list)) else [arg]
+                outputs = [as_tensor(x) for x in args]
+                env[node.name] = outputs[0]
             elif node.op == "get_attr":
                 raise NotImplementedError(
                     f"get_attr {node.target} not supported")
-        return model
+        return outputs
 
     # ---------------------------------------------------------------- modules
     def _lower_module(self, model: FFModel, m, x, node):

@@ -32,9 +32,26 @@ def _register(name):
 
 
 @_register("sparse_categorical_crossentropy")
-def sparse_categorical_crossentropy(logits, labels):
-    """labels: int (batch,) or (batch, 1). Softmax applied internally
-    (matching the reference's softmax-fused backward)."""
+def sparse_categorical_crossentropy(probs, labels):
+    """labels: int (batch,) or (batch, 1); ``probs`` are softmax outputs.
+
+    The reference applies sparse CCE to the Softmax op's output and fuses
+    the two backwards so d loss/d logits = p - onehot (softmax.cu backward
+    + loss_functions.cu:36-50).  ``-log p[label]`` autodiffed through the
+    upstream softmax yields exactly that gradient.  For graphs without a
+    trailing Softmax, compile swaps in the from-logits variant.
+    """
+    if labels.ndim == probs.ndim:
+        labels = jnp.squeeze(labels, axis=-1)
+    picked = jnp.take_along_axis(probs, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return -jnp.mean(jnp.log(picked + 1e-12))
+
+
+@_register("sparse_categorical_crossentropy_from_logits")
+def sparse_categorical_crossentropy_from_logits(logits, labels):
+    """Numerically-stable fused softmax+CCE for graphs that end in raw
+    logits (no Softmax op)."""
     if labels.ndim == logits.ndim:
         labels = jnp.squeeze(labels, axis=-1)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -49,6 +66,12 @@ def categorical_crossentropy(probs, labels):
     CCE to a Softmax op output, loss_functions.cu:52-62)."""
     eps = 1e-12
     ce = -jnp.sum(labels * jnp.log(probs + eps), axis=-1)
+    return jnp.mean(ce)
+
+
+@_register("categorical_crossentropy_from_logits")
+def categorical_crossentropy_from_logits(logits, labels):
+    ce = -jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)
     return jnp.mean(ce)
 
 

@@ -288,6 +288,20 @@ class FFModel:
         return self._unary("pow", x, name, exponent)
 
     # --------------------------------------------------------------- helpers
+    def _output_is_softmaxed(self) -> bool:
+        """Whether the graph output is already probabilities: a Softmax op,
+        a layer with softmax fused as its activation, or either followed
+        only by value-preserving shape ops."""
+        for op in reversed(self.layers):
+            if isinstance(op, Softmax):
+                return True
+            if getattr(op, "activation", None) == "softmax":
+                return True
+            if isinstance(op, (Reshape, Transpose, Reverse, Flat)):
+                continue
+            return False
+        return False
+
     def _op_compute_dtype(self):
         cd = self.config.compute_dtype
         return cd if cd != "float32" else None
@@ -355,6 +369,18 @@ class FFModel:
                           else getattr(loss_type, "__name__", "custom"))
         self._loss_fn = get_loss(loss_type)
         loss_type = self.loss_type
+        # Reference CCE losses consume the Softmax op's output and fuse the
+        # backward (loss_functions.cu:36-62).  When the graph does NOT end
+        # in Softmax, swap in the stable from-logits form so both styles
+        # train identically.
+        if loss_type in ("sparse_categorical_crossentropy",
+                         "sparse_crossentropy", "categorical_crossentropy",
+                         "crossentropy") and self.layers:
+            if not self._output_is_softmaxed():
+                base = ("sparse_categorical_crossentropy"
+                        if "sparse" in loss_type
+                        else "categorical_crossentropy")
+                self._loss_fn = get_loss(base + "_from_logits")
         self.metrics = tuple(metrics)
         if strategy is not None:
             self.strategy = strategy

@@ -1,0 +1,11 @@
+"""Drop-in compatibility package for the reference FlexFlow Python API.
+
+Reference: python/flexflow/ (cffi binding flexflow_cbinding.py:564-875 and
+the keras/torch/onnx frontends).  A user of the reference's
+``from flexflow.core import *`` scripts can run them on this TPU-native
+framework unchanged: the same classes, enums, and imperative verbs are
+provided here, implemented over :mod:`dlrm_flexflow_tpu`'s jitted
+functional core instead of a C library behind cffi.
+"""
+
+from . import type  # noqa: F401

@@ -1,0 +1,842 @@
+"""Reference-compatible imperative binding over the TPU-native core.
+
+Reference: python/flexflow/core/flexflow_cbinding.py (FFConfig :346-378,
+Tensor :380-527, Parameter :529-562, FFModel :564-875, optimizers
+:877-900, initializers :902-960, PerfMetrics/NetConfig/DataLoaders
+:961-1067).  The reference drives a C++ Legion runtime through cffi with
+imperative verbs (``forward``/``zero_gradients``/``backward``/``update``)
+and dataloaders that copy batches into mapped regions.  Here the same
+surface drives :class:`dlrm_flexflow_tpu.model.FFModel`: dataloaders stash
+the current host batch, ``forward`` runs the jitted forward program,
+``backward`` runs a jitted value-and-grad (which also folds training
+metrics, matching the reference where metrics are computed on the backward
+pass, src/runtime/model.cc:961-966), and ``update`` applies the optimizer.
+``train()`` uses the fused single-dispatch train step, which is the TPU
+analogue of Legion tracing the iteration body.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from dlrm_flexflow_tpu import initializers as _init
+from dlrm_flexflow_tpu import optim as _optim
+from dlrm_flexflow_tpu.config import FFConfig as _CoreConfig
+from dlrm_flexflow_tpu.metrics import MetricsAccumulator, compute_metrics
+from dlrm_flexflow_tpu.model import FFModel as _CoreModel
+from dlrm_flexflow_tpu.model import TrainState
+
+from ..type import (ActiMode, AggrMode, DataType, LossType, MetricsType,
+                    OpType, PoolType, enum_to_int, int_to_enum)
+
+__all__ = [
+    "ActiMode", "AggrMode", "DataType", "LossType", "MetricsType", "OpType",
+    "PoolType", "enum_to_int", "int_to_enum",
+    "FFConfig", "FFModel", "Tensor", "Parameter", "Op",
+    "SGDOptimizer", "AdamOptimizer",
+    "Initializer", "GlorotUniformInitializer", "ZeroInitializer",
+    "UniformInitializer", "NormInitializer", "ConstantInitializer",
+    "PerfMetrics", "NetConfig", "SingleDataLoader", "DataLoader2D",
+    "DataLoader4D", "RegionNdarray",
+]
+
+
+# ------------------------------------------------------------- enum mapping
+_ACTI = {ActiMode.AC_MODE_NONE: None, ActiMode.AC_MODE_RELU: "relu",
+         ActiMode.AC_MODE_SIGMOID: "sigmoid", ActiMode.AC_MODE_TANH: "tanh"}
+_AGGR = {AggrMode.AGGR_MODE_NONE: "none", AggrMode.AGGR_MODE_SUM: "sum",
+         AggrMode.AGGR_MODE_AVG: "avg"}
+_POOL = {PoolType.POOL_MAX: "max", PoolType.POOL_AVG: "avg"}
+_DTYPE = {DataType.DT_FLOAT: "float32", DataType.DT_DOUBLE: "float64",
+          DataType.DT_INT32: "int32", DataType.DT_INT64: "int64",
+          DataType.DT_BOOLEAN: "bool"}
+_NP_TO_DT = {np.dtype("float32"): DataType.DT_FLOAT,
+             np.dtype("float64"): DataType.DT_DOUBLE,
+             np.dtype("int32"): DataType.DT_INT32,
+             np.dtype("int64"): DataType.DT_INT64,
+             np.dtype("bool"): DataType.DT_BOOLEAN}
+_LOSS = {
+    LossType.LOSS_CATEGORICAL_CROSSENTROPY: "categorical_crossentropy",
+    LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        "sparse_categorical_crossentropy",
+    # the reference's avg- vs sum-reduce differ by the 1/batch scale the
+    # backward applies (loss_functions.cu:146); the core loss is avg-reduce
+    LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE: "mean_squared_error",
+    LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: "mean_squared_error",
+}
+_METRIC = {
+    MetricsType.METRICS_ACCURACY: "accuracy",
+    MetricsType.METRICS_CATEGORICAL_CROSSENTROPY: "categorical_crossentropy",
+    MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY:
+        "sparse_categorical_crossentropy",
+    MetricsType.METRICS_MEAN_SQUARED_ERROR: "mean_squared_error",
+    MetricsType.METRICS_ROOT_MEAN_SQUARED_ERROR: "root_mean_squared_error",
+    MetricsType.METRICS_MEAN_ABSOLUTE_ERROR: "mean_absolute_error",
+}
+
+
+def _acti(a):
+    if a is None or isinstance(a, str):
+        return a
+    return _ACTI[a]
+
+
+# ------------------------------------------------------------------ FFConfig
+class FFConfig:
+    """reference flexflow_cbinding.py:346-378."""
+
+    def __init__(self):
+        self._cfg = _CoreConfig()
+
+    def parse_args(self, argv: Optional[List[str]] = None):
+        self._cfg = _CoreConfig.parse_args(
+            list(sys.argv[1:] if argv is None else argv))
+
+    def get_batch_size(self):
+        return self._cfg.batch_size
+
+    def get_workers_per_node(self):
+        return self._cfg.resolved_num_devices()
+
+    def get_num_nodes(self):
+        return 1 if jax.process_count() == 0 else jax.process_count()
+
+    def get_epochs(self):
+        return self._cfg.epochs
+
+    def get_current_time(self):
+        """Microseconds, like Legion's get_current_time usage."""
+        return time.perf_counter_ns() // 1000
+
+    def begin_trace(self, trace_id):
+        """Legion tracing is a no-op here: the jit cache plays that role."""
+
+    def end_trace(self, trace_id):
+        pass
+
+    # convenience passthroughs (several reference scripts poke these)
+    @property
+    def batch_size(self):
+        return self._cfg.batch_size
+
+    @property
+    def epochs(self):
+        return self._cfg.epochs
+
+
+# -------------------------------------------------------------------- Tensor
+class Tensor:
+    """reference flexflow_cbinding.py:380-527 — metadata + numpy attach.
+
+    There are no Legion regions to map; ``attach_numpy_array`` just pins a
+    host array to the tensor and ``inline_map``/``inline_unmap`` flip the
+    ``mapped`` flag for API compatibility.
+    """
+
+    def __init__(self, core_tensor, ffmodel: Optional["FFModel"] = None,
+                 owner_op: Optional["Op"] = None):
+        self._t = core_tensor
+        self._ffmodel = ffmodel
+        self._array: Optional[np.ndarray] = None
+        self.owner_op = owner_op
+        self.mapped = False
+
+    @property
+    def num_dims(self):
+        return len(self._t.shape)
+
+    @property
+    def dims(self):
+        return tuple(int(d) for d in self._t.shape)
+
+    # some reference-era scripts use .shape; keep both
+    shape = dims
+
+    @property
+    def data_type(self):
+        return _NP_TO_DT.get(np.dtype(self._t.dtype), DataType.DT_FLOAT)
+
+    def inline_map(self, ffconfig):
+        self.mapped = True
+
+    def inline_unmap(self, ffconfig):
+        self.mapped = False
+
+    def attach_numpy_array(self, ffconfig, np_array: np.ndarray):
+        assert tuple(np_array.shape) == self.dims, (
+            f"attach shape {np_array.shape} != tensor dims {self.dims}")
+        self._array = np_array
+        self.mapped = True
+        if self._ffmodel is not None:
+            name = self._t.name
+            # attaching to a graph input makes it the tensor's standing value
+            if name in self._ffmodel._input_names():
+                self._ffmodel._pending[name] = np_array
+
+    def detach_numpy_array(self, ffconfig):
+        self.mapped = False
+
+    def is_mapped(self):
+        return self.mapped
+
+    def get_array(self, ffconfig, data_type=None):
+        """Current host view: the attached array, the pending batch, or —
+        for an op output — the value from the last ``forward()``."""
+        if self._array is not None:
+            return self._array
+        if self._ffmodel is not None:
+            name = self._t.name
+            if name in self._ffmodel._pending:
+                return np.asarray(self._ffmodel._pending[name])
+            val = self._ffmodel._last_values.get(self._t.uid)
+            if val is not None:
+                return np.asarray(val)
+        raise RuntimeError("tensor has no attached or computed value")
+
+    def get_flat_array(self, ffconfig, data_type=None):
+        return self.get_array(ffconfig, data_type).reshape(-1)
+
+
+class Parameter(Tensor):
+    """reference flexflow_cbinding.py:529-562 (Parameter::set/get_weights).
+
+    Weight layouts are this framework's natural ones (dense kernel is
+    (in, out)); the torch/onnx importers handle layout conversion.
+    """
+
+    def __init__(self, ffmodel: "FFModel", op_name: str, param_name: str,
+                 shape, dtype=np.float32):
+        self._ffmodel = ffmodel
+        self._op_name = op_name
+        self._param_name = param_name
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = np.dtype(dtype)
+        self._array = None
+        self.owner_op = None
+        self.mapped = True
+
+    @property
+    def num_dims(self):
+        return len(self._shape)
+
+    @property
+    def dims(self):
+        return self._shape
+
+    shape = dims
+
+    @property
+    def data_type(self):
+        return _NP_TO_DT.get(self._dtype, DataType.DT_FLOAT)
+
+    def get_weights(self, ffmodel: "FFModel") -> np.ndarray:
+        state = ffmodel._require_state()
+        return np.asarray(state.params[self._op_name][self._param_name])
+
+    def set_weights(self, ffmodel: "FFModel", np_array: np.ndarray):
+        state = ffmodel._require_state()
+        ffmodel._state = ffmodel._core.set_weights(
+            state, self._op_name, self._param_name, np_array)
+
+    def get_array(self, ffconfig, data_type=None):
+        return self.get_weights(self._ffmodel)
+
+
+# ----------------------------------------------------------------------- Op
+class Op:
+    """reference flexflow_cbinding.py:52-84 — layer handle with parameter
+    access (flexflow_op_get_parameter_by_id)."""
+
+    def __init__(self, ffmodel: "FFModel", core_op, op_type: OpType,
+                 idx: int, name: Optional[str]):
+        self._ffmodel = ffmodel
+        self._core_op = core_op
+        self.op_type = op_type
+        self.idx = idx
+        self.name = name or core_op.name
+
+    def _params(self):
+        return self._core_op.param_specs()
+
+    def get_number_parameters(self):
+        return len(self._params())
+
+    def get_parameter_by_id(self, id: int) -> Parameter:
+        spec = self._params()[id]
+        return Parameter(self._ffmodel, self._core_op.name, spec.param_name,
+                         spec.shape)
+
+    _get_parameter_tensor_by_id = get_parameter_by_id
+
+    def get_weight_tensor(self) -> Parameter:
+        return self.get_parameter_by_id(0)
+
+    def get_bias_tensor(self) -> Parameter:
+        return self.get_parameter_by_id(1)
+
+    def get_input_tensor(self) -> Tensor:
+        return Tensor(self._core_op.inputs[0], self._ffmodel)
+
+    _get_input_tensor_by_id = lambda self, id: Tensor(  # noqa: E731
+        self._core_op.inputs[id], self._ffmodel)
+
+    def get_output_tensor(self) -> Tensor:
+        return Tensor(self._core_op.outputs[0], self._ffmodel)
+
+
+# ------------------------------------------------------------------- FFModel
+class FFModel:
+    """reference flexflow_cbinding.py:564-875."""
+
+    def __init__(self, ffconfig: FFConfig):
+        self._ffconfig = ffconfig
+        self._core = _CoreModel(ffconfig._cfg)
+        self._layers: Dict[int, Op] = {}
+        self._nb_layers = 0
+        self._state: Optional[TrainState] = None
+        self._pending: Dict[str, np.ndarray] = {}
+        self._constants: Dict[str, np.ndarray] = {}
+        self._last_values: Dict[int, object] = {}
+        self._grads = None
+        self._acc = MetricsAccumulator(())
+        self._opt_compat = None
+        self._label = None
+        self._bwd = None
+        self._upd = None
+
+    # ------------------------------------------------------------- helpers
+    def _input_names(self):
+        return {t.name for t in self._core._inputs}
+
+    def _require_state(self) -> TrainState:
+        if self._state is None:
+            self.init_layers()
+        return self._state
+
+    def _track(self, out, op_type: OpType, name: Optional[str]):
+        core_op = self._core.layers[-1]
+        self._layers[self._nb_layers] = Op(self, core_op, op_type,
+                                           self._nb_layers, name)
+        self._nb_layers += 1
+        if isinstance(out, (list, tuple)):
+            return [Tensor(t, self, self._layers[self._nb_layers - 1])
+                    for t in out]
+        return Tensor(out, self, self._layers[self._nb_layers - 1])
+
+    # ------------------------------------------------------ tensor creation
+    def create_tensor(self, dims, data_type=DataType.DT_FLOAT,
+                      create_grad=True, name=None) -> Tensor:
+        t = self._core.create_tensor(tuple(dims), _DTYPE[data_type],
+                                     name=name)
+        return Tensor(t, self)
+
+    def create_constant(self, dims, value, data_type=DataType.DT_FLOAT):
+        t = self.create_tensor(dims, data_type)
+        arr = np.full(tuple(dims), value, dtype=_DTYPE[data_type])
+        self._constants[t._t.name] = arr
+        self._pending[t._t.name] = arr
+        return t
+
+    # ----------------------------------------------------------- factories
+    def exp(self, x, name=None):
+        return self._track(self._core.exp(x._t, name=name), OpType.EXP, name)
+
+    def add(self, x, y, name=None):
+        return self._track(self._core.add(x._t, y._t, name=name),
+                           OpType.ADD, name)
+
+    def subtract(self, x, y, name=None):
+        return self._track(self._core.subtract(x._t, y._t, name=name),
+                           OpType.SUBTRACT, name)
+
+    def multiply(self, x, y, name=None):
+        return self._track(self._core.multiply(x._t, y._t, name=name),
+                           OpType.MULTIPLY, name)
+
+    def divide(self, x, y, name=None):
+        return self._track(self._core.divide(x._t, y._t, name=name),
+                           OpType.DIVIDE, name)
+
+    def conv2d(self, input, out_channels, kernel_h, kernel_w, stride_h,
+               stride_w, padding_h, padding_w,
+               activation=ActiMode.AC_MODE_NONE, use_bias=True,
+               shared_op=None, kernel_initializer=None, bias_initializer=None,
+               name=None):
+        out = self._core.conv2d(
+            input._t, out_channels, kernel_h, kernel_w, stride_h, stride_w,
+            padding_h, padding_w, activation=_acti(activation),
+            use_bias=use_bias,
+            kernel_initializer=_unwrap_init(kernel_initializer),
+            bias_initializer=_unwrap_init(bias_initializer), name=name)
+        return self._track(out, OpType.CONV2D, name)
+
+    def embedding(self, input, num_entires, out_dim,
+                  aggr=AggrMode.AGGR_MODE_SUM, shared_op=None,
+                  kernel_initializer=None, name=None):
+        out = self._core.embedding(
+            input._t, num_entires, out_dim,
+            aggr=_AGGR[aggr] if isinstance(aggr, AggrMode) else aggr,
+            kernel_initializer=_unwrap_init(kernel_initializer), name=name)
+        return self._track(out, OpType.EMBEDDING, name)
+
+    def pool2d(self, input, kernel_h, kernel_w, stride_h, stride_w,
+               padding_h, padding_w, pool_type=PoolType.POOL_MAX,
+               activation=ActiMode.AC_MODE_NONE, name=None):
+        out = self._core.pool2d(
+            input._t, kernel_h, kernel_w, stride_h, stride_w, padding_h,
+            padding_w,
+            pool_type=_POOL[pool_type] if isinstance(pool_type, PoolType)
+            else pool_type,
+            activation=_acti(activation), name=name)
+        return self._track(out, OpType.POOL2D, name)
+
+    def batch_norm(self, input, relu=True, name=None):
+        return self._track(self._core.batch_norm(input._t, relu=relu,
+                                                 name=name),
+                           OpType.BATCH_NORM, name)
+
+    def batch_matmul(self, A, B, name=None):
+        return self._track(self._core.batch_matmul(A._t, B._t, name=name),
+                           OpType.BATCH_MATMUL, name)
+
+    def dense(self, input, out_dim, activation=ActiMode.AC_MODE_NONE,
+              use_bias=True, shared_op=None, kernel_initializer=None,
+              bias_initializer=None, name=None):
+        out = self._core.dense(
+            input._t, out_dim, activation=_acti(activation),
+            use_bias=use_bias,
+            kernel_initializer=_unwrap_init(kernel_initializer),
+            bias_initializer=_unwrap_init(bias_initializer), name=name)
+        return self._track(out, OpType.LINEAR, name)
+
+    def concat(self, tensors, axis, name=None):
+        assert isinstance(tensors, list), "tensors should be a list"
+        out = self._core.concat([t._t for t in tensors], axis, name=name)
+        return self._track(out, OpType.CONCAT, name)
+
+    def split(self, input, sizes, axis, name=None):
+        if not isinstance(sizes, list):
+            dim = input.dims[axis]
+            assert dim % sizes == 0, "Split dimension is not divisible"
+            sizes = [dim // sizes] * sizes
+        outs = self._core.split(input._t, sizes, axis, name=name)
+        return self._track(list(outs), OpType.SPLIT, name)
+
+    def flat(self, input, name=None):
+        return self._track(self._core.flat(input._t, name=name),
+                           OpType.FLAT, name)
+
+    def softmax(self, input, name=None):
+        return self._track(self._core.softmax(input._t, name=name),
+                           OpType.SOFTMAX, name)
+
+    def reshape(self, input, shape, name=None):
+        return self._track(self._core.reshape(input._t, tuple(shape),
+                                              name=name),
+                           OpType.RESHAPE, name)
+
+    def transpose(self, input, perm, name=None):
+        return self._track(self._core.transpose(input._t, perm, name=name),
+                           OpType.TRANSPOSE, name)
+
+    def reverse(self, input, axis, name=None):
+        return self._track(self._core.reverse(input._t, axis, name=name),
+                           OpType.REVERSE, name)
+
+    def relu(self, input, name=None):
+        return self._track(self._core.relu(input._t, name=name),
+                           OpType.RELU, name)
+
+    def sigmoid(self, input, name=None):
+        return self._track(self._core.sigmoid(input._t, name=name),
+                           OpType.SIGMOID, name)
+
+    def tanh(self, input, name=None):
+        return self._track(self._core.tanh(input._t, name=name),
+                           OpType.TANH, name)
+
+    def elu(self, input, name=None):
+        return self._track(self._core.elu(input._t, name=name),
+                           OpType.ELU, name)
+
+    def dropout(self, input, rate, seed, name=None):
+        return self._track(self._core.dropout(input._t, rate, seed,
+                                              name=name),
+                           OpType.DROPOUT, name)
+
+    # ------------------------------------------------------------ optimizer
+    def set_sgd_optimizer(self, optimizer):
+        self._opt_compat = optimizer
+
+    def set_adam_optimizer(self, optimizer):
+        self._opt_compat = optimizer
+
+    # -------------------------------------------------------------- compile
+    def compile(self, optimizer=None, loss_type=None, metrics=None,
+                comp_mode=None):
+        if optimizer is not None:
+            self._opt_compat = optimizer
+        core_opt = getattr(self._opt_compat, "_core", None)
+        loss = _LOSS[loss_type] if isinstance(loss_type, LossType) \
+            else (loss_type or "mean_squared_error")
+        mets = tuple(_METRIC[m] if isinstance(m, MetricsType) else m
+                     for m in (metrics or ()))
+        self._core.compile(optimizer=core_opt, loss_type=loss, metrics=mets)
+        self._acc = MetricsAccumulator(mets)
+        self._label = Tensor(self._core.label_tensor, self)
+        return self
+
+    def get_label_tensor(self) -> Tensor:
+        assert self._label is not None, "compile() first"
+        return self._label
+
+    # ----------------------------------------------------- imperative verbs
+    def init_layers(self):
+        """reference FFModel::init_layers — weight init; also builds the
+        split-phase jitted programs the imperative verbs use."""
+        self._state = self._core.init()
+        core = self._core
+        final_uid = core.final_tensor.uid
+
+        def loss_preds_grads(params, inputs, labels, rng, bn_state):
+            values, new_bn = core._apply(params, inputs, training=True,
+                                         rng=rng, bn_state=bn_state)
+            preds = values[final_uid]
+            return core._loss_fn(preds, labels), (preds, new_bn)
+
+        self._bwd = jax.jit(jax.value_and_grad(loss_preds_grads,
+                                               has_aux=True))
+        self._upd = jax.jit(
+            lambda params, grads, opt_state: core.optimizer.update(
+                params, grads, opt_state))
+
+    def _batch_inputs(self):
+        names = self._input_names()
+        label_name = self._core.label_tensor.name
+        inputs = {k: v for k, v in self._pending.items()
+                  if k in names and k != label_name}
+        labels = self._pending.get(label_name)
+        return inputs, labels
+
+    def forward(self):
+        state = self._require_state()
+        inputs, _ = self._batch_inputs()
+        values, _ = self._forward_values(state, inputs)
+        self._last_values = values
+
+    def _forward_values(self, state, inputs):
+        # cache one jitted all-values forward (first call compiles)
+        if not hasattr(self, "_fwd_all"):
+            core = self._core
+
+            def fwd(params, inputs, bn_state):
+                values, _ = core._apply(params, inputs, training=False,
+                                        rng=None, bn_state=bn_state)
+                return values
+
+            self._fwd_all = jax.jit(fwd)
+        return self._fwd_all(state.params, inputs, state.bn_state), None
+
+    def zero_gradients(self):
+        """Gradients are fresh values each backward — nothing to zero."""
+
+    def backward(self):
+        state = self._require_state()
+        inputs, labels = self._batch_inputs()
+        (loss, (preds, _)), grads = self._bwd(
+            state.params, inputs, labels, state.rng, state.bn_state)
+        self._grads = grads
+        mets = compute_metrics(preds, labels, self._acc.metrics or
+                               self._core.metrics, self._core.loss_type)
+        self._acc.update(mets)
+
+    def update(self):
+        state = self._require_state()
+        assert self._grads is not None, "backward() before update()"
+        params, opt = self._upd(state.params, self._grads, state.opt_state)
+        self._state = TrainState(params, opt, state.bn_state, state.rng,
+                                 state.step + 1)
+        self._grads = None
+
+    def compute_metrics(self):
+        _, labels = self._batch_inputs()
+        preds = self._last_values[self._core.final_tensor.uid]
+        mets = compute_metrics(preds, labels, self._acc.metrics or
+                               self._core.metrics, self._core.loss_type)
+        self._acc.update(mets)
+
+    def reset_metrics(self):
+        self._acc.reset()
+
+    def prefetch(self):
+        pass
+
+    # ------------------------------------------------------------ the loops
+    def train(self, dataloaders, epochs=1, batch_size=64):
+        """reference flexflow_cbinding.py:789-812 — same loop shape, but the
+        body is the core's fused jitted train step (fwd+bwd+metrics+update
+        in one XLA program; Legion tracing's analogue is the jit cache)."""
+        state = self._require_state()
+        num_samples = dataloaders[0].get_num_samples()
+        batch = self._ffconfig.get_batch_size()
+        label_name = self._core.label_tensor.name
+        for epoch in range(epochs):
+            for d in dataloaders:
+                d.reset()
+            self.reset_metrics()
+            for _ in range(int(num_samples / batch)):
+                for d in dataloaders:
+                    d.next_batch(self)
+                inputs, labels = self._batch_inputs()
+                assert labels is not None, (
+                    f"no dataloader feeds the label tensor {label_name!r}")
+                state, mets = self._core.train_step(state, inputs, labels)
+                self._acc.update({k: v for k, v in mets.items()
+                                  if k != "loss"})
+            self._state = state
+            print(f"epoch {epoch}: {self._acc.report()}")
+
+    def eval(self, dataloaders):
+        state = self._require_state()
+        num_samples = dataloaders[0].get_num_samples()
+        batch = self._ffconfig.get_batch_size()
+        for d in dataloaders:
+            d.reset()
+        self.reset_metrics()
+        for _ in range(int(num_samples / batch)):
+            for d in dataloaders:
+                d.next_batch(self)
+            inputs, labels = self._batch_inputs()
+            mets = self._core.eval_step(state, inputs, labels)
+            self._acc.update({k: v for k, v in mets.items() if k != "loss"})
+
+    # ----------------------------------------------------------- inspection
+    def get_layers(self):
+        return self._layers
+
+    def get_layer_by_id(self, layer_id) -> Op:
+        return self._layers[layer_id]
+
+    def get_layer_by_name(self, layer_name) -> Op:
+        for op in self._layers.values():
+            if op.name == layer_name or op._core_op.name == layer_name:
+                return op
+        raise KeyError(f"no layer named {layer_name}")
+
+    def get_tensor_by_id(self, id) -> Parameter:
+        """reference flexflow_model_get_parameter_by_id: flat index over all
+        parameters in layer order."""
+        flat = []
+        for op in self._core.layers:
+            for spec in op.param_specs():
+                flat.append((op.name, spec.param_name, spec.shape))
+        op_name, param_name, shape = flat[id]
+        return Parameter(self, op_name, param_name, shape)
+
+    def get_perf_metrics(self) -> "PerfMetrics":
+        return PerfMetrics(self._acc)
+
+    def print_layers(self, id=-1):
+        for i, op in self._layers.items():
+            if id in (-1, i):
+                core = op._core_op
+                outs = ", ".join(str(t.shape) for t in core.outputs)
+                print(f"layer {i}: {core.name} ({op.op_type.name}) -> {outs}")
+
+
+def _unwrap_init(initializer):
+    if initializer is None:
+        return None
+    return getattr(initializer, "_core", initializer)
+
+
+# --------------------------------------------------------------- optimizers
+class SGDOptimizer:
+    """reference flexflow_cbinding.py:877-888."""
+
+    def __init__(self, ffmodel, lr=0.01, momentum=0.0, nesterov=False,
+                 weight_decay=0.0):
+        self._ffmodel = ffmodel
+        self._core = _optim.SGDOptimizer(lr=lr, momentum=momentum,
+                                         nesterov=nesterov,
+                                         weight_decay=weight_decay)
+
+    def set_learning_rate(self, learning_rate):
+        self._core.lr = float(learning_rate)
+        m = self._ffmodel
+        if m is not None and m._state is not None:
+            m._state = m._core.set_learning_rate(m._state, learning_rate)
+
+
+class AdamOptimizer:
+    """reference flexflow_cbinding.py:890-900."""
+
+    def __init__(self, ffmodel, alpha=0.001, beta1=0.9, beta2=0.999,
+                 weight_decay=0.0, epsilon=1e-8):
+        self._ffmodel = ffmodel
+        self._core = _optim.AdamOptimizer(lr=alpha, beta1=beta1, beta2=beta2,
+                                          weight_decay=weight_decay,
+                                          epsilon=epsilon)
+
+    def set_learning_rate(self, learning_rate):
+        self._core.lr = float(learning_rate)
+        m = self._ffmodel
+        if m is not None and m._state is not None:
+            m._state = m._core.set_learning_rate(m._state, learning_rate)
+
+
+# -------------------------------------------------------------- initializers
+class Initializer:
+    _core = None
+
+
+class GlorotUniformInitializer(Initializer):
+    def __init__(self, seed=0):
+        self._core = _init.GlorotUniform()
+        self.seed = seed
+
+
+class ZeroInitializer(Initializer):
+    def __init__(self):
+        self._core = _init.ZeroInitializer()
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed=0, minv=-0.05, maxv=0.05):
+        self._core = _init.UniformInitializer(minval=minv, maxval=maxv,
+                                              seed=seed)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed=0, meanv=0.0, stddev=1.0):
+        self._core = _init.NormInitializer(mean=meanv, stddev=stddev,
+                                           seed=seed)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self._core = _init.ConstantInitializer(value=value)
+
+
+# -------------------------------------------------------------- PerfMetrics
+class PerfMetrics:
+    """reference flexflow_cbinding.py:961-969 (accuracy in percent)."""
+
+    def __init__(self, acc: MetricsAccumulator):
+        self._acc = acc
+
+    def get_accuracy(self) -> float:
+        return self._acc.get_accuracy()
+
+
+# ----------------------------------------------------------------- NetConfig
+class NetConfig:
+    """reference flexflow_cbinding.py:974-983 — carries the --dataset path
+    from the command line."""
+
+    def __init__(self):
+        self.dataset_path = ""
+        argv = sys.argv
+        for i, a in enumerate(argv):
+            if a == "--dataset" and i + 1 < len(argv):
+                self.dataset_path = argv[i + 1]
+
+
+# --------------------------------------------------------------- dataloaders
+class SingleDataLoader:
+    """reference flexflow_cbinding.py:1028-1048: one (batch_tensor,
+    full_tensor) pair; ``next_batch`` stages the next slice for the model's
+    imperative verbs (the reference scatters into the mapped region via a
+    custom GPU task, python/flexflow_dataloader.cc)."""
+
+    def __init__(self, ffmodel: FFModel, input: Tensor, full_input: Tensor,
+                 num_samples: int, data_type=None):
+        assert full_input._array is not None, \
+            "attach_numpy_array the full tensor first"
+        self._ffmodel = ffmodel
+        self._target = input._t.name
+        self._data = np.asarray(full_input._array)
+        self.num_samples = int(num_samples)
+        self._idx = 0
+
+    def set_num_samples(self, samples):
+        self.num_samples = int(samples)
+
+    def get_num_samples(self):
+        return self.num_samples
+
+    def next_batch(self, ffmodel: FFModel):
+        b = ffmodel._ffconfig.get_batch_size()
+        if self._idx + b > self.num_samples:
+            self._idx = 0
+        sl = self._data[self._idx:self._idx + b]
+        self._idx += b
+        ffmodel._pending[self._target] = sl
+
+    def reset(self):
+        self._idx = 0
+
+
+class _PairDataLoader:
+    """input+label pair loaders (reference DataLoader2D/4D,
+    flexflow_cbinding.py:985-1026)."""
+
+    def __init__(self, ffmodel, input, label, full_input=0, full_label=0,
+                 num_samples=0, ffnetconfig=0):
+        self._input = SingleDataLoader(ffmodel, input, full_input,
+                                       num_samples)
+        self._label = SingleDataLoader(ffmodel, label, full_label,
+                                       num_samples)
+        self.num_samples = int(num_samples)
+
+    def set_num_samples(self, samples):
+        self.num_samples = int(samples)
+        self._input.set_num_samples(samples)
+        self._label.set_num_samples(samples)
+
+    def get_num_samples(self):
+        return self.num_samples
+
+    def next_batch(self, ffmodel):
+        self._input.next_batch(ffmodel)
+        self._label.next_batch(ffmodel)
+
+    def reset(self):
+        self._input.reset()
+        self._label.reset()
+
+
+class DataLoader2D(_PairDataLoader):
+    pass
+
+
+class DataLoader4D(_PairDataLoader):
+    pass
+
+
+# ------------------------------------------------------------- RegionNdarray
+class RegionNdarray:
+    """reference flexflow_cbinding.py:1050-1067 — numpy array-interface
+    shim.  Kept for scripts that construct it directly."""
+
+    __slots__ = ["__array_interface__"]
+
+    def __init__(self, shape, data_type, base_ptr, strides, read_only):
+        if data_type == DataType.DT_FLOAT:
+            field_type = "<f4"
+        elif data_type == DataType.DT_INT32:
+            field_type = "<i4"
+        else:
+            raise AssertionError("unknown data type")
+        self.__array_interface__ = {
+            "version": 3,
+            "shape": shape,
+            "typestr": field_type,
+            "data": (base_ptr, read_only),
+            "strides": strides,
+        }

@@ -1,0 +1,7 @@
+"""reference python/flexflow/keras/callbacks.py."""
+
+from dlrm_flexflow_tpu.frontends.keras_callbacks import (
+    Callback, EpochVerifyMetrics, LearningRateScheduler, VerifyMetrics)
+
+__all__ = ["Callback", "LearningRateScheduler", "VerifyMetrics",
+           "EpochVerifyMetrics"]

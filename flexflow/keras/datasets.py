@@ -1,0 +1,5 @@
+"""reference python/flexflow/keras/datasets/ — mnist / cifar10 / reuters."""
+
+from dlrm_flexflow_tpu.frontends.keras_datasets import cifar10, mnist, reuters
+
+__all__ = ["mnist", "cifar10", "reuters"]

@@ -1,0 +1,6 @@
+"""reference python/flexflow/keras/models/ — Model, Sequential, Input."""
+
+from dlrm_flexflow_tpu.frontends.keras import (BaseModel, Input, Model,
+                                               Sequential)
+
+__all__ = ["BaseModel", "Model", "Sequential", "Input"]

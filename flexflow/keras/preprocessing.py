@@ -1,0 +1,9 @@
+"""reference python/flexflow/keras/preprocessing/ — sequence tools."""
+
+import types as _types
+
+from dlrm_flexflow_tpu.frontends.keras_utils import pad_sequences
+
+sequence = _types.SimpleNamespace(pad_sequences=pad_sequences)
+
+__all__ = ["sequence", "pad_sequences"]

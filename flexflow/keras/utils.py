@@ -1,0 +1,15 @@
+"""reference python/flexflow/keras/utils/ (np_utils.py to_categorical /
+normalize, data_utils Sequence, pad_sequences)."""
+
+import types as _types
+
+from dlrm_flexflow_tpu.frontends.keras_utils import (Sequence, normalize,
+                                                     pad_sequences,
+                                                     to_categorical)
+
+np_utils = _types.SimpleNamespace(to_categorical=to_categorical,
+                                  normalize=normalize)
+data_utils = _types.SimpleNamespace(Sequence=Sequence)
+
+__all__ = ["to_categorical", "normalize", "pad_sequences", "Sequence",
+           "np_utils", "data_utils"]

@@ -1,0 +1,3 @@
+"""reference python/flexflow/onnx/ — ONNX import frontend."""
+
+from . import model  # noqa: F401

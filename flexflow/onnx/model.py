@@ -1,0 +1,30 @@
+"""reference python/flexflow/onnx/model.py — ONNXModel(filename).apply(
+ffmodel, {input_name: tensor})."""
+
+from dlrm_flexflow_tpu.frontends.onnx_model import ONNXModel as _CoreOnnx
+
+
+class ONNXModel:
+    """reference onnx/model.py:23."""
+
+    def __init__(self, filename_or_model):
+        self._om = _CoreOnnx(filename_or_model)
+
+    def apply(self, ffmodel, input_dict):
+        from ..core.flexflow_binding import FFModel, Op, OpType, Tensor
+
+        assert isinstance(ffmodel, FFModel), \
+            "apply expects a flexflow.core FFModel"
+        nb_before = len(ffmodel._core.layers)
+        bound = {name: t._t for name, t in input_dict.items()}
+        outs = self._om.lower_onto(ffmodel._core, bound)
+        for core_op in ffmodel._core.layers[nb_before:]:
+            ffmodel._layers[ffmodel._nb_layers] = Op(
+                ffmodel, core_op, OpType.OUTPUT, ffmodel._nb_layers,
+                core_op.name)
+            ffmodel._nb_layers += 1
+        wrapped = [Tensor(t, ffmodel) for t in outs]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+
+__all__ = ["ONNXModel"]

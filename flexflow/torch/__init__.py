@@ -1,0 +1,3 @@
+"""reference python/flexflow/torch/ — PyTorch import frontend."""
+
+from . import fx, model  # noqa: F401

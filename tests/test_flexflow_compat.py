@@ -1,0 +1,339 @@
+"""Drop-in compatibility tests: reference-style scripts running against the
+``flexflow`` compat package (reference: examples/python/native/mnist_mlp.py,
+examples/python/keras/seq_mnist_mlp.py, examples/python/pytorch/mnist_mlp.py
+— same code shape, synthetic data)."""
+
+import numpy as np
+import pytest
+
+from flexflow.core import (ActiMode, AdamOptimizer, AggrMode, DataLoader2D,
+                           DataType, FFConfig, FFModel, LossType, MetricsType,
+                           NetConfig, PoolType, SGDOptimizer,
+                           SingleDataLoader, UniformInitializer,
+                           GlorotUniformInitializer, ZeroInitializer)
+
+
+def _mnist_like(n=256, d=64, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(n, 1)
+    return x, y
+
+
+class TestNativeScriptParity:
+    """The reference mnist_mlp.py top_level_task, line for line."""
+
+    def test_mnist_mlp_script(self):
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "32", "-e", "8"])
+        assert ffconfig.get_batch_size() == 32
+        assert ffconfig.get_epochs() == 8
+        ffmodel = FFModel(ffconfig)
+
+        num_samples = 256
+        dims_input = [ffconfig.get_batch_size(), 64]
+        input_tensor = ffmodel.create_tensor(dims_input, DataType.DT_FLOAT)
+
+        kernel_init = UniformInitializer(12, -0.08, 0.08)
+        t = ffmodel.dense(input_tensor, 128, ActiMode.AC_MODE_RELU,
+                          kernel_initializer=kernel_init)
+        t = ffmodel.dense(t, 128, ActiMode.AC_MODE_RELU)
+        t = ffmodel.dense(t, 10)
+        t = ffmodel.softmax(t)
+
+        ffoptimizer = SGDOptimizer(ffmodel, 0.2)
+        ffmodel.set_sgd_optimizer(ffoptimizer)
+        ffmodel.compile(
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.METRICS_ACCURACY,
+                     MetricsType.METRICS_SPARSE_CATEGORICAL_CROSSENTROPY])
+        label_tensor = ffmodel.get_label_tensor()
+
+        x_train, y_train = _mnist_like(num_samples)
+
+        dims_full_input = [num_samples, 64]
+        full_input = ffmodel.create_tensor(dims_full_input, DataType.DT_FLOAT)
+        dims_full_label = [num_samples, 1]
+        full_label = ffmodel.create_tensor(dims_full_label, DataType.DT_INT32)
+
+        full_input.attach_numpy_array(ffconfig, x_train)
+        full_label.attach_numpy_array(ffconfig, y_train)
+
+        dataloader_input = SingleDataLoader(ffmodel, input_tensor, full_input,
+                                            num_samples, DataType.DT_FLOAT)
+        dataloader_label = SingleDataLoader(ffmodel, label_tensor, full_label,
+                                            num_samples, DataType.DT_INT32)
+
+        full_input.detach_numpy_array(ffconfig)
+        full_label.detach_numpy_array(ffconfig)
+
+        ffmodel.init_layers()
+
+        epochs = ffconfig.get_epochs()
+        ts_start = ffconfig.get_current_time()
+        ffmodel.train((dataloader_input, dataloader_label), epochs)
+        ffmodel.eval((dataloader_input, dataloader_label))
+        ts_end = ffconfig.get_current_time()
+        assert ts_end > ts_start
+
+        perf_metrics = ffmodel.get_perf_metrics()
+        accuracy = perf_metrics.get_accuracy()
+        assert accuracy > 50.0, f"eval accuracy {accuracy}"
+
+    def test_imperative_verbs_reduce_loss(self):
+        """forward / zero_gradients / backward / update — the reference's
+        per-iteration verb sequence (flexflow_cbinding.py:789-812)."""
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "64"])
+        ffmodel = FFModel(ffconfig)
+        x, y = _mnist_like(64, d=32, classes=4)
+
+        inp = ffmodel.create_tensor([64, 32], DataType.DT_FLOAT)
+        t = ffmodel.dense(inp, 64, ActiMode.AC_MODE_RELU)
+        t = ffmodel.dense(t, 4)
+        t = ffmodel.softmax(t)
+        ffmodel.compile(
+            optimizer=SGDOptimizer(ffmodel, 0.1),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.METRICS_ACCURACY])
+        ffmodel.init_layers()
+
+        label = ffmodel.get_label_tensor()
+        full_x = ffmodel.create_tensor([64, 32], DataType.DT_FLOAT)
+        full_y = ffmodel.create_tensor([64, 1], DataType.DT_INT32)
+        full_x.attach_numpy_array(ffconfig, x)
+        full_y.attach_numpy_array(ffconfig, y)
+        dl_x = SingleDataLoader(ffmodel, inp, full_x, 64, DataType.DT_FLOAT)
+        dl_y = SingleDataLoader(ffmodel, label, full_y, 64, DataType.DT_INT32)
+
+        def current_accuracy():
+            ffmodel.reset_metrics()
+            dl_x.reset(); dl_y.reset()
+            dl_x.next_batch(ffmodel); dl_y.next_batch(ffmodel)
+            ffmodel.forward()
+            ffmodel.compute_metrics()
+            return ffmodel.get_perf_metrics().get_accuracy()
+
+        acc0 = current_accuracy()
+        for _ in range(30):
+            dl_x.reset(); dl_y.reset()
+            dl_x.next_batch(ffmodel); dl_y.next_batch(ffmodel)
+            ffmodel.forward()
+            ffmodel.zero_gradients()
+            ffmodel.backward()
+            ffmodel.update()
+        acc1 = current_accuracy()
+        assert acc1 > acc0 or acc1 == pytest.approx(100.0)
+
+    def test_weights_roundtrip_and_layer_access(self):
+        ffconfig = FFConfig()
+        ffmodel = FFModel(ffconfig)
+        inp = ffmodel.create_tensor([16, 8], DataType.DT_FLOAT)
+        t = ffmodel.dense(inp, 4, name="fc1")
+        ffmodel.compile(optimizer=SGDOptimizer(ffmodel, 0.01),
+                        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        ffmodel.init_layers()
+
+        layer = ffmodel.get_layer_by_name("fc1")
+        kernel = layer.get_weight_tensor()
+        w = kernel.get_weights(ffmodel)
+        assert w.shape == (8, 4)
+        new_w = np.ones_like(w)
+        kernel.set_weights(ffmodel, new_w)
+        np.testing.assert_array_equal(kernel.get_weights(ffmodel), new_w)
+
+        # flat parameter indexing (reference get_tensor_by_id)
+        p0 = ffmodel.get_tensor_by_id(0)
+        np.testing.assert_array_equal(p0.get_weights(ffmodel), new_w)
+        ffmodel.print_layers()
+
+    def test_ops_surface(self):
+        """Every factory the reference binding exposes builds and runs."""
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "8"])
+        ffmodel = FFModel(ffconfig)
+        img = ffmodel.create_tensor([8, 3, 16, 16], DataType.DT_FLOAT)
+        t = ffmodel.conv2d(img, 4, 3, 3, 1, 1, 1, 1,
+                           ActiMode.AC_MODE_RELU)
+        t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0, PoolType.POOL_MAX)
+        t = ffmodel.batch_norm(t, relu=True)
+        t = ffmodel.flat(t)
+        a = ffmodel.dense(t, 16, ActiMode.AC_MODE_TANH)
+        b = ffmodel.dense(t, 16, ActiMode.AC_MODE_SIGMOID)
+        t = ffmodel.add(a, b)
+        t = ffmodel.subtract(t, b)
+        t = ffmodel.multiply(t, a)
+        t = ffmodel.exp(t)
+        t = ffmodel.dropout(t, 0.2, 0)
+        parts = ffmodel.split(t, 2, axis=1)
+        t = ffmodel.concat(parts, axis=1)
+        t = ffmodel.reshape(t, [8, 4, 4])
+        t = ffmodel.transpose(t, [0, 2, 1])
+        t = ffmodel.reverse(t, 1)
+        t = ffmodel.reshape(t, [8, 16])
+        t = ffmodel.dense(t, 4)
+        t = ffmodel.softmax(t)
+        ffmodel.compile(
+            optimizer=AdamOptimizer(ffmodel, 0.001),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.METRICS_ACCURACY])
+        ffmodel.init_layers()
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 3, 16, 16)).astype(np.float32)
+        y = rng.integers(0, 4, (8, 1)).astype(np.int32)
+        full_x = ffmodel.create_tensor([8, 3, 16, 16], DataType.DT_FLOAT)
+        full_y = ffmodel.create_tensor([8, 1], DataType.DT_INT32)
+        full_x.attach_numpy_array(ffconfig, x)
+        full_y.attach_numpy_array(ffconfig, y)
+        dl = DataLoader2D(ffmodel, img, ffmodel.get_label_tensor(),
+                          full_x, full_y, 8)
+        ffmodel.train((dl,), epochs=1)
+
+    def test_embedding_and_constant(self):
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "16"])
+        ffmodel = FFModel(ffconfig)
+        idx = ffmodel.create_tensor([16, 4], DataType.DT_INT64)
+        emb = ffmodel.embedding(idx, 100, 8, AggrMode.AGGR_MODE_SUM,
+                                kernel_initializer=GlorotUniformInitializer(7))
+        c = ffmodel.create_constant([16, 8], 1.0, DataType.DT_FLOAT)
+        t = ffmodel.multiply(emb, c)
+        t = ffmodel.dense(t, 1, ActiMode.AC_MODE_SIGMOID,
+                          bias_initializer=ZeroInitializer())
+        ffmodel.compile(optimizer=SGDOptimizer(ffmodel, 0.01),
+                        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR,
+                                 MetricsType.METRICS_ACCURACY])
+        ffmodel.init_layers()
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 100, (16, 4)).astype(np.int64)
+        lab = rng.random((16, 1)).astype(np.float32)
+        full_i = ffmodel.create_tensor([16, 4], DataType.DT_INT64)
+        full_l = ffmodel.create_tensor([16, 1], DataType.DT_FLOAT)
+        full_i.attach_numpy_array(ffconfig, ids)
+        full_l.attach_numpy_array(ffconfig, lab)
+        dl_i = SingleDataLoader(ffmodel, idx, full_i, 16, DataType.DT_INT64)
+        dl_l = SingleDataLoader(ffmodel, ffmodel.get_label_tensor(), full_l,
+                                16, DataType.DT_FLOAT)
+        ffmodel.train((dl_i, dl_l), epochs=1)
+
+    def test_netconfig(self):
+        nc = NetConfig()
+        assert nc.dataset_path == ""
+
+
+class TestKerasScriptParity:
+    """reference seq_mnist_mlp.py shape: input_shape on first layer, keras
+    optimizers/initializers/losses/metrics modules."""
+
+    def test_seq_mnist_mlp_script(self):
+        import flexflow.keras.optimizers
+        from flexflow.keras.initializers import GlorotUniform, Zeros
+        from flexflow.keras.layers import Activation, Dense, Dropout
+        from flexflow.keras.models import Sequential
+
+        x_train, y_train = _mnist_like(128, d=48, classes=10)
+
+        model = Sequential()
+        d1 = Dense(64, input_shape=(48,),
+                   kernel_initializer=GlorotUniform(123),
+                   bias_initializer=Zeros())
+        model.add(d1)
+        model.add(Activation("relu"))
+        model.add(Dropout(0.1))
+        model.add(Dense(64, activation="relu"))
+        model.add(Dense(10))
+        model.add(Activation("softmax"))
+
+        opt = flexflow.keras.optimizers.SGD(learning_rate=0.05)
+        model.compile(optimizer=opt,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy", "sparse_categorical_crossentropy"],
+                      batch_size=32)
+        model.fit(x_train, y_train, epochs=2, verbose=False)
+        model.evaluate(x=x_train, y=y_train)
+
+    def test_functional_with_loss_metric_objects(self):
+        from flexflow.keras import losses, metrics
+        from flexflow.keras.layers import Concatenate, Dense, Input
+        from flexflow.keras.models import Model
+
+        i1 = Input(shape=(8,))
+        i2 = Input(shape=(8,))
+        merged = Concatenate(axis=1)([i1, i2])
+        out = Dense(4, activation="relu")(merged)
+        out = Dense(2)(out)
+        from flexflow.keras.layers import Activation
+        out = Activation("softmax")(out)
+        model = Model(inputs=[i1, i2], outputs=out)
+        model.compile(optimizer="adam",
+                      loss=losses.SparseCategoricalCrossentropy(),
+                      metrics=[metrics.Accuracy(),
+                               metrics.SparseCategoricalCrossentropy()],
+                      batch_size=16)
+        rng = np.random.default_rng(0)
+        x1 = rng.standard_normal((32, 8)).astype(np.float32)
+        x2 = rng.standard_normal((32, 8)).astype(np.float32)
+        y = rng.integers(0, 2, (32, 1)).astype(np.int32)
+        model.fit([x1, x2], y, epochs=1, verbose=False)
+
+    def test_datasets_and_utils(self):
+        from flexflow.keras.datasets import cifar10, mnist
+        from flexflow.keras.utils import np_utils, to_categorical
+
+        (x, y), _ = mnist.load_data()
+        assert x.shape[1:] == (28, 28)
+        (xc, yc), _ = cifar10.load_data()
+        assert xc.shape[1:] == (3, 32, 32)
+        oh = to_categorical(np.array([0, 2, 1]), 3)
+        assert oh.shape == (3, 3)
+        assert np_utils.to_categorical is to_categorical
+
+
+class TestTorchScriptParity:
+    """reference examples/python/pytorch/mnist_mlp.py shape."""
+
+    def test_torch_to_flexflow_roundtrip(self, tmp_path):
+        import torch.nn as nn
+
+        from flexflow.torch.fx import torch_to_flexflow
+        from flexflow.torch.model import PyTorchModel
+
+        mlp = nn.Sequential(nn.Linear(32, 16), nn.ReLU(), nn.Linear(16, 4),
+                            nn.Softmax(dim=1))
+        fname = str(tmp_path / "mlp.ff")
+        torch_to_flexflow(mlp, fname)
+
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "16"])
+        ffmodel = FFModel(ffconfig)
+        input_tensor = ffmodel.create_tensor([16, 32], DataType.DT_FLOAT)
+        torch_model = PyTorchModel(fname)
+        output_tensors = torch_model.apply(ffmodel, [input_tensor])
+        assert output_tensors[0].dims == (16, 4)
+
+        ffmodel.compile(
+            optimizer=SGDOptimizer(ffmodel, 0.01),
+            loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+            metrics=[MetricsType.METRICS_ACCURACY])
+        ffmodel.init_layers()
+        torch_model.import_weights(ffmodel)
+
+        # forward parity vs torch on the same batch
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        full_x = ffmodel.create_tensor([16, 32], DataType.DT_FLOAT)
+        full_x.attach_numpy_array(ffconfig, x)
+        dl = SingleDataLoader(ffmodel, input_tensor, full_x, 16,
+                              DataType.DT_FLOAT)
+        dl.next_batch(ffmodel)
+        ffmodel.forward()
+        got = output_tensors[0].get_array(ffconfig)
+
+        import torch
+        with torch.no_grad():
+            want = mlp(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

@@ -76,14 +76,30 @@ class TestAdam:
 
 class TestLosses:
     def test_sparse_cce_grad_matches_reference_kernel(self, rng):
-        """grad = (softmax(logits) - onehot)/batch (loss_functions.cu:36-50)."""
+        """grad at the logits = (softmax(logits) - onehot)/batch
+        (loss_functions.cu:36-50), via both entry points: the from-logits
+        fused form, and the probs form composed with an upstream softmax
+        (the reference's Softmax-op + sparse-CCE pipeline)."""
+        from dlrm_flexflow_tpu.losses import (
+            sparse_categorical_crossentropy_from_logits)
+
         logits = rng.standard_normal((6, 4), dtype=np.float32)
         labels = rng.integers(0, 4, size=(6,))
-        g = np.asarray(jax.grad(sparse_categorical_crossentropy)(
-            jnp.asarray(logits), jnp.asarray(labels)))
         sm = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
         onehot = np.eye(4)[labels]
-        np.testing.assert_allclose(g, (sm - onehot) / 6, atol=1e-5, rtol=1e-5)
+        want = (sm - onehot) / 6
+
+        g = np.asarray(jax.grad(sparse_categorical_crossentropy_from_logits)(
+            jnp.asarray(logits), jnp.asarray(labels)))
+        np.testing.assert_allclose(g, want, atol=1e-5, rtol=1e-5)
+
+        def through_softmax(lg, lab):
+            return sparse_categorical_crossentropy(
+                jax.nn.softmax(lg, axis=-1), lab)
+
+        g2 = np.asarray(jax.grad(through_softmax)(jnp.asarray(logits),
+                                                  jnp.asarray(labels)))
+        np.testing.assert_allclose(g2, want, atol=1e-5, rtol=1e-5)
 
     def test_mse_grad_matches_reference_kernel(self, rng):
         """grad = 2*(pred-label)/batch per element (loss_functions.cu:64-74)."""
