@@ -43,19 +43,117 @@ class Layer:
         self.input_dtype = dtype
         self._inbound: List["Layer"] = []
         self._node: Optional[object] = None  # symbolic KTensor
+        # filled in at lowering time by BaseModel._emit: per owning keras
+        # model, the core Op(s) this layer produced there — what makes
+        # layer.get_weights/set_weights (reference net2net examples, e.g.
+        # seq_mnist_mlp_net2net.py) work, including when the same layer
+        # object ends up lowered into several models (teacher + composed).
+        # id(owner) -> [owner, ops, build_gen]
+        self._bindings: Dict[int, list] = {}
 
     def __call__(self, *inputs):
-        ins = []
-        for i in inputs:
-            ins.extend(i if isinstance(i, (list, tuple)) else [i])
-        out = KTensor(self, ins)
-        return out
+        return KTensor(self, _flatten_ktensors(inputs))
 
     def lower(self, model: FFModel, xs):
         raise NotImplementedError
 
     def output_steps(self):  # number of core tensors produced
         return 1
+
+    # ---- weight transfer (reference layer.get_weights/set_weights, used by
+    # the net2net examples: seq_mnist_mlp_net2net.py:39-72) ------------------
+    def _built_op(self, ffmodel=None):
+        """Resolve (owning keras model, core op) for weight access.
+
+        ``ffmodel`` — a core FFModel or keras BaseModel — selects among
+        owners when this layer is bound into several models (the reference
+        passes ``teacher_model.ffmodel`` explicitly for exactly this
+        reason); without it the most recently bound owner wins.
+        """
+        cands = []
+        for owner, ops, gen in self._bindings.values():
+            real = [o for o in ops if o is not _NESTED_MARKER]
+            if not real or owner.state is None or gen != owner._build_gen:
+                continue
+            cands.append((owner, real[0]))
+        if ffmodel is not None:
+            for owner, op in cands:
+                if owner is ffmodel or owner.ffmodel is ffmodel:
+                    return owner, op
+            raise ValueError(
+                f"layer {self.name or type(self).__name__} is not part of "
+                "the given model — pass the model that contains it (or no "
+                "model at all for the most recent binding)")
+        if not cands:
+            raise ValueError(
+                f"layer {self.name or type(self).__name__} has no built "
+                "weights — compile the model that contains it first")
+        return cands[-1]
+
+    def get_weights(self, ffmodel=None) -> Tuple[np.ndarray, ...]:
+        """Return this layer's weights as numpy arrays (kernel, bias, ...).
+
+        ``ffmodel`` follows the reference signature
+        (``dense.get_weights(model.ffmodel)``) and disambiguates which
+        model's TrainState to read when the layer is part of several.
+        """
+        owner, op = self._built_op(ffmodel)
+        return tuple(np.asarray(owner.state.params[op.name][s.param_name])
+                     for s in op.param_specs())
+
+    def set_weights(self, *args):
+        """Overwrite this layer's weights.
+
+        Accepts the reference form ``set_weights(ffmodel, kernel, bias)``
+        and the keras form ``set_weights([kernel, bias])``.
+        """
+        arrays: List[np.ndarray] = []
+        target = None
+        for a in args:
+            if isinstance(a, (BaseModel, FFModel)):
+                target = a  # reference passes model.ffmodel first
+            elif isinstance(a, (list, tuple)):
+                arrays.extend(a)
+            else:
+                arrays.append(a)
+        owner, op = self._built_op(target)
+        specs = op.param_specs()
+        if len(arrays) != len(specs):
+            raise ValueError(f"expected {len(specs)} arrays "
+                             f"({[s.param_name for s in specs]}), "
+                             f"got {len(arrays)}")
+        st = owner.state
+        for spec, arr in zip(specs, arrays):
+            arr = np.asarray(arr)
+            if tuple(arr.shape) != tuple(spec.shape):
+                raise ValueError(
+                    f"weight {op.name}/{spec.param_name}: expected shape "
+                    f"{tuple(spec.shape)}, got {tuple(arr.shape)}")
+            st = owner.ffmodel.set_weights(st, op.name, spec.param_name, arr)
+        owner.state = st
+
+
+#: placeholder recorded in a nested model's ``_ops`` to mark "lowered in
+#: this build" without pretending the model itself owns a single core Op
+_NESTED_MARKER = object()
+
+
+def _flatten_ktensors(inputs) -> List["KTensor"]:
+    ins: List[KTensor] = []
+    for i in inputs:
+        ins.extend(i if isinstance(i, (list, tuple)) else [i])
+    return ins
+
+
+def _leaf_layers(model) -> List[Layer]:
+    """All plain (non-model) layers of a model, nested models expanded."""
+    out: List[Layer] = []
+    for l in model._keras_layers():
+        if isinstance(l, BaseModel):
+            out.extend(_leaf_layers(l))
+        else:
+            out.append(l)
+    return out
 
 
 class KTensor:
@@ -74,7 +172,11 @@ class Input(Layer):
         self.dtype = dtype
 
     def __call__(self):
-        return KTensor(self, [])
+        # one symbolic node per Input layer, so Model(inputs=the_layer, ...)
+        # and the DAG built from the_layer() agree on node identity
+        if self._node is None:
+            self._node = KTensor(self, [])
+        return self._node
 
 
 def InputTensor(shape, dtype="float32", name=None):
@@ -267,10 +369,99 @@ class BaseModel:
         self.state: Optional[TrainState] = None
         self._input_names: List[str] = []
         self.batch_size: Optional[int] = None
+        # layer-protocol fields, present because a model can be nested as a
+        # layer inside another model
+        self._bindings: Dict[int, list] = {}
+        self._sym = None
+        self._build_gen: int = 0  # bumped per compile; invalidates stale ops
+        self._nested_used: List["BaseModel"] = []  # nested models, per build
 
     # built by subclasses: populate self.ffmodel + self._input_names
     def _build(self, batch_size: int):
         raise NotImplementedError
+
+    # ---- composition: a model is also a layer (reference nested examples:
+    # func_cifar10_cnn_nested.py model2(model1(x)), seq_mnist_cnn_nested.py
+    # Sequential().add(model1)) ----------------------------------------------
+    def __call__(self, *inputs) -> "KTensor":
+        return KTensor(self, _flatten_ktensors(inputs))
+
+    def _claim(self, layer) -> list:
+        """Bind ``layer`` to this model for the current build generation and
+        return its [owner, ops, gen] binding record."""
+        b = layer._bindings.get(id(self))
+        if b is None or b[2] != self._build_gen:
+            b = [self, [], self._build_gen]
+            layer._bindings[id(self)] = b
+        return b
+
+    def _emit(self, layer, xs):
+        """Lower one layer (or nested model) into self.ffmodel, recording
+        the produced core Op on the layer for weight access."""
+        b = self._claim(layer)
+        if isinstance(layer, BaseModel):
+            if b[1]:
+                raise NotImplementedError(
+                    "using the same nested model on multiple inputs "
+                    "(weight sharing) is not supported — build a second "
+                    "model instance instead")
+            self._nested_used.append(layer)
+            out = layer._lower_into(self, xs)
+            b[1].append(_NESTED_MARKER)  # mark as lowered this build
+            return out
+        # re-lowering a layer WITH weights would silently create a second,
+        # unshared weight set; stateless layers (Activation/Flatten/...)
+        # can be reused freely — each use just emits a fresh op
+        if any(o is not _NESTED_MARKER and o.param_specs() for o in b[1]):
+            raise NotImplementedError(
+                f"layer {layer.name or type(layer).__name__} was already "
+                "used in this model — shared layers (one weighted layer "
+                "called on multiple inputs) are not supported; create a "
+                "new layer instance per call site")
+        t = layer.lower(self.ffmodel, xs)
+        op = getattr(t, "owner_op", None)
+        if op is not None:
+            b[1].append(op)
+        return t
+
+    def _lower_into(self, outer: "BaseModel", xs):
+        """Replay this model's layers into ``outer``'s graph (nested use).
+        Implemented by subclasses."""
+        raise NotImplementedError
+
+    def _input_signature_hint(self) -> Tuple[Tuple[int, ...], str]:
+        """(per-sample shape, dtype) of this model's first input."""
+        raise NotImplementedError
+
+    # ---- symbolic accessors (reference base_model.py:67-97: model.input /
+    # model.output / get_layer) ----------------------------------------------
+    @property
+    def input(self) -> List["KTensor"]:
+        return self._symbolic()[0]
+
+    @property
+    def output(self) -> "KTensor":
+        return self._symbolic()[1]
+
+    def _symbolic(self):
+        """(input KTensors, output KTensor) of this model's own DAG."""
+        raise NotImplementedError
+
+    def _keras_layers(self) -> List[Layer]:
+        raise NotImplementedError
+
+    def get_layer(self, name: Optional[str] = None,
+                  index: Optional[int] = None) -> Layer:
+        """reference base_model.py:90 — look up a layer by name or index."""
+        layers = self._keras_layers()
+        if name is not None:
+            for l in layers:
+                if getattr(l, "name", None) == name:
+                    return l
+            raise ValueError(f"no layer named {name!r}")
+        if index is not None:
+            return layers[index]
+        raise ValueError("pass name= or index=")
 
     def compile(self, optimizer="sgd", loss="categorical_crossentropy",
                 metrics=("accuracy",), batch_size: int = 32):
@@ -278,6 +469,8 @@ class BaseModel:
             optimizer = _OPTIMIZERS[optimizer.lower()]()
         assert isinstance(optimizer, Optimizer)
         self.batch_size = batch_size
+        self._build_gen += 1  # invalidates layer->op bindings of prior builds
+        self._nested_used = []
         self._build(batch_size)
         # keras loss/metric marker objects carry their registry name
         loss = getattr(loss, "name", None) or loss
@@ -286,7 +479,36 @@ class BaseModel:
         self.ffmodel.compile(optimizer=optimizer, loss_type=loss,
                              metrics=tuple(metrics))
         self.state = self.ffmodel.init()
+        self._adopt_nested_weights()
         return self
+
+    def _adopt_nested_weights(self):
+        """Composing an already-compiled (possibly trained) model into this
+        one starts from its CURRENT weights, keras-style, instead of
+        silently re-initializing them.
+
+        ``_nested_used`` records parents before their children, so iterate
+        reversed: a parent model's state (which contains the most recent
+        training of its sub-models' layers) is applied last and wins over a
+        doubly-nested child's stale standalone state."""
+        for nested in reversed(self._nested_used):
+            if nested.state is None:
+                continue
+            for layer in _leaf_layers(nested):
+                src = layer._bindings.get(id(nested))
+                dst = layer._bindings.get(id(self))
+                if src is None or dst is None:
+                    continue
+                if src[2] != nested._build_gen or dst[2] != self._build_gen:
+                    continue
+                src_ops = [o for o in src[1] if o is not _NESTED_MARKER]
+                dst_ops = [o for o in dst[1] if o is not _NESTED_MARKER]
+                for s_op, d_op in zip(src_ops, dst_ops):
+                    for spec in s_op.param_specs():
+                        val = nested.state.params[s_op.name][spec.param_name]
+                        self.state = self.ffmodel.set_weights(
+                            self.state, d_op.name, spec.param_name,
+                            np.asarray(val))
 
     def _as_input_dict(self, x) -> Dict[str, np.ndarray]:
         if isinstance(x, dict):
@@ -339,6 +561,14 @@ class BaseModel:
         return np.asarray(self.ffmodel.forward(self.state, inputs))
 
     def summary(self) -> str:
+        if self.ffmodel is None:
+            # pre-compile summary (reference prints sub-model summaries
+            # before the composed model is compiled)
+            lines = [f"Model: {self.name or type(self).__name__} "
+                     "(not compiled)"]
+            for l in self._keras_layers():
+                lines.append(f"  {l.name or type(l).__name__}")
+            return "\n".join(lines)
         lines = [f"Model: {self.name or type(self).__name__}"]
         for op in self.ffmodel.layers:
             lines.append(f"  {op.name:24s} {op.op_type:16s} "
@@ -355,25 +585,55 @@ class Sequential(BaseModel):
 
     def add(self, layer: Layer):
         self._layers.append(layer)
+        self._sym = None  # invalidate cached symbolic chain
 
-    def _build(self, batch_size: int):
+    def _split_input(self):
         assert self._layers, "Sequential model has no layers"
         first = self._layers[0]
         if isinstance(first, Input):
-            inp, rest = first, self._layers[1:]
+            return first, self._layers[1:]
+        if isinstance(first, BaseModel):
+            shape, dtype = first._input_signature_hint()
         else:
             # reference-style: first layer carries input_shape
-            assert first.input_shape is not None, (
-                "Sequential model needs an Input layer or input_shape= on "
-                "the first layer")
-            inp = Input(first.input_shape, first.input_dtype)
-            rest = self._layers
+            shape, dtype = first.input_shape, first.input_dtype
+        assert shape is not None, (
+            "Sequential model needs an Input layer or input_shape= on "
+            "the first layer")
+        return Input(shape, dtype), self._layers
+
+    def _build(self, batch_size: int):
+        inp, rest = self._split_input()
         self.ffmodel = FFModel(FFConfig(batch_size=batch_size))
         t = self.ffmodel.create_tensor((batch_size,) + inp.shape, inp.dtype,
                                        name=inp.name or "input")
         self._input_names = [t.name]
         for layer in rest:
-            t = layer.lower(self.ffmodel, [t])
+            t = self._emit(layer, [t])
+
+    def _lower_into(self, outer: BaseModel, xs):
+        t = xs[0]
+        _, rest = self._split_input()
+        for layer in rest:
+            t = outer._emit(layer, [t])
+        return t
+
+    def _input_signature_hint(self):
+        inp, _ = self._split_input()
+        return inp.shape, inp.dtype
+
+    def _symbolic(self):
+        if getattr(self, "_sym", None) is None:
+            inp, rest = self._split_input()
+            kt = inp()
+            out = kt
+            for layer in rest:
+                out = layer(out)
+            self._sym = ([kt], out)
+        return self._sym
+
+    def _keras_layers(self):
+        return [l for l in self._layers if not isinstance(l, Input)]
 
 
 class Model(BaseModel):
@@ -381,7 +641,9 @@ class Model(BaseModel):
 
     def __init__(self, inputs, outputs, name=None):
         super().__init__(name)
-        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        # tolerate Input layer objects in place of their symbolic tensors
+        self._inputs = [i() if isinstance(i, Input) else i for i in ins]
         self._outputs = (outputs if isinstance(outputs, (list, tuple))
                          else [outputs])
 
@@ -389,6 +651,19 @@ class Model(BaseModel):
         self.ffmodel = FFModel(FFConfig(batch_size=batch_size))
         lowered: Dict[int, object] = {}
         self._input_names = []
+
+        # declared inputs first, so multi-input fit([x1, x2], y) binds
+        # arrays to tensors in the user's declared order, not DAG-traversal
+        # order (non-Input declared tensors — a model rooted at an
+        # intermediate tensor — are left for visit() to lower upstream)
+        for kt in self._inputs:
+            if not isinstance(kt.layer, Input):
+                continue
+            t = self.ffmodel.create_tensor(
+                (batch_size,) + kt.layer.shape, kt.layer.dtype,
+                name=kt.layer.name)
+            lowered[id(kt)] = t
+            self._input_names.append(t.name)
 
         def visit(kt: KTensor):
             key = id(kt)
@@ -401,12 +676,55 @@ class Model(BaseModel):
                 self._input_names.append(t.name)
             else:
                 xs = [visit(i) for i in kt.inputs]
-                t = kt.layer.lower(self.ffmodel, xs)
+                t = self._emit(kt.layer, xs)
             lowered[key] = t
             return t
 
         for out in self._outputs:
             visit(out)
+
+    def _lower_into(self, outer: BaseModel, xs):
+        assert len(xs) == len(self._inputs), (
+            f"nested model takes {len(self._inputs)} inputs, got {len(xs)}")
+        lowered = {id(kt): x for kt, x in zip(self._inputs, xs)}
+
+        def visit(kt: KTensor):
+            key = id(kt)
+            if key in lowered:
+                return lowered[key]
+            assert not isinstance(kt.layer, Input), (
+                "nested model input not bound")
+            t = outer._emit(kt.layer, [visit(i) for i in kt.inputs])
+            lowered[key] = t
+            return t
+
+        outs = [visit(o) for o in self._outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def _input_signature_hint(self):
+        return self._inputs[0].layer.shape, self._inputs[0].layer.dtype
+
+    def _symbolic(self):
+        ins = list(self._inputs)
+        outs = self._outputs
+        return ins, (outs[0] if len(outs) == 1 else outs)
+
+    def _keras_layers(self):
+        seen_nodes, seen_layers, order = set(), set(), []
+
+        def visit(kt: KTensor):
+            if id(kt) in seen_nodes:
+                return
+            seen_nodes.add(id(kt))
+            for i in kt.inputs:
+                visit(i)
+            if not isinstance(kt.layer, Input) and id(kt.layer) not in seen_layers:
+                seen_layers.add(id(kt.layer))
+                order.append(kt.layer)
+
+        for out in self._outputs:
+            visit(out)
+        return order
 
 
 # ---------------------------------------------------------------- submodules

@@ -419,6 +419,18 @@ class FFModel:
         # label tensor (reference model.cc:1046-1060: dims copied from final
         # output; 1 class-dim entry for sparse CCE)
         out = self.final_tensor
+        return self._compile_body(out, loss_type, donate_state)
+
+    @property
+    def has_stochastic(self) -> bool:
+        """True when the graph consumes per-step randomness (training-mode
+        dropout) — the single source of truth for rng-split decisions in
+        both the fused train_step and the compat binding's imperative
+        verbs."""
+        return any(isinstance(op, Dropout) and op.rate > 0.0
+                   for op in self.layers)
+
+    def _compile_body(self, out, loss_type, donate_state):
         if "sparse" in loss_type:
             lshape = tuple(out.shape[:-1]) + (1,)
             ldtype = jnp.int32
@@ -438,8 +450,7 @@ class FFModel:
 
         # only Dropout consumes per-step randomness; skipping the split for
         # deterministic graphs keeps the threefry kernel out of the hot loop
-        has_stochastic = any(isinstance(op, Dropout) and op.rate > 0.0
-                             for op in self.layers)
+        has_stochastic = self.has_stochastic
 
         def train_step(state: TrainState, inputs, labels):
             if has_stochastic:

@@ -481,7 +481,9 @@ class FFModel:
                 comp_mode=None):
         if optimizer is not None:
             self._opt_compat = optimizer
-        core_opt = getattr(self._opt_compat, "_core", None)
+        # unwrap compat optimizers; pass core optimizers straight through
+        # (never silently drop to the default-SGD fallback)
+        core_opt = getattr(self._opt_compat, "_core", self._opt_compat)
         loss = _LOSS[loss_type] if isinstance(loss_type, LossType) \
             else (loss_type or "mean_squared_error")
         mets = tuple(_METRIC[m] if isinstance(m, MetricsType) else m
@@ -514,6 +516,11 @@ class FFModel:
         self._upd = jax.jit(
             lambda params, grads, opt_state: core.optimizer.update(
                 params, grads, opt_state))
+        # mirror the fused train_step: only split the RNG when the graph
+        # actually consumes per-step randomness
+        self._has_stochastic = core.has_stochastic
+        self._pending_bn = None
+        self._pending_rng = None
 
     def _batch_inputs(self):
         names = self._input_names()
@@ -548,9 +555,17 @@ class FFModel:
     def backward(self):
         state = self._require_state()
         inputs, labels = self._batch_inputs()
-        (loss, (preds, _)), grads = self._bwd(
-            state.params, inputs, labels, state.rng, state.bn_state)
+        if self._has_stochastic:
+            rng, next_rng = jax.random.split(state.rng)
+        else:
+            rng, next_rng = None, state.rng
+        (loss, (preds, new_bn)), grads = self._bwd(
+            state.params, inputs, labels, rng, state.bn_state)
         self._grads = grads
+        # threaded into the new TrainState by update(), exactly like the
+        # fused train_step does
+        self._pending_bn = new_bn
+        self._pending_rng = next_rng
         mets = compute_metrics(preds, labels, self._acc.metrics or
                                self._core.metrics, self._core.loss_type)
         self._acc.update(mets)
@@ -559,9 +574,15 @@ class FFModel:
         state = self._require_state()
         assert self._grads is not None, "backward() before update()"
         params, opt = self._upd(state.params, self._grads, state.opt_state)
-        self._state = TrainState(params, opt, state.bn_state, state.rng,
+        new_bn = self._pending_bn if self._pending_bn is not None \
+            else state.bn_state
+        new_rng = self._pending_rng if self._pending_rng is not None \
+            else state.rng
+        self._state = TrainState(params, opt, new_bn, new_rng,
                                  state.step + 1)
         self._grads = None
+        self._pending_bn = None
+        self._pending_rng = None
 
     def compute_metrics(self):
         _, labels = self._batch_inputs()

@@ -337,3 +337,76 @@ class TestTorchScriptParity:
         with torch.no_grad():
             want = mlp(torch.from_numpy(x)).numpy()
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+class TestImperativeStateThreading:
+    """The imperative verb loop must thread BN running stats and the PRNG
+    exactly like the fused train_step (regression: they were dropped)."""
+
+    def _build(self, with_dropout=False, with_bn=False):
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "16"])
+        ffmodel = FFModel(ffconfig)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = rng.standard_normal((16, 1)).astype(np.float32)
+        inp = ffmodel.create_tensor([16, 8], DataType.DT_FLOAT)
+        t = ffmodel.dense(inp, 8, ActiMode.AC_MODE_RELU)
+        if with_bn:
+            # batch_norm in the binding expects NCHW; use a dense->reshape
+            t4 = ffmodel.reshape(t, [16, 2, 2, 2])
+            t4 = ffmodel.batch_norm(t4, relu=False)
+            t = ffmodel.reshape(t4, [16, 8])
+        if with_dropout:
+            t = ffmodel.dropout(t, 0.5, 0)
+        t = ffmodel.dense(t, 1)
+        ffmodel.compile(optimizer=SGDOptimizer(ffmodel, 0.05),
+                        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                        metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        ffmodel.init_layers()
+        label = ffmodel.get_label_tensor()
+        fx = ffmodel.create_tensor([16, 8], DataType.DT_FLOAT)
+        fy = ffmodel.create_tensor([16, 1], DataType.DT_FLOAT)
+        fx.attach_numpy_array(ffconfig, x)
+        fy.attach_numpy_array(ffconfig, y)
+        dx = SingleDataLoader(ffmodel, inp, fx, 16, DataType.DT_FLOAT)
+        dy = SingleDataLoader(ffmodel, label, fy, 16, DataType.DT_FLOAT)
+        return ffmodel, dx, dy
+
+    def _step(self, ffmodel, dx, dy):
+        dx.reset(); dy.reset()
+        dx.next_batch(ffmodel); dy.next_batch(ffmodel)
+        ffmodel.forward()
+        ffmodel.zero_gradients()
+        ffmodel.backward()
+        ffmodel.update()
+
+    def test_bn_running_stats_advance(self):
+        ffmodel, dx, dy = self._build(with_bn=True)
+        import jax
+        before = jax.tree_util.tree_leaves(ffmodel._state.bn_state)
+        assert before, "graph has no BN state"
+        self._step(ffmodel, dx, dy)
+        after = jax.tree_util.tree_leaves(ffmodel._state.bn_state)
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_rng_advances_with_dropout(self):
+        ffmodel, dx, dy = self._build(with_dropout=True)
+        rng_before = np.asarray(ffmodel._state.rng)
+        self._step(ffmodel, dx, dy)
+        assert not np.array_equal(rng_before, np.asarray(ffmodel._state.rng))
+
+    def test_core_optimizer_passthrough(self):
+        """compile(optimizer=<core optimizer>) must not silently fall back
+        to default SGD."""
+        import dlrm_flexflow_tpu as ffcore
+        ffconfig = FFConfig()
+        ffconfig.parse_args(["-b", "16"])
+        ffmodel = FFModel(ffconfig)
+        inp = ffmodel.create_tensor([16, 8], DataType.DT_FLOAT)
+        ffmodel.dense(inp, 1)
+        core_adam = ffcore.AdamOptimizer(lr=0.007)
+        ffmodel.compile(optimizer=core_adam,
+                        loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                        metrics=[])
+        assert ffmodel._core.optimizer is core_adam

@@ -1,0 +1,233 @@
+"""Nested-model composition and net2net weight transfer in the keras
+frontend (reference: examples/python/keras/{seq,func}_*_net2net.py weight
+transfer via layer.get_weights/set_weights; func_cifar10_cnn_nested.py
+model2(model1(x)); seq_mnist_cnn_nested.py Sequential().add(model);
+func_cifar10_cnn_concat_seq_model.py Model([m1.input[0], m2.input[0]], out)
+composing sub-model symbolic outputs)."""
+
+import numpy as np
+import pytest
+
+from dlrm_flexflow_tpu.frontends.keras import (Activation, Concatenate,
+                                               Dense, Input, Model,
+                                               Sequential)
+
+
+def _data(n=64, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(n, 1)
+    return x, y
+
+
+class TestNet2Net:
+    def test_layer_weight_transfer_between_models(self):
+        x, y = _data()
+        teacher = Sequential([
+            Dense(16, activation="relu", input_shape=(8,), name="d1"),
+            Dense(16, activation="relu", name="d2"),
+            Dense(4, name="d3"),
+            Activation("softmax"),
+        ])
+        teacher.compile(optimizer="sgd",
+                        loss="sparse_categorical_crossentropy",
+                        metrics=("accuracy",), batch_size=16)
+        teacher.fit(x, y, epochs=1, verbose=False)
+
+        # reference net2net pattern: read trained weights by layer index
+        d1 = teacher.get_layer(index=0)
+        k1, b1 = d1.get_weights(teacher.ffmodel)
+        k2, b2 = teacher.get_layer(index=1).get_weights(teacher.ffmodel)
+        k3, b3 = teacher.get_layer(name="d3").get_weights(teacher.ffmodel)
+        assert k1.shape == (8, 16) and b1.shape == (16,)
+
+        student_layers = [
+            Dense(16, activation="relu", input_shape=(8,), name="s1"),
+            Dense(16, activation="relu", name="s2"),
+            Dense(4, name="s3"),
+            Activation("softmax"),
+        ]
+        student = Sequential(student_layers)
+        student.compile(optimizer="sgd",
+                        loss="sparse_categorical_crossentropy",
+                        metrics=("accuracy",), batch_size=16)
+        student_layers[0].set_weights(student.ffmodel, k1, b1)
+        student_layers[1].set_weights(student.ffmodel, [k2, b2])  # keras form
+        student_layers[2].set_weights(student.ffmodel, k3, b3)
+
+        # identical weights + deterministic graph => identical predictions
+        np.testing.assert_allclose(student.predict(x[:16]),
+                                   teacher.predict(x[:16]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_set_weights_shape_mismatch_raises(self):
+        x, y = _data()
+        m = Sequential([Dense(4, input_shape=(8,), name="d")])
+        m.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=(), batch_size=16)
+        with pytest.raises(ValueError):
+            m.get_layer(index=0).set_weights(np.zeros((8, 4)))  # missing bias
+
+    def test_unbuilt_layer_raises(self):
+        with pytest.raises(ValueError):
+            Dense(4).get_weights()
+
+
+class TestNestedModels:
+    def test_functional_model_of_models(self):
+        """model2(model1(x)) — func_cifar10_cnn_nested.py shape."""
+        x, y = _data()
+
+        in1 = Input(shape=(8,))()
+        out1 = Dense(16, activation="relu")(in1)
+        model1 = Model(in1, out1)
+
+        in2 = Input(shape=(16,))()
+        out2 = Dense(4)(in2)
+        out2 = Activation("softmax")(out2)
+        model2 = Model(in2, out2)
+
+        in3 = Input(shape=(8,))()
+        composed = Model(in3, model2(model1(in3)))
+        composed.compile(optimizer="sgd",
+                         loss="sparse_categorical_crossentropy",
+                         metrics=("accuracy",), batch_size=16)
+        composed.fit(x, y, epochs=1, verbose=False)
+        assert composed.predict(x[:16]).shape == (16, 4)
+        # 3 core dense/softmax ops were lowered into ONE graph
+        assert len([op for op in composed.ffmodel.layers]) >= 3
+
+    def test_sequential_of_models(self):
+        """Sequential().add(model1).add(model2) — seq_mnist_cnn_nested.py."""
+        x, y = _data()
+        model1 = Sequential([Dense(16, activation="relu", input_shape=(8,))])
+        in2 = Input(shape=(16,))()
+        out2 = Activation("softmax")(Dense(4)(in2))
+        model2 = Model(in2, out2)
+
+        model = Sequential()
+        model.add(model1)
+        model.add(model2)
+        assert "not compiled" in model.summary()  # pre-compile summary works
+        model.compile(optimizer="sgd",
+                      loss="sparse_categorical_crossentropy",
+                      metrics=("accuracy",), batch_size=16)
+        model.fit(x, y, epochs=1, verbose=False)
+        assert model.predict(x[:16]).shape == (16, 4)
+
+    def test_concat_of_sequential_outputs_multi_input_fit(self):
+        """Concatenate()([m1.output, m2.output]) + Model([m1.input[0],
+        m2.input[0]], out) — func_cifar10_cnn_concat_seq_model.py shape."""
+        x, y = _data()
+        m1 = Sequential([Dense(8, activation="relu", input_shape=(8,))])
+        m2 = Sequential([Dense(8, activation="relu", input_shape=(8,))])
+
+        merged = Concatenate(axis=1)([m1.output, m2.output])
+        out = Activation("softmax")(Dense(4)(merged))
+        model = Model([m1.input[0], m2.input[0]], out)
+        model.compile(optimizer="sgd",
+                      loss="sparse_categorical_crossentropy",
+                      metrics=("accuracy",), batch_size=16)
+        model.fit([x, x], y, epochs=1, verbose=False)
+        assert model.predict([x[:16], x[:16]]).shape == (16, 4)
+
+    def test_nested_weights_live_in_outer_state(self):
+        """Weights of a nested model's layers are accessible after the outer
+        model is compiled — and update when the outer model trains."""
+        x, y = _data()
+        d_inner = Dense(16, activation="relu", input_shape=(8,), name="inner")
+        model1 = Sequential([d_inner])
+        model = Sequential()
+        model.add(model1)
+        model.add(Dense(4, name="head"))
+        model.compile(optimizer="sgd", loss="mean_squared_error",
+                      metrics=(), batch_size=16)
+        k_before, _ = d_inner.get_weights()
+        model.fit(x, y.astype(np.float32), epochs=1, verbose=False)
+        k_after, _ = d_inner.get_weights()
+        assert not np.allclose(k_before, k_after)  # trained through nesting
+
+
+class TestLayerReuseAndRebinding:
+    def test_stateless_layer_reuse_is_allowed(self):
+        """Reusing an Activation (no weights) twice in one model works;
+        only weighted layers refuse sharing."""
+        x, y = _data()
+        relu = Activation("relu")
+        m = Sequential([Dense(16, input_shape=(8,)), relu, Dense(4), relu])
+        m.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=(), batch_size=16)
+        m.fit(x, np.zeros((64, 4), np.float32), epochs=1, verbose=False)
+
+    def test_weighted_layer_reuse_raises(self):
+        shared = Dense(4)
+        a = Input(shape=(8,))()
+        b = Input(shape=(8,))()
+        mm = Model([a, b], Concatenate(axis=1)([shared(a), shared(b)]))
+        with pytest.raises(NotImplementedError):
+            mm.compile(optimizer="sgd", loss="mean_squared_error",
+                       metrics=(), batch_size=8)
+
+    def test_composing_preserves_teacher_weights(self):
+        """Nesting a trained model into a new one must not clobber reads of
+        the teacher's trained weights — and the composed model adopts them."""
+        x, y = _data()
+        teacher = Sequential([Dense(16, activation="relu", input_shape=(8,),
+                                    name="t1"),
+                              Dense(4, name="t2")])
+        teacher.compile(optimizer="sgd", loss="mean_squared_error",
+                        metrics=(), batch_size=16)
+        teacher.fit(x, np.zeros((64, 4), np.float32), epochs=1, verbose=False)
+        k_trained, _ = teacher.get_layer(index=0).get_weights()
+
+        head = Input(shape=(8,))()
+        composed = Model(head, teacher(head))
+        composed.compile(optimizer="sgd", loss="mean_squared_error",
+                         metrics=(), batch_size=16)
+
+        # explicit-ffmodel read still returns the teacher's trained values
+        k_after, _ = teacher.get_layer(index=0).get_weights(teacher.ffmodel)
+        np.testing.assert_array_equal(k_trained, k_after)
+        # and the composed model adopted them rather than re-initializing
+        np.testing.assert_allclose(composed.predict(x[:16]),
+                                   teacher.predict(x[:16]),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_doubly_nested_adoption_prefers_parent_training(self):
+        """top adopting mid (which trained inner's layers) must not be
+        overwritten by inner's stale standalone state."""
+        x, _ = _data()
+        d = Dense(16, activation="relu", input_shape=(8,), name="deep")
+        inner = Sequential([d])
+        inner.compile(optimizer="sgd", loss="mean_squared_error",
+                      metrics=(), batch_size=16)  # standalone state = W0
+        k0, _ = d.get_weights(inner.ffmodel)
+
+        mid = Sequential()
+        mid.add(inner)
+        mid.add(Dense(4, name="mid_head"))
+        mid.compile(optimizer="sgd", loss="mean_squared_error",
+                    metrics=(), batch_size=16)
+        mid.fit(x, np.zeros((64, 4), np.float32), epochs=1, verbose=False)
+        k_trained, _ = d.get_weights(mid.ffmodel)
+        assert not np.allclose(k0, k_trained)
+
+        top = Sequential()
+        top.add(mid)
+        top.add(Dense(2, name="top_head"))
+        top.compile(optimizer="sgd", loss="mean_squared_error",
+                    metrics=(), batch_size=16)
+        k_top, _ = d.get_weights(top.ffmodel)
+        np.testing.assert_array_equal(k_top, k_trained)  # not stale W0
+
+    def test_explicit_wrong_model_raises(self):
+        da = Dense(4, input_shape=(8,), name="da")
+        a = Sequential([da])
+        a.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=(), batch_size=8)
+        b = Sequential([Dense(4, input_shape=(8,))])
+        b.compile(optimizer="sgd", loss="mean_squared_error",
+                  metrics=(), batch_size=8)
+        with pytest.raises(ValueError):
+            da.get_weights(b.ffmodel)
