@@ -16,6 +16,7 @@ factories) and defers execution to the core jitted train step.
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -71,7 +72,10 @@ class Layer:
         reason); without it the most recently bound owner wins.
         """
         cands = []
-        for owner, ops, gen in self._bindings.values():
+        for ref, ops, gen in self._bindings.values():
+            owner = ref()
+            if owner is None:  # model was garbage-collected
+                continue
             real = [o for o in ops if o is not _NESTED_MARKER]
             if not real or owner.state is None or gen != owner._build_gen:
                 continue
@@ -143,17 +147,6 @@ def _flatten_ktensors(inputs) -> List["KTensor"]:
     for i in inputs:
         ins.extend(i if isinstance(i, (list, tuple)) else [i])
     return ins
-
-
-def _leaf_layers(model) -> List[Layer]:
-    """All plain (non-model) layers of a model, nested models expanded."""
-    out: List[Layer] = []
-    for l in model._keras_layers():
-        if isinstance(l, BaseModel):
-            out.extend(_leaf_layers(l))
-        else:
-            out.append(l)
-    return out
 
 
 class KTensor:
@@ -374,7 +367,7 @@ class BaseModel:
         self._bindings: Dict[int, list] = {}
         self._sym = None
         self._build_gen: int = 0  # bumped per compile; invalidates stale ops
-        self._nested_used: List["BaseModel"] = []  # nested models, per build
+        self._emitted_layers: List[Layer] = []  # plain layers, per build
 
     # built by subclasses: populate self.ffmodel + self._input_names
     def _build(self, batch_size: int):
@@ -388,10 +381,20 @@ class BaseModel:
 
     def _claim(self, layer) -> list:
         """Bind ``layer`` to this model for the current build generation and
-        return its [owner, ops, gen] binding record."""
+        return its [owner weakref, ops, gen] binding record.  Owners are
+        held weakly and dead entries pruned, so binding a layer never pins
+        discarded models (and their TrainStates) in memory."""
+        for key in [k for k, (r, _, _) in layer._bindings.items()
+                    if r() is None]:
+            del layer._bindings[key]
         b = layer._bindings.get(id(self))
-        if b is None or b[2] != self._build_gen:
-            b = [self, [], self._build_gen]
+        if b is None or b[0]() is not self or b[2] != self._build_gen:
+            b = [weakref.ref(self), [], self._build_gen]
+            # pop-then-insert so a rebind (recompile) moves this owner to
+            # the END of the dict: "most recently bound" resolution in
+            # _built_op / _adopt_reused_layer_weights relies on insertion
+            # order reflecting binding recency
+            layer._bindings.pop(id(self), None)
             layer._bindings[id(self)] = b
         return b
 
@@ -405,7 +408,6 @@ class BaseModel:
                     "using the same nested model on multiple inputs "
                     "(weight sharing) is not supported — build a second "
                     "model instance instead")
-            self._nested_used.append(layer)
             out = layer._lower_into(self, xs)
             b[1].append(_NESTED_MARKER)  # mark as lowered this build
             return out
@@ -422,6 +424,8 @@ class BaseModel:
         op = getattr(t, "owner_op", None)
         if op is not None:
             b[1].append(op)
+            if layer not in self._emitted_layers:
+                self._emitted_layers.append(layer)
         return t
 
     def _lower_into(self, outer: "BaseModel", xs):
@@ -470,7 +474,7 @@ class BaseModel:
         assert isinstance(optimizer, Optimizer)
         self.batch_size = batch_size
         self._build_gen += 1  # invalidates layer->op bindings of prior builds
-        self._nested_used = []
+        self._emitted_layers = []
         self._build(batch_size)
         # keras loss/metric marker objects carry their registry name
         loss = getattr(loss, "name", None) or loss
@@ -479,36 +483,44 @@ class BaseModel:
         self.ffmodel.compile(optimizer=optimizer, loss_type=loss,
                              metrics=tuple(metrics))
         self.state = self.ffmodel.init()
-        self._adopt_nested_weights()
+        self._adopt_reused_layer_weights()
         return self
 
-    def _adopt_nested_weights(self):
-        """Composing an already-compiled (possibly trained) model into this
-        one starts from its CURRENT weights, keras-style, instead of
-        silently re-initializing them.
-
-        ``_nested_used`` records parents before their children, so iterate
-        reversed: a parent model's state (which contains the most recent
-        training of its sub-models' layers) is applied last and wins over a
-        doubly-nested child's stale standalone state."""
-        for nested in reversed(self._nested_used):
-            if nested.state is None:
+    def _adopt_reused_layer_weights(self):
+        """A layer object that already carries trained weights in another
+        live model keeps them here, keras-style, instead of being silently
+        re-initialized.  Covers every composition path — model(x) nesting,
+        Sequential.add(model), and symbolic m.output/m.input reuse — because
+        it keys on the layer objects actually lowered into this build.  Of
+        several source models the most recently bound one wins (a parent
+        that trained the layer was bound after the sub-model that first
+        owned it)."""
+        for layer in self._emitted_layers:
+            mine = layer._bindings.get(id(self))
+            if mine is None or mine[2] != self._build_gen:
                 continue
-            for layer in _leaf_layers(nested):
-                src = layer._bindings.get(id(nested))
-                dst = layer._bindings.get(id(self))
-                if src is None or dst is None:
+            source = None
+            for ref, ops, gen in layer._bindings.values():
+                owner = ref()
+                if (owner is None or owner is self or owner.state is None
+                        or gen != owner._build_gen):
                     continue
-                if src[2] != nested._build_gen or dst[2] != self._build_gen:
-                    continue
-                src_ops = [o for o in src[1] if o is not _NESTED_MARKER]
-                dst_ops = [o for o in dst[1] if o is not _NESTED_MARKER]
-                for s_op, d_op in zip(src_ops, dst_ops):
-                    for spec in s_op.param_specs():
-                        val = nested.state.params[s_op.name][spec.param_name]
-                        self.state = self.ffmodel.set_weights(
-                            self.state, d_op.name, spec.param_name,
-                            np.asarray(val))
+                source = (owner, ops)
+            if source is None:
+                continue
+            src_owner, src_ops = source
+            s_real = [o for o in src_ops if o is not _NESTED_MARKER]
+            d_real = [o for o in mine[1] if o is not _NESTED_MARKER]
+            for s_op, d_op in zip(s_real, d_real):
+                d_specs = {sp.param_name: sp for sp in d_op.param_specs()}
+                for spec in s_op.param_specs():
+                    dsp = d_specs.get(spec.param_name)
+                    if dsp is None or tuple(dsp.shape) != tuple(spec.shape):
+                        continue  # architectures diverged; keep fresh init
+                    val = src_owner.state.params[s_op.name][spec.param_name]
+                    self.state = self.ffmodel.set_weights(
+                        self.state, d_op.name, spec.param_name,
+                        np.asarray(val))
 
     def _as_input_dict(self, x) -> Dict[str, np.ndarray]:
         if isinstance(x, dict):
@@ -612,6 +624,8 @@ class Sequential(BaseModel):
             t = self._emit(layer, [t])
 
     def _lower_into(self, outer: BaseModel, xs):
+        assert len(xs) == 1, (
+            f"nested Sequential takes 1 input, got {len(xs)}")
         t = xs[0]
         _, rest = self._split_input()
         for layer in rest:
@@ -739,4 +753,5 @@ from . import keras_datasets as datasets  # noqa: E402
 from . import keras_utils as utils  # noqa: E402
 
 preprocessing = _types.SimpleNamespace(
-    sequence=_types.SimpleNamespace(pad_sequences=utils.pad_sequences))
+    sequence=_types.SimpleNamespace(pad_sequences=utils.pad_sequences),
+    text=_types.SimpleNamespace(Tokenizer=utils.Tokenizer))

@@ -119,3 +119,76 @@ class Sequence:
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]
+
+
+class Tokenizer:
+    """Word-id sequence vectorizer (reference
+    python/flexflow/keras/preprocessing/text.py Tokenizer — the reuters
+    example only uses ``sequences_to_matrix``; ``fit_on_texts`` is included
+    for API completeness)."""
+
+    def __init__(self, num_words=None, oov_token=None, split=" ",
+                 lower=True, **_ignored):
+        self.num_words = num_words
+        self.oov_token = oov_token
+        self.split = split
+        self.lower = lower
+        self.word_index = {}
+        self.word_counts = {}
+        self.document_count = 0
+
+    def fit_on_texts(self, texts):
+        for text in texts:
+            self.document_count += 1
+            if self.lower:
+                text = text.lower()
+            for w in text.split(self.split):
+                if not w:
+                    continue
+                self.word_counts[w] = self.word_counts.get(w, 0) + 1
+        offset = 1 + (1 if self.oov_token else 0)
+        by_freq = sorted(self.word_counts, key=self.word_counts.get,
+                         reverse=True)
+        self.word_index = {w: i + offset for i, w in enumerate(by_freq)}
+        if self.oov_token:
+            self.word_index[self.oov_token] = 1
+
+    def texts_to_sequences(self, texts):
+        out = []
+        nw = self.num_words
+        for text in texts:
+            if self.lower:
+                text = text.lower()
+            seq = []
+            for w in text.split(self.split):
+                i = self.word_index.get(w)
+                if i is None:
+                    if self.oov_token:
+                        seq.append(1)
+                    continue
+                if nw and i >= nw:
+                    if self.oov_token:
+                        seq.append(1)
+                    continue
+                seq.append(i)
+            out.append(seq)
+        return out
+
+    def sequences_to_matrix(self, sequences, mode="binary"):
+        if not self.num_words and not self.word_index:
+            raise ValueError("specify num_words or fit_on_texts first")
+        num_words = self.num_words or (max(self.word_index.values()) + 1)
+        m = np.zeros((len(sequences), num_words), dtype=np.float32)
+        for r, seq in enumerate(sequences):
+            ids, counts = np.unique(
+                [i for i in seq if 0 <= i < num_words], return_counts=True)
+            ids = ids.astype(np.intp)
+            if mode == "binary":
+                m[r, ids] = 1.0
+            elif mode == "count":
+                m[r, ids] = counts
+            elif mode == "freq":
+                m[r, ids] = counts / max(len(seq), 1)
+            else:
+                raise ValueError(f"unsupported mode {mode!r}")
+        return m

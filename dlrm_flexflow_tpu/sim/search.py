@@ -60,6 +60,20 @@ def legal_configs(op, num_devices: int,
     return cands
 
 
+def data_parallel_strategy(model, num_devices: int) -> Strategy:
+    """The search's starting point (reference model.cc:1102): data-parallel
+    over every op, falling back to no partitioning when the batch dimension
+    does not divide."""
+    s = Strategy()
+    for op in model.layers:
+        s[op.name] = ParallelConfig.data_parallel(
+            op.outputs[0].ndim, num_devices)
+        if op.outputs[0].shape[0] % num_devices != 0:
+            s[op.name] = ParallelConfig(
+                dims=(1,) * op.outputs[0].ndim, device_ids=[0])
+    return s
+
+
 def mcmc_search(model, num_devices: int, budget: int = 1000,
                 alpha: float = 0.05,
                 simulator: Optional[Simulator] = None,
@@ -80,14 +94,7 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
     rng = random.Random(seed)
 
     # start from data-parallel (reference model.cc:1102)
-    current = Strategy()
-    for op in model.layers:
-        current[op.name] = ParallelConfig.data_parallel(
-            op.outputs[0].ndim, num_devices)
-        # fall back to no partitioning when batch doesn't divide
-        if op.outputs[0].shape[0] % num_devices != 0:
-            current[op.name] = ParallelConfig(
-                dims=(1,) * op.outputs[0].ndim, device_ids=[0])
+    current = data_parallel_strategy(model, num_devices)
 
     candidates = {op.name: legal_configs(op, num_devices)
                   for op in model.layers}

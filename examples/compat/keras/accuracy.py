@@ -1,0 +1,18 @@
+"""Accuracy targets consumed by the verify callbacks (mirrors the role of
+the reference's examples/python/keras/accuracy.py helper).
+
+With no network egress the datasets fall back to deterministic synthetic
+data, so targets default to 0 (wiring demo) unless FF_REAL_DATA is set."""
+
+import os
+from enum import Enum
+
+_REAL = bool(os.environ.get("FF_REAL_DATA"))
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90 if _REAL else 0
+    MNIST_CNN = 90 if _REAL else 0
+    REUTERS_MLP = 80 if _REAL else 0
+    CIFAR10_CNN = 78 if _REAL else 0
+    CIFAR10_ALEXNET = 78 if _REAL else 0

@@ -1,0 +1,50 @@
+"""Two Sequential towers merged via Concatenate on their symbolic outputs
+(parity with reference
+examples/python/keras/func_cifar10_cnn_concat_seq_model.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model, Sequential
+    from flexflow.keras.layers import (Activation, Concatenate, Conv2D,
+                                       Dense, Flatten)
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import cifar10
+    (x_train, y_train), _ = cifar10.load_data(SAMPLES)
+    x_train = x_train[:SAMPLES].astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    model1 = Sequential([Conv2D(filters=32, input_shape=(3, 32, 32),
+                                kernel_size=(3, 3), strides=(1, 1),
+                                padding=(1, 1), activation="relu",
+                                name="conv2d_0_0")])
+    model2 = Sequential([Conv2D(filters=32, input_shape=(3, 32, 32),
+                                kernel_size=(3, 3), strides=(1, 1),
+                                padding=(1, 1), activation="relu",
+                                name="conv2d_0_1")])
+    print(model1.summary())
+    print(model2.summary())
+
+    merged = Concatenate(axis=1)([model1.output, model2.output])
+    t = Flatten()(merged)
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+    model = Model([model1.input[0], model2.input[0]], out)
+    model.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=64)
+    model.fit([x_train, x_train], y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

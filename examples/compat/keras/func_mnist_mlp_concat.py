@@ -1,0 +1,40 @@
+"""Functional MNIST MLP with concatenated branches (parity with reference
+examples/python/keras/func_mnist_mlp_concat.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model
+    from flexflow.keras.layers import (Activation, Concatenate, Dense,
+                                       Input)
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import mnist
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 784).astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    inp = Input(shape=(784,), dtype="float32")
+    a = Dense(256, activation="relu")(inp)
+    b = Dense(256, activation="relu")(inp)
+    t = Concatenate(axis=1)([a, b])
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+    model = Model(inp, out)
+    model.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,46 @@
+"""Sequential MNIST CNN (parity with reference
+examples/python/keras/seq_mnist_cnn.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Sequential
+    from flexflow.keras.layers import (Activation, Conv2D, Dense, Flatten,
+                                       MaxPooling2D)
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import mnist
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 1, 28, 28)
+    x_train = x_train.astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    model = Sequential([
+        Conv2D(filters=32, input_shape=(1, 28, 28), kernel_size=(3, 3),
+               strides=(1, 1), padding=(1, 1), activation="relu"),
+        Conv2D(filters=64, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu"),
+        MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid"),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10),
+        Activation("softmax"),
+    ])
+    opt = optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,41 @@
+"""Sequential MNIST MLP (parity with reference
+examples/python/keras/seq_mnist_mlp.py from the python/test.sh matrix)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Sequential
+    from flexflow.keras.layers import Activation, Dense
+    from flexflow.keras import optimizers
+    from flexflow.keras.callbacks import EpochVerifyMetrics, VerifyMetrics
+    from accuracy import ModelAccuracy
+
+    from flexflow.keras.datasets import mnist
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 784).astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    model = Sequential([Dense(512, activation="relu", input_shape=(784,)),
+                        Dense(512, activation="relu"),
+                        Dense(10),
+                        Activation("softmax")])
+    opt = optimizers.SGD(learning_rate=0.01)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS,
+              callbacks=[VerifyMetrics(ModelAccuracy.MNIST_MLP),
+                         EpochVerifyMetrics(ModelAccuracy.MNIST_MLP)])
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,57 @@
+"""Net2net teacher->student weight transfer (parity with reference
+examples/python/keras/seq_mnist_mlp_net2net.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Sequential
+    from flexflow.keras.layers import Activation, Dense
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import mnist
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 784).astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    teacher = Sequential([Dense(256, activation="relu", input_shape=(784,),
+                                name="dense1"),
+                          Dense(256, activation="relu", name="dense2"),
+                          Dense(10, name="dense3"),
+                          Activation("softmax")])
+    teacher.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], batch_size=64)
+    teacher.fit(x_train, y_train, epochs=EPOCHS)
+
+    d1_kernel, d1_bias = teacher.get_layer(index=0).get_weights(
+        teacher.ffmodel)
+    d2_kernel, d2_bias = teacher.get_layer(index=1).get_weights(
+        teacher.ffmodel)
+    d3_kernel, d3_bias = teacher.get_layer(index=2).get_weights(
+        teacher.ffmodel)
+
+    dense1s = Dense(256, activation="relu", input_shape=(784,),
+                    name="dense1s")
+    dense2s = Dense(256, activation="relu", name="dense2s")
+    dense3s = Dense(10, name="dense3s")
+    student = Sequential([dense1s, dense2s, dense3s, Activation("softmax")])
+    student.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], batch_size=64)
+    dense1s.set_weights(student.ffmodel, d1_kernel, d1_bias)
+    dense2s.set_weights(student.ffmodel, d2_kernel, d2_bias)
+    dense3s.set_weights(student.ffmodel, d3_kernel, d3_bias)
+    student.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

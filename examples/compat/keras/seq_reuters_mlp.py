@@ -1,0 +1,44 @@
+"""Sequential Reuters topic-classification MLP (parity with reference
+examples/python/keras/seq_reuters_mlp.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Sequential
+    from flexflow.keras.layers import Activation, Dense
+    from flexflow.keras import optimizers
+    from flexflow.keras.preprocessing.text import Tokenizer
+
+    from flexflow.keras.datasets import reuters
+    max_words = 1000
+    (x_train, y_train), _ = reuters.load_data(num_words=max_words,
+                                              test_split=0.2)
+    num_classes = int(np.max(y_train)) + 1
+    tokenizer = Tokenizer(num_words=max_words)
+    x_train = tokenizer.sequences_to_matrix(x_train, mode="binary")
+    n = min(SAMPLES, len(x_train)) // 64 * 64
+    x_train = x_train[:n].astype("float32")
+    y_train = y_train[:n].astype("int32").reshape(-1, 1)
+
+    model = Sequential([Dense(512, activation="relu",
+                              input_shape=(max_words,)),
+                        Dense(num_classes),
+                        Activation("softmax")])
+    opt = optimizers.Adam(learning_rate=0.001)
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,39 @@
+"""Element-unary layer coverage (parity with reference
+examples/python/keras/unary.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model
+    from flexflow.keras.layers import Activation, Dense, Input
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import mnist
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 784).astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    inp = Input(shape=(784,), dtype="float32")
+    t = Dense(128)(inp)
+    for fn in ("relu", "sigmoid", "tanh", "elu", "exp"):
+        t = Activation(fn)(t)
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+    model = Model(inp, out)
+    model.compile(optimizer=optimizers.SGD(learning_rate=0.001),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,57 @@
+"""Native FFModel-API MNIST CNN (parity with reference
+examples/python/native/mnist_cnn.py)."""
+
+import os
+
+import numpy as np
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.core import (ActiMode, DataType, FFConfig, FFModel,
+                               LossType, MetricsType, PoolType,
+                               SGDOptimizer, SingleDataLoader)
+    from flexflow.keras.datasets import mnist
+
+    ffconfig = FFConfig()
+    ffconfig.parse_args(["-b", "64", "-e", str(EPOCHS)])
+    ffmodel = FFModel(ffconfig)
+
+    (x_train, y_train), _ = mnist.load_data()
+    n = min(SAMPLES, 1024) // 64 * 64
+    x_train = x_train[:n].reshape(n, 1, 28, 28).astype(np.float32) / 255
+    y_train = y_train[:n].astype(np.int32).reshape(n, 1)
+
+    input_tensor = ffmodel.create_tensor([64, 1, 28, 28], DataType.DT_FLOAT)
+    t = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
+                       ActiMode.AC_MODE_RELU)
+    t = ffmodel.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0, PoolType.POOL_MAX)
+    t = ffmodel.flat(t)
+    t = ffmodel.dense(t, 128, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 10)
+    t = ffmodel.softmax(t)
+
+    ffmodel.set_sgd_optimizer(SGDOptimizer(ffmodel, 0.01))
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    label_tensor = ffmodel.get_label_tensor()
+
+    full_input = ffmodel.create_tensor([n, 1, 28, 28], DataType.DT_FLOAT)
+    full_label = ffmodel.create_tensor([n, 1], DataType.DT_INT32)
+    full_input.attach_numpy_array(ffconfig, x_train)
+    full_label.attach_numpy_array(ffconfig, y_train)
+    dl_input = SingleDataLoader(ffmodel, input_tensor, full_input, 64,
+                                DataType.DT_FLOAT)
+    dl_label = SingleDataLoader(ffmodel, label_tensor, full_label, 64,
+                                DataType.DT_INT32)
+
+    ffmodel.init_layers()
+    ffmodel.train([dl_input, dl_label], epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,41 @@
+"""Layer/parameter introspection (parity with reference
+examples/python/native/print_layers.py): build a model, enumerate layers,
+read weights back through Parameter handles."""
+
+import os
+
+import numpy as np
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.core import (ActiMode, DataType, FFConfig, FFModel,
+                               LossType, MetricsType, SGDOptimizer)
+
+    ffconfig = FFConfig()
+    ffconfig.parse_args(["-b", "64"])
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor([64, 784], DataType.DT_FLOAT)
+    t = ffmodel.dense(input_tensor, 512, ActiMode.AC_MODE_RELU,
+                      name="dense1")
+    t = ffmodel.dense(t, 10, name="dense2")
+    t = ffmodel.softmax(t, name="softmax")
+    ffmodel.set_sgd_optimizer(SGDOptimizer(ffmodel, 0.01))
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    ffmodel.init_layers()
+
+    ffmodel.print_layers()
+    for op in ffmodel.get_layers().values():
+        print(op.name)
+    d1 = ffmodel.get_layer_by_name("dense1")
+    kernel = d1.get_parameter_by_id(0).get_weights(ffmodel)
+    bias = d1.get_parameter_by_id(1).get_weights(ffmodel)
+    print("dense1 kernel", kernel.shape, "bias", bias.shape)
+
+
+if __name__ == "__main__":
+    top_level_task()

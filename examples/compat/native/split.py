@@ -1,0 +1,54 @@
+"""Split + concat graph (parity with reference
+examples/python/native/split.py)."""
+
+import os
+
+import numpy as np
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.core import (ActiMode, DataType, FFConfig, FFModel,
+                               LossType, MetricsType, SGDOptimizer,
+                               SingleDataLoader)
+
+    ffconfig = FFConfig()
+    ffconfig.parse_args(["-b", "64", "-e", str(EPOCHS)])
+    ffmodel = FFModel(ffconfig)
+
+    rng = np.random.default_rng(0)
+    n = SAMPLES // 64 * 64
+    x_train = rng.standard_normal((n, 32)).astype(np.float32)
+    y_train = rng.integers(0, 4, size=(n, 1)).astype(np.int32)
+
+    input_tensor = ffmodel.create_tensor([64, 32], DataType.DT_FLOAT)
+    a, b = ffmodel.split(input_tensor, 2, axis=1)
+    a = ffmodel.dense(a, 16, ActiMode.AC_MODE_RELU)
+    b = ffmodel.dense(b, 16, ActiMode.AC_MODE_RELU)
+    t = ffmodel.concat([a, b], axis=1)
+    t = ffmodel.dense(t, 4)
+    t = ffmodel.softmax(t)
+
+    ffmodel.set_sgd_optimizer(SGDOptimizer(ffmodel, 0.01))
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    label_tensor = ffmodel.get_label_tensor()
+
+    full_input = ffmodel.create_tensor([n, 32], DataType.DT_FLOAT)
+    full_label = ffmodel.create_tensor([n, 1], DataType.DT_INT32)
+    full_input.attach_numpy_array(ffconfig, x_train)
+    full_label.attach_numpy_array(ffconfig, y_train)
+    dl_x = SingleDataLoader(ffmodel, input_tensor, full_input, 64,
+                            DataType.DT_FLOAT)
+    dl_y = SingleDataLoader(ffmodel, label_tensor, full_label, 64,
+                            DataType.DT_INT32)
+
+    ffmodel.init_layers()
+    ffmodel.train([dl_x, dl_y], epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,69 @@
+"""PyTorch-frontend MNIST MLP: torch.fx trace -> FFModel (parity with the
+reference pair examples/python/pytorch/mnist_mlp_torch.py +
+mnist_mlp.py)."""
+
+import os
+
+import numpy as np
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    import torch
+    from flexflow.torch.model import PyTorchModel
+    from flexflow.core import (DataType, FFConfig, FFModel, LossType,
+                               MetricsType, SGDOptimizer, SingleDataLoader)
+    from flexflow.keras.datasets import mnist
+
+    class MLP(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.linear1 = torch.nn.Linear(784, 512)
+            self.linear2 = torch.nn.Linear(512, 512)
+            self.linear3 = torch.nn.Linear(512, 10)
+            self.relu = torch.nn.ReLU()
+
+        def forward(self, x):
+            y = self.relu(self.linear1(x))
+            y = self.relu(self.linear2(y))
+            return self.linear3(y)
+
+    mlp = MLP()
+
+    ffconfig = FFConfig()
+    ffconfig.parse_args(["-b", "64", "-e", str(EPOCHS)])
+    ffmodel = FFModel(ffconfig)
+    input_tensor = ffmodel.create_tensor([64, 784], DataType.DT_FLOAT)
+
+    torch_model = PyTorchModel(mlp)
+    output = torch_model.apply(ffmodel, [input_tensor])[0]
+    output = ffmodel.softmax(output)
+
+    (x_train, y_train), _ = mnist.load_data()
+    n = SAMPLES // 64 * 64
+    x_train = x_train[:n].reshape(n, 784).astype(np.float32) / 255
+    y_train = y_train[:n].astype(np.int32).reshape(n, 1)
+
+    ffmodel.set_sgd_optimizer(SGDOptimizer(ffmodel, 0.01))
+    ffmodel.compile(
+        loss_type=LossType.LOSS_SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.METRICS_ACCURACY])
+    label_tensor = ffmodel.get_label_tensor()
+
+    full_input = ffmodel.create_tensor([n, 784], DataType.DT_FLOAT)
+    full_label = ffmodel.create_tensor([n, 1], DataType.DT_INT32)
+    full_input.attach_numpy_array(ffconfig, x_train)
+    full_label.attach_numpy_array(ffconfig, y_train)
+    dl_x = SingleDataLoader(ffmodel, input_tensor, full_input, 64,
+                            DataType.DT_FLOAT)
+    dl_y = SingleDataLoader(ffmodel, label_tensor, full_label, 64,
+                            DataType.DT_INT32)
+
+    ffmodel.init_layers()
+    ffmodel.train([dl_x, dl_y], epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Run the whole compat example matrix (the analogue of the reference's
+# python/test.sh, which runs every keras/native/onnx/pytorch example under
+# flexflow_python).  Each script is plain python here.
+# keras/accuracy.py is a helper module imported by the scripts, not a
+# runnable example.
+set -e
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+cd "$(dirname "$0")"
+
+for s in keras/seq_mnist_mlp.py keras/seq_mnist_cnn.py \
+         keras/seq_reuters_mlp.py keras/seq_cifar10_cnn.py \
+         keras/seq_mnist_mlp_net2net.py keras/seq_mnist_cnn_nested.py \
+         keras/callback.py keras/unary.py keras/reshape.py \
+         keras/func_mnist_mlp.py keras/func_mnist_mlp_concat.py \
+         keras/func_cifar10_alexnet.py \
+         keras/func_cifar10_cnn_concat_seq_model.py \
+         native/mnist_mlp.py native/mnist_cnn.py native/print_layers.py \
+         native/split.py onnx/mnist_mlp.py pytorch/mnist_mlp.py; do
+  echo "=== $s"
+  python "$s"
+done

@@ -1,0 +1,5 @@
+"""reference python/flexflow/keras/preprocessing/sequence.py."""
+
+from dlrm_flexflow_tpu.frontends.keras_utils import pad_sequences
+
+__all__ = ["pad_sequences"]

@@ -1,0 +1,5 @@
+"""reference python/flexflow/keras/preprocessing/text.py."""
+
+from dlrm_flexflow_tpu.frontends.keras_utils import Tokenizer
+
+__all__ = ["Tokenizer"]

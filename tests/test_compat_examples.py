@@ -1,0 +1,54 @@
+"""Smoke-run a representative slice of the compat example matrix
+(examples/compat/ — the analogue of the reference's python/test.sh, which
+runs every keras/native/onnx/pytorch example script).  Runs each script
+in-process via runpy with tiny sizes on the virtual CPU mesh."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "compat")
+
+
+def _run(rel):
+    path = os.path.join(EXAMPLES, rel)
+    d = os.path.dirname(path)
+    saved = {k: os.environ.get(k)
+             for k in ("FF_EXAMPLE_SAMPLES", "FF_EXAMPLE_EPOCHS")}
+    os.environ["FF_EXAMPLE_SAMPLES"] = "128"
+    os.environ["FF_EXAMPLE_EPOCHS"] = "1"
+    sys.path.insert(0, d)
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.path.remove(d)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("script", [
+    "keras/seq_mnist_mlp.py",
+    "keras/func_mnist_mlp_concat.py",
+    "keras/seq_mnist_mlp_net2net.py",
+    "keras/callback.py",
+    "keras/unary.py",
+    "keras/reshape.py",
+    "keras/seq_reuters_mlp.py",
+    "native/mnist_mlp.py",
+    "native/print_layers.py",
+    "native/split.py",
+    "pytorch/mnist_mlp.py",
+])
+def test_example_runs(script):
+    _run(script)
+
+
+def test_onnx_example_runs():
+    pytest.importorskip("onnx")
+    _run("onnx/mnist_mlp.py")

@@ -231,3 +231,78 @@ class TestLayerReuseAndRebinding:
                   metrics=(), batch_size=8)
         with pytest.raises(ValueError):
             da.get_weights(b.ffmodel)
+
+    def test_symbolic_composition_adopts_trained_weights(self):
+        """m1.output / m1.input composition (no model(x) call) must also
+        carry m1's trained weights into the composed model."""
+        x, _ = _data()
+        m1 = Sequential([Dense(8, activation="relu", input_shape=(8,),
+                               name="m1d")])
+        m1.compile(optimizer="sgd", loss="mean_squared_error",
+                   metrics=(), batch_size=16)
+        m1.fit(x, np.zeros((64, 8), np.float32), epochs=1, verbose=False)
+        k_trained, _ = m1.get_layer(index=0).get_weights(m1.ffmodel)
+
+        m2 = Sequential([Dense(8, activation="relu", input_shape=(8,))])
+        merged = Concatenate(axis=1)([m1.output, m2.output])
+        out = Dense(4)(merged)
+        composed = Model([m1.input[0], m2.input[0]], out)
+        composed.compile(optimizer="sgd", loss="mean_squared_error",
+                         metrics=(), batch_size=16)
+        k_in_composed, _ = m1.get_layer(index=0).get_weights(
+            composed.ffmodel)
+        np.testing.assert_array_equal(k_in_composed, k_trained)
+
+    def test_nested_sequential_multi_input_asserts(self):
+        m1 = Sequential([Dense(4, input_shape=(8,))])
+        a = Input(shape=(8,))()
+        b = Input(shape=(8,))()
+        mm = Model([a, b], m1(a, b))  # 2 inputs into a 1-input Sequential
+        with pytest.raises(AssertionError):
+            mm.compile(optimizer="sgd", loss="mean_squared_error",
+                       metrics=(), batch_size=8)
+
+    def test_discarded_models_are_not_pinned(self):
+        """Binding records hold models weakly: composing a teacher into
+        throwaway models must not keep those models alive."""
+        import gc
+        import weakref
+        teacher = Sequential([Dense(4, input_shape=(8,), name="wd")])
+        teacher.compile(optimizer="sgd", loss="mean_squared_error",
+                        metrics=(), batch_size=8)
+        head = Input(shape=(8,))()
+        composed = Model(head, teacher(head))
+        composed.compile(optimizer="sgd", loss="mean_squared_error",
+                         metrics=(), batch_size=8)
+        ref = weakref.ref(composed)
+        del composed, head
+        gc.collect()
+        assert ref() is None  # teacher's layer bindings did not pin it
+
+    def test_recompiled_source_wins_over_stale_composition(self):
+        """After m1 is retrained, a NEW composition must adopt m1's fresh
+        weights, not a stale snapshot held by an earlier composition."""
+        x, _ = _data()
+        m1 = Sequential([Dense(8, activation="relu", input_shape=(8,),
+                               name="rw")])
+        m1.compile(optimizer="sgd", loss="mean_squared_error",
+                   metrics=(), batch_size=16)
+        m1.fit(x, np.zeros((64, 8), np.float32), epochs=1, verbose=False)
+
+        h1 = Input(shape=(8,))()
+        c1 = Model(h1, m1(h1))
+        c1.compile(optimizer="sgd", loss="mean_squared_error",
+                   metrics=(), batch_size=16)
+
+        # recompile + retrain m1: its binding must move to most-recent
+        m1.compile(optimizer="sgd", loss="mean_squared_error",
+                   metrics=(), batch_size=16)
+        m1.fit(x, np.ones((64, 8), np.float32), epochs=2, verbose=False)
+        k_fresh, _ = m1.get_layer(index=0).get_weights(m1.ffmodel)
+
+        h2 = Input(shape=(8,))()
+        c2 = Model(h2, m1(h2))
+        c2.compile(optimizer="sgd", loss="mean_squared_error",
+                   metrics=(), batch_size=16)
+        k_c2, _ = m1.get_layer(index=0).get_weights(c2.ffmodel)
+        np.testing.assert_array_equal(k_c2, k_fresh)

@@ -164,3 +164,26 @@ class TestDLRMSearch:
         t_dp = sim.simulate(dp)
         best = mcmc_search(model, 8, budget=300, seed=2, simulator=sim)
         assert best.best_simulated_time <= t_dp
+
+
+class TestStandaloneCLI:
+    """python -m dlrm_flexflow_tpu.sim — the analogue of the reference's
+    standalone analytic simulator (scripts/simulator.cc)."""
+
+    def test_cli_search_and_export(self, tmp_path, capsys):
+        from dlrm_flexflow_tpu.sim.__main__ import main
+        out = tmp_path / "s.json"
+        rc = main(["--app", "dlrm", "--devices", "4", "--budget", "50",
+                   "--export", str(out)])
+        assert rc == 0
+        assert out.exists()
+        text = capsys.readouterr().out
+        assert "data-parallel baseline" in text
+        assert "searched strategy" in text
+
+    def test_cli_every_app_builds(self):
+        from dlrm_flexflow_tpu.sim.__main__ import build_app
+        for app in ["dlrm", "alexnet", "resnet", "inception",
+                    "candle_uno", "nmt"]:
+            m = build_app(app, 16)
+            assert m.layers, app
