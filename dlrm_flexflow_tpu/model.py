@@ -699,7 +699,8 @@ class FFModel:
         # warmup/compile batch
         first = dataloader.peek()
         state, _ = self.train_step(state, first[0], first[1])
-        jax.block_until_ready(state.params)
+        from .profiling import device_fence
+        device_fence(state.step)
         t0 = time.perf_counter()
         samples = 0
         for epoch in range(epochs):
@@ -726,7 +727,7 @@ class FFModel:
             if early_stop:
                 print(f"Accuracy reached, early stop, epoch: {epoch}")
                 break
-        jax.block_until_ready(state.params)
+        device_fence(state.step)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
         if verbose:

@@ -30,6 +30,38 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+def device_fence(x):
+    """Execution fence that actually waits.
+
+    On the tunneled TPU platform ``jax.block_until_ready`` can return
+    before the computation finishes (donated-buffer ready events), so all
+    timing paths fence by forcing a device->host read of one element
+    derived from the output — the transfer cannot complete until the
+    program that produced it has."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(x)
+    if not leaves:
+        return x
+    leaf = leaves[-1]
+    try:
+        # read one element from EVERY addressable shard so a sharded or
+        # replicated array waits for all participating devices, not just
+        # the shard that happens to back element 0
+        shards = getattr(leaf, "addressable_shards", None)
+        datas = [s.data for s in shards] if shards else [leaf]
+        for d in datas:
+            if getattr(d, "ndim", None) == 0:
+                np.asarray(d)
+            elif getattr(d, "size", 0):
+                np.asarray(d.ravel()[0])
+            else:  # zero-size shard: nothing to read, fall back
+                jax.block_until_ready(d)
+    except (AttributeError, TypeError):
+        jax.block_until_ready(leaves)
+    return x
+
+
 class Timer:
     """Fenced wall-clock timing (reference dlrm.cc:154-198 protocol)."""
 
@@ -46,7 +78,7 @@ class Timer:
 
     @staticmethod
     def fence(x):
-        jax.block_until_ready(x)
+        device_fence(x)
 
 
 class OpTimer:

@@ -183,13 +183,15 @@ class CostModel:
 
         jbwd = jax.jit(bwd_fn)
 
+        from ..profiling import device_fence
+
         def timeit(f, *args):
             out = f(*args)
-            jax.block_until_ready(out)
+            device_fence(out)  # block_until_ready can return early (tunnel)
             t0 = time.perf_counter()
             for _ in range(self.measure_iters):
                 out = f(*args)
-            jax.block_until_ready(out)
+            device_fence(out)
             return (time.perf_counter() - t0) / self.measure_iters
 
         fwd = timeit(jfwd, params, xs)
