@@ -15,6 +15,11 @@ tracing with ``-dm:memoize``), so host dispatch is off the critical path.
 Default precision is mixed: bf16 MXU matmuls with f32 accumulation and
 f32 master weights (BENCH_DTYPE=float32 for full fp32).
 
+The early-return was demonstrated directly on this platform: a window of
+3 chained epochs "fenced" by jax.block_until_ready(state.params) closed in
+0.7 ms while the subsequent scalar read of state.step — which the same
+program chain produces — stalled 120 s until the real work finished.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference repo publishes no numbers (BASELINE.md) — vs_baseline is
 computed against the FIRST *fenced* bench_history.json entry whose shape
@@ -68,12 +73,14 @@ def main():
     }
     labels = rng.integers(0, 2,
                           size=(num_batches, batch, 1)).astype(np.float32)
-    # Dataset lives on device for the whole run — the analogue of the
-    # reference's zero-copy attached full-dataset regions (dlrm.cc:266-382);
-    # without this every epoch re-uploads ~40MB host->device inside the
-    # timed window.
-    inputs = {k: jax.device_put(v) for k, v in inputs.items()}
-    labels = jax.device_put(labels)
+    # Dataset lives on device — placed ONCE with the sharding train_epoch
+    # expects (mesh-aware), the analogue of the reference's zero-copy
+    # attached full-dataset regions (dlrm.cc:266-382); without this every
+    # epoch re-uploads ~40MB host->device inside the timed window.
+    # BENCH_HOST_INPUTS=1 keeps the dataset host-side (the pre-fix
+    # behavior) for apples-to-apples re-measurement of old anchors.
+    if not os.environ.get("BENCH_HOST_INPUTS"):
+        inputs, labels = model.place_dataset(inputs, labels)
 
     from dlrm_flexflow_tpu.profiling import device_fence
 

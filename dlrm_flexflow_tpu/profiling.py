@@ -43,22 +43,24 @@ def device_fence(x):
     leaves = jax.tree_util.tree_leaves(x)
     if not leaves:
         return x
-    leaf = leaves[-1]
-    try:
-        # read one element from EVERY addressable shard so a sharded or
-        # replicated array waits for all participating devices, not just
-        # the shard that happens to back element 0
-        shards = getattr(leaf, "addressable_shards", None)
-        datas = [s.data for s in shards] if shards else [leaf]
-        for d in datas:
-            if getattr(d, "ndim", None) == 0:
-                np.asarray(d)
-            elif getattr(d, "size", 0):
-                np.asarray(d.ravel()[0])
-            else:  # zero-size shard: nothing to read, fall back
-                jax.block_until_ready(d)
-    except (AttributeError, TypeError):
-        jax.block_until_ready(leaves)
+    for leaf in leaves:
+        try:
+            # read one element from EVERY addressable shard so a sharded
+            # or replicated array waits for all participating devices, not
+            # just the shard that happens to back element 0 — and do it
+            # for every leaf, since leaves may come from separate
+            # dispatches
+            shards = getattr(leaf, "addressable_shards", None)
+            datas = [s.data for s in shards] if shards else [leaf]
+            for d in datas:
+                if getattr(d, "ndim", None) == 0:
+                    np.asarray(d)
+                elif getattr(d, "size", 0):
+                    np.asarray(d.ravel()[0])
+                else:  # zero-size shard: nothing to read, fall back
+                    jax.block_until_ready(d)
+        except (AttributeError, TypeError):
+            jax.block_until_ready(leaf)
     return x
 
 

@@ -1,0 +1,149 @@
+"""Sparse embedding update fast path: under plain SGD, the compiled
+train_step gathers rows outside the differentiated region and scatter-
+applies -lr*row_grad — numerics must match the dense autodiff path
+EXACTLY (same adds, different traffic)."""
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+
+
+def _dlrm(batch=16, rows=64, tables=4, bag=2, stacked=True, mesh=False,
+          table_parallel=False, optimizer=None):
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    cfg = DLRMConfig(sparse_feature_size=8,
+                     embedding_size=[rows] * tables,
+                     embedding_bag_size=bag,
+                     mlp_bot=[4, 16, 8],
+                     mlp_top=[8 * tables + 8, 16, 1])
+    fc = ff.FFConfig(batch_size=batch)
+    m = build_dlrm(cfg, fc, stacked_embeddings=stacked,
+                   table_parallel=table_parallel)
+    m.compile(optimizer=optimizer or ff.SGDOptimizer(lr=0.05),
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
+    return cfg, m
+
+
+def _batch(cfg, batch=16, tables=4, stacked=True, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal((batch, cfg.mlp_bot[0])).astype(np.float32)
+    if stacked:
+        inputs = {"dense": dense,
+                  "sparse": rng.integers(0, cfg.embedding_size[0],
+                                         size=(batch, tables,
+                                               cfg.embedding_bag_size),
+                                         dtype=np.int64)}
+    else:
+        inputs = {"dense": dense}
+        for i in range(tables):
+            inputs[f"sparse_{i}"] = rng.integers(
+                0, cfg.embedding_size[i],
+                size=(batch, cfg.embedding_bag_size), dtype=np.int64)
+    labels = rng.integers(0, 2, size=(batch, 1)).astype(np.float32)
+    return inputs, labels
+
+
+class TestSparseMatchesDense:
+    @pytest.mark.parametrize("stacked", [True, False])
+    def test_train_steps_identical(self, stacked):
+        cfg, m = _dlrm(stacked=stacked)
+        assert m._sparse_emb_ops  # fast path active
+        st_sparse = m.init(seed=0)
+
+        # dense reference: same graph, momentum!=0 disables the fast path
+        # is not fair (different math); instead force dense by rebuilding
+        # with the fast path disabled via monkeypatched eligibility
+        cfg2, m2 = _dlrm(stacked=stacked,
+                         optimizer=ff.SGDOptimizer(lr=0.05, momentum=0.9))
+        assert not m2._sparse_emb_ops
+        # momentum=0.9 changes the update; emulate dense plain SGD by
+        # zeroing momentum's contribution is wrong — instead compare
+        # against a manual dense step below.
+        del cfg2, m2
+
+        import jax
+        import jax.numpy as jnp
+        inputs, labels = _batch(cfg, stacked=stacked)
+
+        # manual dense reference step (autodiff through the table)
+        final_uid = m.final_tensor.uid
+
+        def loss_fn(params):
+            values, _ = m._apply(params, inputs, training=True, rng=None,
+                                 bn_state={})
+            return m._loss_fn(values[final_uid], labels)
+
+        g = jax.grad(loss_fn)(st_sparse.params)
+        ref_params = jax.tree_util.tree_map(
+            lambda w, gg: w - 0.05 * gg, st_sparse.params, g)
+
+        st1, _ = m.train_step(st_sparse, inputs, labels)
+
+        for opn in st1.params:
+            for k in st1.params[opn]:
+                np.testing.assert_allclose(
+                    np.asarray(st1.params[opn][k]),
+                    np.asarray(ref_params[opn][k]),
+                    rtol=1e-6, atol=1e-6,
+                    err_msg=f"{opn}/{k} ({'stacked' if stacked else 'per-table'})")
+
+    def test_repeated_ids_accumulate(self):
+        """Duplicate ids in one batch must accumulate their grads (the
+        reference's atomicAdd semantics)."""
+        cfg, m = _dlrm(stacked=True)
+        st = m.init(seed=0)
+        inputs, labels = _batch(cfg)
+        # force every lookup to the same id
+        inputs["sparse"] = np.zeros_like(inputs["sparse"])
+        import jax
+
+        def loss_fn(params):
+            values, _ = m._apply(params, inputs, training=True, rng=None,
+                                 bn_state={})
+            return m._loss_fn(values[m.final_tensor.uid], labels)
+
+        g = jax.grad(loss_fn)(st.params)
+        ref_emb = np.asarray(st.params["emb"]["embedding"]) \
+            - 0.05 * np.asarray(g["emb"]["embedding"])
+        st1, _ = m.train_step(st, inputs, labels)
+        np.testing.assert_allclose(np.asarray(st1.params["emb"]["embedding"]),
+                                   ref_emb, rtol=1e-6, atol=1e-6)
+
+    def test_momentum_and_wd_fall_back_to_dense(self):
+        _, m_mom = _dlrm(optimizer=ff.SGDOptimizer(lr=0.05, momentum=0.9))
+        assert not m_mom._sparse_emb_ops
+        _, m_wd = _dlrm(optimizer=ff.SGDOptimizer(lr=0.05, weight_decay=0.1))
+        assert not m_wd._sparse_emb_ops
+        _, m_adam = _dlrm(optimizer=ff.AdamOptimizer(lr=0.001))
+        assert not m_adam._sparse_emb_ops
+
+    def test_table_parallel_mesh_matches_single_device(self):
+        """Fast path under the hybrid strategy on an 8-device mesh equals
+        single-device numerics."""
+        import jax
+        cfg, m1 = _dlrm(mesh=False)
+        st1 = m1.init(seed=0)
+        inputs, labels = _batch(cfg)
+        st1, _ = m1.train_step(st1, inputs, labels)
+
+        mesh = ff.make_mesh({"data": 2, "model": 4})
+        cfg2, m2 = _dlrm(mesh=mesh, table_parallel=True)
+        assert m2._sparse_emb_ops
+        st2 = m2.init(seed=0)
+        st2, _ = m2.train_step(st2, inputs, labels)
+        np.testing.assert_allclose(
+            np.asarray(st1.params["emb"]["embedding"]),
+            np.asarray(st2.params["emb"]["embedding"]),
+            rtol=1e-5, atol=1e-5)
+
+    def test_lr_schedule_still_applies(self):
+        """The scatter step reads lr from opt_state so schedules work."""
+        cfg, m = _dlrm()
+        st = m.init(seed=0)
+        inputs, labels = _batch(cfg)
+        st_lr = m.set_learning_rate(st, 0.0)  # freeze
+        before = np.asarray(st_lr.params["emb"]["embedding"])
+        st1, _ = m.train_step(st_lr, inputs, labels)
+        np.testing.assert_array_equal(
+            before, np.asarray(st1.params["emb"]["embedding"]))
