@@ -39,6 +39,59 @@ import time
 import numpy as np
 
 
+def _vs_and_record(thpt, key):
+    """Anchor ``thpt`` against the FIRST fenced history entry matching
+    ``key`` exactly, append this run, and return the ratio (1.0 when no
+    anchor exists)."""
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_history.json")
+    vs = 1.0
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        if not isinstance(hist, list):
+            hist = []
+        for h in hist:
+            if (h.get("fenced") and h.get("value")
+                    and all(h.get(k) == v for k, v in key.items())):
+                vs = thpt / float(h["value"])
+                break
+    except (OSError, ValueError, TypeError, AttributeError):
+        hist = []
+    hist.append({**key, "ts": time.time(), "value": thpt, "fenced": True})
+    try:
+        with open(hist_path, "w") as f:
+            json.dump(hist, f, indent=1)
+    except OSError:
+        pass
+    return vs
+
+
+def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
+             place=True):
+    """Fenced best-of-reps timing over scanned epochs (the one shared
+    timing protocol: warmup/compile epoch, then ``reps`` windows of
+    ``epochs`` chained epochs each closed by a real device fence)."""
+    from dlrm_flexflow_tpu.profiling import device_fence
+
+    if place:
+        # dataset placed once with the sharding train_epoch expects (the
+        # analogue of the reference's zero-copy attached dataset regions,
+        # dlrm.cc:266-382); place=False keeps host inputs for
+        # apples-to-apples re-measurement of old anchors
+        inputs, labels = model.place_dataset(inputs, labels)
+    state, _ = model.train_epoch(state, inputs, labels)
+    device_fence(state.step)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            state, _ = model.train_epoch(state, inputs, labels)
+        device_fence(state.step)
+        times.append(time.perf_counter() - t0)
+    return epochs * num_batches * batch / float(min(times))
+
+
 def main():
     import jax
     import dlrm_flexflow_tpu as ff
@@ -73,75 +126,18 @@ def main():
     }
     labels = rng.integers(0, 2,
                           size=(num_batches, batch, 1)).astype(np.float32)
-    # Dataset lives on device — placed ONCE with the sharding train_epoch
-    # expects (mesh-aware), the analogue of the reference's zero-copy
-    # attached full-dataset regions (dlrm.cc:266-382); without this every
-    # epoch re-uploads ~40MB host->device inside the timed window.
-    # BENCH_HOST_INPUTS=1 keeps the dataset host-side (the pre-fix
-    # behavior) for apples-to-apples re-measurement of old anchors.
-    if not os.environ.get("BENCH_HOST_INPUTS"):
-        inputs, labels = model.place_dataset(inputs, labels)
-
-    from dlrm_flexflow_tpu.profiling import device_fence
-
-    def fence(st):
-        # jax.block_until_ready can return early on the tunneled TPU
-        # platform; fence on a device->host read of the step counter,
-        # which the whole chained program feeds.
-        device_fence(st.step)
-
-    # warmup epoch = compile (reference runs epoch 0 untimed, dlrm.cc:178)
-    state, _ = model.train_epoch(state, inputs, labels)
-    fence(state)
-
-    # One rep = `epochs` back-to-back epochs dispatched asynchronously with
-    # a single device fence at the end (the analogue of dlrm.cc:154-198's
-    # fenced wall-clock over the whole run; async dispatch keeps the chip
-    # busy).  The remote-chip path sees external contention, so report the
-    # best sustained window out of BENCH_REPS reps rather than trusting one.
     reps = int(os.environ.get("BENCH_REPS", 5))
-    samples_per_rep = epochs * num_batches * batch
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(epochs):
-            state, mets = model.train_epoch(state, inputs, labels)
-        fence(state)
-        times.append(time.perf_counter() - t0)
-    thpt = samples_per_rep / float(min(times))
-
-    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_history.json")
-    # vs_baseline is anchored to the FIRST recorded entry with a matching
-    # shape config (the round-1 anchor of this framework — the reference
-    # repo publishes no numbers, BASELINE.md), so improvements accumulate
-    # instead of drifting with the previous run's noise.
-    vs = 1.0
-    try:
-        with open(hist_path) as f:
-            hist = json.load(f)
-        if not isinstance(hist, list):
-            hist = []
-        for h in hist:
-            if (h.get("fenced")
-                    and h.get("batch") == batch
-                    and h.get("num_batches") == num_batches
-                    and h.get("epochs") == epochs
-                    and h.get("rows") == rows
-                    and h.get("value")):
-                vs = thpt / float(h["value"])
-                break
-    except (OSError, ValueError, TypeError, AttributeError):
-        hist = []
-    hist.append({"ts": time.time(), "value": thpt,
-                 "batch": batch, "num_batches": num_batches,
-                 "epochs": epochs, "rows": rows, "dtype": dtype,
-                 "fenced": True})
-    try:
-        with open(hist_path, "w") as f:
-            json.dump(hist, f, indent=1)
-    except OSError:
-        pass
+    thpt = _windows(model, state, inputs, labels, batch, num_batches,
+                    epochs, reps,
+                    place=not os.environ.get("BENCH_HOST_INPUTS"))
+    # vs_baseline: FIRST fenced history entry of the same config is the
+    # anchor, so improvements accumulate instead of drifting with the
+    # previous run's noise (the reference publishes no numbers,
+    # BASELINE.md).  "dtype" is deliberately not part of the key: the
+    # mixed-precision default is credited as a framework optimization.
+    vs = _vs_and_record(thpt, {"app": "dlrm", "batch": batch,
+                               "num_batches": num_batches,
+                               "epochs": epochs, "rows": rows})
 
     print(json.dumps({
         "metric": "dlrm_synthetic_samples_per_sec",
@@ -151,5 +147,129 @@ def main():
     }))
 
 
+# --------------------------------------------------------------------------
+# Additional headline configs (BASELINE.json "configs"): BENCH_APP selects
+# one; the default "dlrm" is the synthetic run_random.sh workload above.
+# Each prints the same one-line JSON protocol.
+
+KAGGLE_TABLES = [1396, 550, 1761917, 507795, 290, 21, 11948, 608, 3, 58176,
+                 5237, 1497287, 3127, 26, 12153, 1068715, 10, 4836, 2085, 4,
+                 1312273, 17, 15, 110946, 91, 72655]  # run_criteo_kaggle.sh
+
+
+def bench_app(app: str):
+    import jax
+    import dlrm_flexflow_tpu as ff
+
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    nb = int(os.environ.get("BENCH_BATCHES", 16))
+    epochs = int(os.environ.get("BENCH_EPOCHS", 2))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    rng = np.random.default_rng(0)
+    fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
+    mesh = False if jax.device_count() == 1 else None
+
+    if app == "alexnet":
+        # "AlexNet single-device, synthetic data, default data-parallel"
+        from dlrm_flexflow_tpu.apps.alexnet import build_alexnet
+        model = build_alexnet(fc)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                      loss_type="sparse_categorical_crossentropy",
+                      metrics=("accuracy",), mesh=mesh)
+        inputs = {"input": rng.standard_normal(
+            (nb, batch, 3, 229, 229)).astype(np.float32)}
+        labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
+    elif app == "inception":
+        # "InceptionV3 with SOAP auto-searched op/attr-parallel strategy"
+        from dlrm_flexflow_tpu.apps.inception import build_inception
+        from dlrm_flexflow_tpu.sim.search import mcmc_search
+        model = build_inception(fc)
+        strategy = mcmc_search(model, max(jax.device_count(), 2),
+                               budget=int(os.environ.get("BENCH_BUDGET",
+                                                         100)))
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                      loss_type="sparse_categorical_crossentropy",
+                      metrics=("accuracy",), mesh=mesh, strategy=strategy)
+        inputs = {"input": rng.standard_normal(
+            (nb, batch, 3, 299, 299)).astype(np.float32)}
+        labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
+    elif app == "nmt":
+        # "NMT LSTM seq2seq (nmt/), attribute-parallel RNN layers"
+        from dlrm_flexflow_tpu.apps.nmt import NMTConfig, build_nmt
+        cfg = NMTConfig(vocab_size=4096, embed_size=512, hidden_size=512)
+        model = build_nmt(cfg, fc, seq_shards=2)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                      loss_type="sparse_categorical_crossentropy",
+                      metrics=("sparse_categorical_crossentropy",),
+                      mesh=mesh)
+        inputs = {
+            "src": rng.integers(0, cfg.vocab_size,
+                                size=(nb, batch, cfg.src_len),
+                                dtype=np.int32),
+            "tgt_in": rng.integers(0, cfg.vocab_size,
+                                   size=(nb, batch, cfg.tgt_len),
+                                   dtype=np.int32),
+        }
+        labels = rng.integers(0, cfg.vocab_size,
+                              size=(nb, batch, cfg.tgt_len, 1)).astype(
+                                  np.int32)
+    elif app in ("dlrm_kaggle", "dlrm_hybrid"):
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        if app == "dlrm_kaggle":
+            # "DLRM small (Criteo-Kaggle), data-parallel embeddings + MLP"
+            # run_criteo_kaggle.sh says mlp_top 224-512-256-1, but with
+            # its own cat interaction the width is 16 + 26*16 = 432 (the
+            # reference snapshot is mid-merge and inconsistent; SURVEY.md
+            # "Repo state warning") — use the consistent width
+            cfg = DLRMConfig(sparse_feature_size=16,
+                             embedding_size=list(KAGGLE_TABLES),
+                             embedding_bag_size=1,
+                             mlp_bot=[13, 512, 256, 64, 16],
+                             mlp_top=[432, 512, 256, 1])
+            model = build_dlrm(cfg, fc)
+        else:
+            # "DLRM Criteo-Terabyte, SOAP hybrid (table-parallel
+            # embeddings, DP MLP)" — TB-scale tables, hybrid strategy
+            cfg = DLRMConfig()
+            cfg.embedding_size = [int(os.environ.get("BENCH_ROWS",
+                                                     1_000_000))] * 8
+            model = build_dlrm(cfg, fc, table_parallel=True)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy", "mean_squared_error"), mesh=mesh)
+        dense = rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32)
+        if model._dlrm_stacked:
+            inputs = {"dense": dense,
+                      "sparse": rng.integers(
+                          0, cfg.embedding_size[0],
+                          size=(nb, batch, len(cfg.embedding_size),
+                                cfg.embedding_bag_size), dtype=np.int64)}
+        else:
+            inputs = {"dense": dense}
+            for i, rows_i in enumerate(cfg.embedding_size):
+                inputs[f"sparse_{i}"] = rng.integers(
+                    0, rows_i, size=(nb, batch, cfg.embedding_bag_size),
+                    dtype=np.int64)
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+    else:
+        raise SystemExit(f"unknown BENCH_APP {app!r}")
+
+    state = model.init(seed=0)
+    thpt = _windows(model, state, inputs, labels, batch, nb, epochs, reps)
+    key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
+    if app in ("dlrm_kaggle", "dlrm_hybrid"):
+        key["rows"] = max(cfg.embedding_size)
+    vs = _vs_and_record(thpt, key)
+    print(json.dumps({
+        "metric": f"{app}_samples_per_sec",
+        "value": round(thpt, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    app = os.environ.get("BENCH_APP", "dlrm")
+    sys.exit(main() if app == "dlrm" else bench_app(app))

@@ -184,6 +184,12 @@ def run(argv: Sequence[str] = ()):  # pragma: no cover - CLI
                                      cfg.embedding_bag_size,
                                      ffconfig.batch_size, stacked=stacked)
     state, thpt = model.fit(state, loader, epochs=ffconfig.epochs)
+    if ffconfig.profiling:
+        # reference --profiling wraps every kernel in timing events and
+        # prints per-op times (model.cc:1376-1379, linear.cu:499-531)
+        from ..profiling import OpTimer
+        timer = OpTimer(model)
+        print(timer.report(timer.profile(state, None)))
     return thpt
 
 

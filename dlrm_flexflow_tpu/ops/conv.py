@@ -75,18 +75,25 @@ class Conv2D(Op):
     def forward(self, params, xs, *, training=False, rng=None):
         (x,) = xs
         k = params["kernel"]
-        if self.compute_dtype in ("bfloat16", jnp.bfloat16):
+        mixed = self.compute_dtype in ("bfloat16", jnp.bfloat16)
+        if mixed:
             x = x.astype(jnp.bfloat16)
             k = k.astype(jnp.bfloat16)
         ph, pw = self.padding
+        # no preferred_element_type upcast here: the conv transpose rule
+        # rejects an f32 cotangent against bf16 residuals; emitting bf16
+        # (the MXU still accumulates f32 internally) and upcasting via
+        # astype lets autodiff insert matching conversions on the grads
         y = jax.lax.conv_general_dilated(
             x, k,
             window_strides=self.stride,
             padding=((ph, ph), (pw, pw)),
             dimension_numbers=("NCHW", "HWIO", "NCHW"),
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=None if mixed else jnp.float32,
         )
+        if mixed:
+            y = y.astype(jnp.float32)
         if self.use_bias:
             y = y + params["bias"][None, :, None, None]
         y = activation_fn(self.activation)(y)

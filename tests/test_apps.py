@@ -166,3 +166,15 @@ class TestNMT:
         labels = rng.integers(0, 64, size=(8, 8, 1), dtype=np.int32)
         state, mets = m.train_step(state, {"src": src, "tgt_in": tgt}, labels)
         assert np.isfinite(float(mets["loss"]))
+
+
+def test_dlrm_profiling_flag(capsys):
+    """--profiling prints a per-op timing table after training
+    (reference model.cc:1376-1379 wrapping kernels with timing events)."""
+    from dlrm_flexflow_tpu.apps.dlrm import run
+    run(["-b", "16", "-e", "1", "--data-size", "32", "--profiling",
+         "--arch-embedding-size", "100-100",
+         "--arch-sparse-feature-size", "4",
+         "--arch-mlp-bot", "4-8-4", "--arch-mlp-top", "12-8-1"])
+    out = capsys.readouterr().out
+    assert "forward(us)" in out and "bot_0" in out
