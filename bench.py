@@ -39,10 +39,12 @@ import time
 import numpy as np
 
 
-def _vs_and_record(thpt, key):
-    """Anchor ``thpt`` against the FIRST fenced history entry matching
-    ``key`` exactly, append this run, and return the ratio (1.0 when no
-    anchor exists)."""
+def _emit(metric, thpt, key, extra=None):
+    """Shared tail of every benchmark: anchor ``thpt`` against the FIRST
+    fenced history entry matching ``key`` (entries predating the "app"
+    field count as app=="dlrm"), append this run (plus ``extra``
+    provenance fields like dtype, excluded from matching), and print the
+    one-line JSON protocol."""
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_history.json")
     vs = 1.0
@@ -51,20 +53,35 @@ def _vs_and_record(thpt, key):
             hist = json.load(f)
         if not isinstance(hist, list):
             hist = []
+
+        def matches(h):
+            for k, v in key.items():
+                hv = h.get(k)
+                if k == "app" and hv is None:
+                    hv = "dlrm"  # records written before the app field
+                if hv != v:
+                    return False
+            return True
+
         for h in hist:
-            if (h.get("fenced") and h.get("value")
-                    and all(h.get(k) == v for k, v in key.items())):
+            if h.get("fenced") and h.get("value") and matches(h):
                 vs = thpt / float(h["value"])
                 break
     except (OSError, ValueError, TypeError, AttributeError):
         hist = []
-    hist.append({**key, "ts": time.time(), "value": thpt, "fenced": True})
+    hist.append({**key, **(extra or {}), "ts": time.time(), "value": thpt,
+                 "fenced": True})
     try:
         with open(hist_path, "w") as f:
             json.dump(hist, f, indent=1)
     except OSError:
         pass
-    return vs
+    print(json.dumps({
+        "metric": metric,
+        "value": round(thpt, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 4),
+    }))
 
 
 def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
@@ -135,16 +152,10 @@ def main():
     # previous run's noise (the reference publishes no numbers,
     # BASELINE.md).  "dtype" is deliberately not part of the key: the
     # mixed-precision default is credited as a framework optimization.
-    vs = _vs_and_record(thpt, {"app": "dlrm", "batch": batch,
-                               "num_batches": num_batches,
-                               "epochs": epochs, "rows": rows})
-
-    print(json.dumps({
-        "metric": "dlrm_synthetic_samples_per_sec",
-        "value": round(thpt, 2),
-        "unit": "samples/s",
-        "vs_baseline": round(vs, 4),
-    }))
+    _emit("dlrm_synthetic_samples_per_sec", thpt,
+          {"app": "dlrm", "batch": batch, "num_batches": num_batches,
+           "epochs": epochs, "rows": rows},
+          extra={"dtype": dtype})
 
 
 # --------------------------------------------------------------------------
@@ -183,11 +194,16 @@ def bench_app(app: str):
     elif app == "inception":
         # "InceptionV3 with SOAP auto-searched op/attr-parallel strategy"
         from dlrm_flexflow_tpu.apps.inception import build_inception
-        from dlrm_flexflow_tpu.sim.search import mcmc_search
         model = build_inception(fc)
-        strategy = mcmc_search(model, max(jax.device_count(), 2),
-                               budget=int(os.environ.get("BENCH_BUDGET",
-                                                         100)))
+        strategy = None
+        if jax.device_count() > 1:
+            # a searched strategy only changes execution when there is a
+            # mesh to shard over; on one chip skip the search rather than
+            # discard its result
+            from dlrm_flexflow_tpu.sim.search import mcmc_search
+            strategy = mcmc_search(model, jax.device_count(),
+                                   budget=int(os.environ.get("BENCH_BUDGET",
+                                                             100)))
         model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                       loss_type="sparse_categorical_crossentropy",
                       metrics=("accuracy",), mesh=mesh, strategy=strategy)
@@ -261,13 +277,7 @@ def bench_app(app: str):
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     if app in ("dlrm_kaggle", "dlrm_hybrid"):
         key["rows"] = max(cfg.embedding_size)
-    vs = _vs_and_record(thpt, key)
-    print(json.dumps({
-        "metric": f"{app}_samples_per_sec",
-        "value": round(thpt, 2),
-        "unit": "samples/s",
-        "vs_baseline": round(vs, 4),
-    }))
+    _emit(f"{app}_samples_per_sec", thpt, key, extra={"dtype": dtype})
 
 
 if __name__ == "__main__":

@@ -341,3 +341,51 @@ class TestMoE:
             state, mets = m.train_step(state, {"x": x}, y)
             results[tp] = float(mets["loss"])
         np.testing.assert_allclose(results[False], results[True], rtol=1e-5)
+
+
+def _dp_matrix_run(mesh):
+    """3 training steps of a small DLRM under the given mesh; returns the
+    tensors the TestDeviceCountMatrix cases compare."""
+    import numpy as np
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+    cfg = DLRMConfig(sparse_feature_size=8,
+                     embedding_size=[64] * 4,
+                     embedding_bag_size=2,
+                     mlp_bot=[4, 16, 8],
+                     mlp_top=[8 * 4 + 8, 16, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=16))
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
+    st = m.init(seed=0)
+    rng = np.random.default_rng(0)
+    ins = {"dense": rng.standard_normal((16, 4)).astype(np.float32),
+           "sparse": rng.integers(0, 64, size=(16, 4, 2), dtype=np.int64)}
+    lab = rng.integers(0, 2, size=(16, 1)).astype(np.float32)
+    for _ in range(3):
+        st, mets = m.train_step(st, ins, lab)
+    return (np.asarray(st.params["emb"]["embedding"]),
+            np.asarray(st.params["top_1"]["kernel"]),
+            float(mets["loss"]))
+
+
+@pytest.fixture(scope="module")
+def dp_matrix_reference():
+    return _dp_matrix_run(False)
+
+
+class TestDeviceCountMatrix:
+    """The reference op harness runs every case at -ll:gpu {1,2,4,8}
+    (src/ops/tests/test_harness.py:246-287); mirror that matrix: the same
+    training run must be bit-compatible at every data-parallel width."""
+
+    @pytest.mark.parametrize("ndev", [2, 4, 8])
+    def test_dlrm_training_identical_at_every_dp_width(
+            self, ndev, dp_matrix_reference):
+        import numpy as np
+        ref_emb, ref_k, ref_loss = dp_matrix_reference
+        emb, k, loss = _dp_matrix_run(make_mesh({"data": ndev}))
+        np.testing.assert_allclose(emb, ref_emb, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(k, ref_k, rtol=1e-5, atol=1e-6)
+        assert loss == pytest.approx(ref_loss, rel=1e-5)
