@@ -1,0 +1,40 @@
+"""Functional CIFAR-10 CNN (parity with reference
+examples/python/keras/func_cifar10_cnn.py)."""
+
+import os
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model
+    from flexflow.keras.layers import (Activation, Conv2D, Dense, Flatten,
+                                       Input, MaxPooling2D)
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import cifar10
+    (x_train, y_train), _ = cifar10.load_data(SAMPLES)
+    x_train = x_train[:SAMPLES].astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    inp = Input(shape=(3, 32, 32), dtype="float32")
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(inp)
+    t = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+               padding=(1, 1), activation="relu")(t)
+    t = MaxPooling2D(pool_size=(2, 2), strides=(2, 2), padding="valid")(t)
+    t = Flatten()(t)
+    t = Dense(512, activation="relu")(t)
+    t = Dense(10)(t)
+    out = Activation("softmax")(t)
+    model = Model(inp, out)
+    model.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy", "sparse_categorical_crossentropy"],
+                  batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

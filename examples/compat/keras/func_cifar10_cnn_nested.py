@@ -1,0 +1,44 @@
+"""Model-of-models composition (parity with reference
+examples/python/keras/func_cifar10_cnn_nested.py: model2(model1(x)))."""
+
+import os
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model
+    from flexflow.keras.layers import (Activation, Conv2D, Dense, Flatten,
+                                       Input, MaxPooling2D)
+    from flexflow.keras import optimizers
+
+    from flexflow.keras.datasets import cifar10
+    (x_train, y_train), _ = cifar10.load_data(SAMPLES)
+    x_train = x_train[:SAMPLES].astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    in1 = Input(shape=(3, 32, 32), dtype="float32")
+    out1 = Conv2D(filters=32, kernel_size=(3, 3), strides=(1, 1),
+                  padding=(1, 1), activation="relu")(in1)
+    out1 = MaxPooling2D(pool_size=(2, 2), strides=(2, 2),
+                        padding="valid")(out1)
+    model1 = Model(in1, out1)
+
+    in2 = Input(shape=(32, 16, 16), dtype="float32")
+    out2 = Flatten()(in2)
+    out2 = Dense(256, activation="relu")(out2)
+    out2 = Dense(10)(out2)
+    out2 = Activation("softmax")(out2)
+    model2 = Model(in2, out2)
+
+    in3 = Input(shape=(3, 32, 32), dtype="float32")
+    model = Model(in3, model2(model1(in3)))
+    model.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=64)
+    model.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,45 @@
+"""Functional-API net2net weight transfer (parity with reference
+examples/python/keras/func_mnist_mlp_net2net.py)."""
+
+import os
+
+EPOCHS = int(os.environ.get("FF_EXAMPLE_EPOCHS", 1))
+SAMPLES = int(os.environ.get("FF_EXAMPLE_SAMPLES", 2048))
+
+
+def top_level_task():
+    from flexflow.keras.models import Model
+    from flexflow.keras.layers import Activation, Dense, Input
+    from flexflow.keras import optimizers
+    from flexflow.keras.datasets import mnist
+
+    (x_train, y_train), _ = mnist.load_data()
+    x_train = x_train[:SAMPLES].reshape(SAMPLES, 784).astype("float32") / 255
+    y_train = y_train[:SAMPLES].astype("int32").reshape(-1, 1)
+
+    inp = Input(shape=(784,), dtype="float32")
+    d1 = Dense(256, activation="relu", name="t_d1")
+    d2 = Dense(10, name="t_d2")
+    teacher = Model(inp, Activation("softmax")(d2(d1(inp))))
+    teacher.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], batch_size=64)
+    teacher.fit(x_train, y_train, epochs=EPOCHS)
+
+    k1, b1 = d1.get_weights(teacher.ffmodel)
+    k2, b2 = d2.get_weights(teacher.ffmodel)
+
+    inp_s = Input(shape=(784,), dtype="float32")
+    s1 = Dense(256, activation="relu", name="s_d1")
+    s2 = Dense(10, name="s_d2")
+    student = Model(inp_s, Activation("softmax")(s2(s1(inp_s))))
+    student.compile(optimizer=optimizers.SGD(learning_rate=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"], batch_size=64)
+    s1.set_weights(student.ffmodel, k1, b1)
+    s2.set_weights(student.ffmodel, k2, b2)
+    student.fit(x_train, y_train, epochs=EPOCHS)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,5 +1,5 @@
-"""Native FFModel-API MNIST CNN (parity with reference
-examples/python/native/mnist_cnn.py)."""
+"""Native FFModel-API CIFAR-10 CNN (parity with reference
+examples/python/native/cifar10_cnn.py)."""
 
 import os
 
@@ -13,24 +13,24 @@ def top_level_task():
     from flexflow.core import (ActiMode, DataType, FFConfig, FFModel,
                                LossType, MetricsType, PoolType,
                                SGDOptimizer, SingleDataLoader)
-    from flexflow.keras.datasets import mnist
+    from flexflow.keras.datasets import cifar10
 
     ffconfig = FFConfig()
     ffconfig.parse_args(["-b", "64", "-e", str(EPOCHS)])
     ffmodel = FFModel(ffconfig)
 
-    (x_train, y_train), _ = mnist.load_data()
     n = min(SAMPLES, 1024) // 64 * 64
-    x_train = x_train[:n].reshape(n, 1, 28, 28).astype(np.float32) / 255
+    (x_train, y_train), _ = cifar10.load_data(n)
+    x_train = x_train[:n].astype(np.float32) / 255
     y_train = y_train[:n].astype(np.int32).reshape(n, 1)
 
-    input_tensor = ffmodel.create_tensor([64, 1, 28, 28], DataType.DT_FLOAT)
+    input_tensor = ffmodel.create_tensor([64, 3, 32, 32], DataType.DT_FLOAT)
     t = ffmodel.conv2d(input_tensor, 32, 3, 3, 1, 1, 1, 1,
                        ActiMode.AC_MODE_RELU)
-    t = ffmodel.conv2d(t, 64, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
+    t = ffmodel.conv2d(t, 32, 3, 3, 1, 1, 1, 1, ActiMode.AC_MODE_RELU)
     t = ffmodel.pool2d(t, 2, 2, 2, 2, 0, 0, PoolType.POOL_MAX)
     t = ffmodel.flat(t)
-    t = ffmodel.dense(t, 128, ActiMode.AC_MODE_RELU)
+    t = ffmodel.dense(t, 512, ActiMode.AC_MODE_RELU)
     t = ffmodel.dense(t, 10)
     t = ffmodel.softmax(t)
 
@@ -40,17 +40,17 @@ def top_level_task():
         metrics=[MetricsType.METRICS_ACCURACY])
     label_tensor = ffmodel.get_label_tensor()
 
-    full_input = ffmodel.create_tensor([n, 1, 28, 28], DataType.DT_FLOAT)
+    full_input = ffmodel.create_tensor([n, 3, 32, 32], DataType.DT_FLOAT)
     full_label = ffmodel.create_tensor([n, 1], DataType.DT_INT32)
     full_input.attach_numpy_array(ffconfig, x_train)
     full_label.attach_numpy_array(ffconfig, y_train)
-    dl_input = SingleDataLoader(ffmodel, input_tensor, full_input, n,
-                                DataType.DT_FLOAT)
-    dl_label = SingleDataLoader(ffmodel, label_tensor, full_label, n,
-                                DataType.DT_INT32)
+    dl_x = SingleDataLoader(ffmodel, input_tensor, full_input, n,
+                            DataType.DT_FLOAT)
+    dl_y = SingleDataLoader(ffmodel, label_tensor, full_label, n,
+                            DataType.DT_INT32)
 
     ffmodel.init_layers()
-    ffmodel.train([dl_input, dl_label], epochs=EPOCHS)
+    ffmodel.train([dl_x, dl_y], epochs=EPOCHS)
 
 
 if __name__ == "__main__":

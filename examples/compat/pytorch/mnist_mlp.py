@@ -56,9 +56,9 @@ def top_level_task():
     full_label = ffmodel.create_tensor([n, 1], DataType.DT_INT32)
     full_input.attach_numpy_array(ffconfig, x_train)
     full_label.attach_numpy_array(ffconfig, y_train)
-    dl_x = SingleDataLoader(ffmodel, input_tensor, full_input, 64,
+    dl_x = SingleDataLoader(ffmodel, input_tensor, full_input, n,
                             DataType.DT_FLOAT)
-    dl_y = SingleDataLoader(ffmodel, label_tensor, full_label, 64,
+    dl_y = SingleDataLoader(ffmodel, label_tensor, full_label, n,
                             DataType.DT_INT32)
 
     ffmodel.init_layers()

@@ -14,10 +14,13 @@ for s in keras/seq_mnist_mlp.py keras/seq_mnist_cnn.py \
          keras/seq_mnist_mlp_net2net.py keras/seq_mnist_cnn_nested.py \
          keras/callback.py keras/unary.py keras/reshape.py \
          keras/func_mnist_mlp.py keras/func_mnist_mlp_concat.py \
+         keras/func_mnist_cnn.py keras/func_cifar10_cnn.py \
+         keras/func_cifar10_cnn_nested.py keras/func_mnist_mlp_net2net.py \
          keras/func_cifar10_alexnet.py \
          keras/func_cifar10_cnn_concat_seq_model.py \
-         native/mnist_mlp.py native/mnist_cnn.py native/print_layers.py \
-         native/split.py onnx/mnist_mlp.py pytorch/mnist_mlp.py; do
+         native/mnist_mlp.py native/mnist_cnn.py native/cifar10_cnn.py \
+         native/print_layers.py native/split.py native/tensor_attach.py \
+         onnx/mnist_mlp.py pytorch/mnist_mlp.py; do
   echo "=== $s"
   python "$s"
 done

@@ -41,6 +41,8 @@ def _run(rel):
     "keras/reshape.py",
     "keras/seq_reuters_mlp.py",
     "native/mnist_mlp.py",
+    "native/tensor_attach.py",
+    "keras/func_mnist_mlp_net2net.py",
     "native/print_layers.py",
     "native/split.py",
     "pytorch/mnist_mlp.py",
