@@ -48,6 +48,13 @@ class FFConfig:
     simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
     # Numerics
     compute_dtype: str = "float32"  # per-op matmuls may run bf16 on TPU
+    # Row-sparse embedding updates under plain SGD ("auto"|"on"|"off").
+    # "auto" enables them on cpu/gpu, where scatter updates alias in
+    # place; on tpu the XLA scatter emitter wraps the update in full-table
+    # layout copies (measured slower than dense autodiff — see PERF.md),
+    # so "auto" keeps the dense path there until the pallas row-update
+    # kernel lands.  "on"/"off" force the choice.
+    sparse_embedding_updates: str = "auto"
     seed: int = 0
 
     @staticmethod

@@ -466,7 +466,26 @@ class FFModel:
         # kernel pair (embedding.cu:199-224, optimizer_kernel.cu:23-43).
         input_name_of = {t.uid: t.name for t in self._inputs}
         sparse_emb = []
-        if (isinstance(self.optimizer, SGDOptimizer)
+        sparse_mode = getattr(self.config, "sparse_embedding_updates",
+                              "auto")
+        if sparse_mode == "auto":
+            # the win depends on the backend updating the table in place.
+            # XLA:TPU's scatter emitter forces its own layout on the
+            # operand and surrounds the scatter with FULL-TABLE layout
+            # copies (measured in the compiled HLO: 2 table-sized copy ops
+            # per step, making the sparse path ~4x slower than dense
+            # autodiff on a v5e) — so "auto" keeps the dense path on tpu
+            # until the planned pallas in-place row-update kernel lands,
+            # and enables sparse on cpu/gpu where scatter aliases cleanly
+            sparse_ok = jax.default_backend() in ("cpu", "gpu")
+        elif sparse_mode in ("on", "off"):
+            sparse_ok = sparse_mode == "on"
+        else:
+            raise ValueError(
+                f"sparse_embedding_updates must be 'auto'|'on'|'off', "
+                f"got {sparse_mode!r}")
+        if (sparse_ok
+                and isinstance(self.optimizer, SGDOptimizer)
                 and self.optimizer.momentum == 0.0
                 and self.optimizer.weight_decay == 0.0):
             for op in self.layers:

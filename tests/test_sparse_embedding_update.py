@@ -147,3 +147,45 @@ class TestSparseMatchesDense:
         st1, _ = m.train_step(st_lr, inputs, labels)
         np.testing.assert_array_equal(
             before, np.asarray(st1.params["emb"]["embedding"]))
+
+
+class TestSparseModeKnob:
+    def test_off_forces_dense(self):
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 8],
+                         mlp_top=[8 * 2 + 8, 1])
+        fc = ff.FFConfig(batch_size=8, sparse_embedding_updates="off")
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        assert not m._sparse_emb_ops
+
+    def test_auto_enables_on_cpu(self):
+        # the test platform is cpu (conftest), an aliasing backend
+        import jax
+        assert jax.default_backend() == "cpu"
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 8],
+                         mlp_top=[8 * 2 + 8, 1])
+        m = build_dlrm(cfg, ff.FFConfig(batch_size=8))
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        assert m._sparse_emb_ops
+
+    def test_invalid_mode_raises(self):
+        import pytest as _pytest
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 8],
+                         mlp_top=[8 * 2 + 8, 1])
+        m = build_dlrm(cfg, ff.FFConfig(batch_size=8,
+                                        sparse_embedding_updates="On"))
+        with _pytest.raises(ValueError):
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
