@@ -49,11 +49,11 @@ class FFConfig:
     # Numerics
     compute_dtype: str = "float32"  # per-op matmuls may run bf16 on TPU
     # Row-sparse embedding updates under plain SGD ("auto"|"on"|"off").
-    # "auto" enables them on cpu/gpu, where scatter updates alias in
-    # place; on tpu the XLA scatter emitter wraps the update in full-table
-    # layout copies (measured slower than dense autodiff — see PERF.md),
-    # so "auto" keeps the dense path there until the pallas row-update
-    # kernel lands.  "on"/"off" force the choice.
+    # "auto" enables them on cpu/gpu (scatter aliases in place) and on
+    # single-device tpu where the in-place pallas row-update kernel
+    # applies (ops/pallas_scatter.py — XLA's own scatter emitter forces
+    # full-table layout copies, see PERF.md).  "on"/"off" force the
+    # choice.
     sparse_embedding_updates: str = "auto"
     seed: int = 0
 

@@ -468,16 +468,18 @@ class FFModel:
         sparse_emb = []
         sparse_mode = getattr(self.config, "sparse_embedding_updates",
                               "auto")
+        backend = jax.default_backend()
         if sparse_mode == "auto":
-            # the win depends on the backend updating the table in place.
-            # XLA:TPU's scatter emitter forces its own layout on the
-            # operand and surrounds the scatter with FULL-TABLE layout
-            # copies (measured in the compiled HLO: 2 table-sized copy ops
-            # per step, making the sparse path ~4x slower than dense
-            # autodiff on a v5e) — so "auto" keeps the dense path on tpu
-            # until the planned pallas in-place row-update kernel lands,
-            # and enables sparse on cpu/gpu where scatter aliases cleanly
-            sparse_ok = jax.default_backend() in ("cpu", "gpu")
+            # the win depends on updating the table in place.  cpu/gpu
+            # scatter aliases cleanly.  XLA:TPU's scatter emitter forces
+            # its own operand layout and wraps the update in FULL-TABLE
+            # layout copies (measured ~4x slower than dense autodiff on a
+            # v5e), so on tpu the path is taken only where the in-place
+            # pallas row-update kernel applies: single-device (SPMD cannot
+            # partition a pallas_call) and kernel-compatible shapes,
+            # checked per op below.
+            sparse_ok = (backend in ("cpu", "gpu")
+                         or (backend == "tpu" and self.mesh is None))
         elif sparse_mode in ("on", "off"):
             sparse_ok = sparse_mode == "on"
         else:
@@ -492,7 +494,9 @@ class FFModel:
                 if (isinstance(op, (Embedding, StackedEmbedding))
                         and getattr(op, "placement", "tpu") != "cpu"
                         and not getattr(op, "use_pallas", False)
-                        and op.inputs[0].uid in input_name_of):
+                        and op.inputs[0].uid in input_name_of
+                        and not (sparse_mode == "auto" and backend == "tpu"
+                                 and not op.pallas_update_ok())):
                     sparse_emb.append(op)
         self._sparse_emb_ops = [op.name for op in sparse_emb]
         emb_names = {op.name for op in sparse_emb}

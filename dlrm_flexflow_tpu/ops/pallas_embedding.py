@@ -85,7 +85,7 @@ def embedding_bag_pallas(table: jnp.ndarray, ids: jnp.ndarray,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # ids
         grid=(bsz // block_b,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table in HBM
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table in HBM
         out_specs=pl.BlockSpec((block_b, dim), lambda b, ids: (b, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[

@@ -1,0 +1,195 @@
+"""Pallas TPU kernel: in-place sparse row update of an embedding table.
+
+TPU-native replacement for the reference's scatter-add backward +
+in-place SGD kernel pair on embedding tables (reference:
+src/ops/embedding.cu:199-224 atomicAdd scatter, optimizer_kernel.cu:23-43
+sgd_update).  XLA:TPU's scatter emitter forces its own operand layout and
+wraps the update in FULL-TABLE layout copies (see PERF.md), so the
+row-sparse SGD path is implemented as a hand-written kernel instead:
+
+  table[ids[k]] += scale * updates[k]        (duplicates accumulate)
+
+- The table stays in HBM and is updated IN PLACE via
+  ``input_output_aliases`` — per step only the touched rows move.
+- ids arrive SORTED (the wrapper sorts); duplicate ids form adjacent
+  runs.  Within a block the kernel chains run accumulation sequentially
+  on the VPU; only the LAST slot of each run writes back, so duplicate
+  writebacks can never race.  Runs crossing a block boundary are carried
+  in a VMEM scratch (grid steps execute sequentially on TPU).
+- Row DMAs of one block are all started before any is awaited, so the
+  fetch latency overlaps.
+
+The wrapper falls back to ``table.at[ids].add`` off-TPU (and in tests via
+interpret mode the kernel itself is exercised).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BLOCK = 16  # update slots per grid step (unrolled in-kernel)
+
+
+def _row_update_kernel(ids_ref, table_hbm, upd_ref, out_hbm,
+                       scratch, acc_ref, carry_ref, sems, out_sems,
+                       *, block: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    base = blk * block
+
+    # ---- fetch all rows of this block (overlapped DMAs) ------------------
+    # rows are moved as 2-D (1, d) slices: 1-D (d,) row refs hit a Mosaic
+    # lowering bug for d < 128
+    def fetch(k):
+        return pltpu.make_async_copy(
+            out_hbm.at[pl.ds(ids_ref[base + k], 1)],
+            scratch.at[pl.ds(k, 1)], sems.at[k])
+
+    for k in range(block):
+        fetch(k).start()
+    for k in range(block):
+        fetch(k).wait()
+
+    # ---- sequential run accumulation -------------------------------------
+    # acc_k = prev_acc + u_k   when ids[k] == ids[k-1]  (same run)
+    #       = fetched_k + u_k  otherwise                (new run)
+    # slot 0 continues the carry when the run crosses the block boundary
+    for k in range(block):
+        g = base + k
+        u = upd_ref[k, :]
+        if k == 0:
+            prev = carry_ref[0, :]
+            # clamp so grid step 0 never reads before the ids buffer (the
+            # blk > 0 mask discards the value, not the load)
+            prev_id = ids_ref[jnp.maximum(base - 1, 0)]
+            same = (blk > 0) & (ids_ref[base] == prev_id)
+        else:
+            prev = acc_ref[k - 1, :]
+            same = ids_ref[g] == ids_ref[g - 1]
+        fetched = scratch[k, :]
+        acc_ref[k, :] = jnp.where(same, prev, fetched) + u
+
+    carry_ref[0, :] = acc_ref[block - 1, :]
+
+    # ---- write back only the last slot of each run -----------------------
+    # run-last <=> next id differs; ids_ref is padded with a sentinel at
+    # position n, so slot n-1 is always run-last
+    def wb(k):
+        return pltpu.make_async_copy(
+            acc_ref.at[pl.ds(k, 1)],
+            out_hbm.at[pl.ds(ids_ref[base + k], 1)],
+            out_sems.at[k])
+
+    for k in range(block):
+        g = base + k
+
+        @pl.when(ids_ref[g] != ids_ref[g + 1])
+        def _():
+            wb(k).start()
+
+    for k in range(block):
+        g = base + k
+
+        @pl.when(ids_ref[g] != ids_ref[g + 1])
+        def _():
+            wb(k).wait()
+
+
+def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False):
+    """table (R, d) f32; ids_sorted (n,) int32 ascending (padded tail
+    repeats the last id with zero updates); upd_sorted (n, d).  Returns
+    the updated table, aliased in place."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = upd_sorted.shape
+    assert n % _BLOCK == 0, f"n={n} must divide by {_BLOCK}"
+    # sentinel pad so ids_ref[g + 1] is valid at g = n - 1
+    ids_padded = jnp.concatenate(
+        [ids_sorted, jnp.full((1,), -1, jnp.int32)])
+
+    kern = functools.partial(_row_update_kernel, block=_BLOCK)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # ids
+        grid=(n // _BLOCK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
+            pl.BlockSpec((_BLOCK, d), lambda b, ids: (b, 0)),  # updates
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased table
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK, d), table.dtype),   # fetched rows
+            pltpu.VMEM((_BLOCK, d), table.dtype),   # accumulated rows
+            pltpu.VMEM((1, d), table.dtype),        # cross-block carry
+            pltpu.SemaphoreType.DMA((_BLOCK,)),
+            pltpu.SemaphoreType.DMA((_BLOCK,)),
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={1: 0},  # table input -> output, in place
+        interpret=interpret,
+    )(ids_padded, table, upd_sorted)
+
+
+def supports_pallas_row_update(num_rows: int, dim: int, n: int) -> bool:
+    """Static eligibility of the kernel for a (num_rows, dim) table with
+    ``n`` updates per step (Mosaic needs 128-lane rows; narrower dims are
+    packed, which needs both 128 % dim == 0 and num_rows % pack == 0)."""
+    if n % _BLOCK != 0:
+        return False
+    if dim >= 128:
+        return dim % 128 == 0
+    if 128 % dim != 0:
+        return False
+    return num_rows % (128 // dim) == 0
+
+
+def sparse_row_update(table, ids, updates, scale, *, interpret=False,
+                      force=False, allow_kernel=True):
+    """``table[ids] += scale * updates`` with duplicate accumulation.
+
+    table (R, d); ids (...,) int; updates (..., d).  Uses the pallas
+    in-place kernel on TPU (or when forced/interpreted); otherwise the
+    plain XLA scatter-add.
+
+    Mosaic requires 128-lane row slices, so tables with d < 128 (and
+    128 % d == 0) are viewed as (R/pack, d*pack) — a free row-major
+    bitcast — and each update lands in its half/quarter row via a
+    padded 128-lane update vector; duplicate-run accumulation then keys
+    on VIEW rows, which also serializes updates to neighboring packed
+    rows (they share a view row and would otherwise race on writeback).
+    """
+    r, d = table.shape
+    ids_flat = ids.reshape(-1).astype(jnp.int32)
+    upd_flat = (scale * updates.reshape(-1, d)).astype(table.dtype)
+    n = ids_flat.shape[0]
+    # allow_kernel=False (e.g. a sharded table under a mesh — SPMD cannot
+    # partition a pallas_call) forces the XLA scatter path
+    use_kernel = force or interpret or (
+        allow_kernel and jax.default_backend() == "tpu")
+    if not (use_kernel and supports_pallas_row_update(r, d, n)):
+        return table.at[ids_flat].add(upd_flat)
+    pack = 1 if d >= 128 else 128 // d
+    if pack > 1:
+        q = ids_flat // pack
+        h = ids_flat % pack
+        lanes = jax.nn.one_hot(h, pack, dtype=table.dtype)  # (n, pack)
+        upd_flat = (lanes[:, :, None] * upd_flat[:, None, :]).reshape(
+            n, d * pack)
+        view = table.reshape(r // pack, d * pack)
+        order = jnp.argsort(q)
+        out = _row_update_pallas(view, q[order], upd_flat[order],
+                                 interpret=interpret)
+        return out.reshape(r, d)
+    order = jnp.argsort(ids_flat)
+    return _row_update_pallas(table, ids_flat[order], upd_flat[order],
+                              interpret=interpret)
