@@ -2,10 +2,12 @@ from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, constrain, make_mesh,
                    param_pspec, pspec_for_config, sharding)
 from .parallel_config import ParallelConfig, Strategy
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "make_mesh", "pspec_for_config", "param_pspec", "sharding", "constrain",
     "ParallelConfig", "Strategy",
     "ring_attention", "ring_attention_sharded",
+    "ulysses_attention", "ulysses_attention_sharded",
 ]

@@ -1,0 +1,59 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second first-class long-context strategy next to ring attention
+(parallel/ring_attention.py).  No reference analogue (the reference has
+no attention, SURVEY §5.7).  Design:
+
+- q/k/v enter sequence-sharded: each device holds (B, H, S/p, D);
+- one ``lax.all_to_all`` over the "seq" mesh axis re-shards from the
+  sequence dim to the HEAD dim -> (B, H/p, S, D): every device now sees
+  the FULL sequence for its head subset, so plain dense attention
+  (including exact causal masking) runs locally with no per-step
+  communication;
+- a second all-to-all swaps the output back to sequence-sharded.
+
+Trade-off vs ring attention: Ulysses moves activations twice through
+all-to-all (cheap on the ICI torus) and needs heads % devices == 0, but
+keeps the full S×S score matrix per head on one chip — best for moderate
+S with many heads.  Ring attention never materializes full-S scores —
+best for extreme S.  Both are exposed with the same sharded signature.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import sdpa
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq",
+                      causal: bool = False):
+    """Per-shard body (inside shard_map): q/k/v local (B, H, S/p, D)."""
+    nheads = q.shape[1]
+    p = jax.lax.psum(1, axis_name)
+    assert nheads % p == 0, (
+        f"ulysses needs heads ({nheads}) divisible by the '{axis_name}' "
+        f"axis size ({p})")
+    # seq-sharded -> head-sharded: (B, H, S/p, D) -> (B, H/p, S, D)
+    swap = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                             split_axis=1, concat_axis=2, tiled=True)
+    o = sdpa(swap(q), swap(k), swap(v), causal=causal)
+    # head-sharded -> seq-sharded: (B, H/p, S, D) -> (B, H, S/p, D)
+    return jax.lax.all_to_all(o, axis_name=axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                              causal: bool = False):
+    """shard_map wrapper: q/k/v are global (B, H, S, D) arrays sharded on
+    S over ``seq_axis`` (B on "data" when present), like
+    ``ring_attention_sharded``."""
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, None, seq_axis, None)
+    f = functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal)
+    return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
