@@ -55,6 +55,10 @@ class FFConfig:
     # full-table layout copies, see PERF.md).  "on"/"off" force the
     # choice.
     sparse_embedding_updates: str = "auto"
+    # fit()'s scanned-epoch fast path stages the whole dataset on device;
+    # datasets larger than this stay on the streaming per-batch loop
+    # (0 disables the fast path entirely)
+    fit_scan_max_bytes: int = 2 * 1024 * 1024 * 1024
     seed: int = 0
 
     @staticmethod

@@ -211,3 +211,53 @@ class TestDotInteractionVsTorch:
                                    xt.grad.numpy(), rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(np.asarray(g["emb"]),
                                    et.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+class TestFitScanFastPath:
+    """fit() runs scan-eligible epochs as one on-device lax.scan; the
+    result must be identical to the per-batch loop (same steps, same
+    metric totals)."""
+
+    def _model_and_loader(self):
+        import numpy as np
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=[64] * 4,
+                         embedding_bag_size=2,
+                         mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 4 + 8, 16, 1])
+        m = build_dlrm(cfg, ff.FFConfig(batch_size=16))
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy", "mean_squared_error"), mesh=False)
+        loader = SyntheticDLRMLoader(64, 4, cfg.embedding_size, 2, 16)
+        return m, loader
+
+    def test_matches_per_batch_loop(self, capsys):
+        import numpy as np
+        from dlrm_flexflow_tpu.frontends.keras_callbacks import Callback
+
+        m1, l1 = self._model_and_loader()
+        st1 = m1.init(seed=0)
+        st1, _ = m1.fit(st1, l1, epochs=2, verbose=True)  # scan path
+        assert m1._last_fit_used_scan  # the fast path actually engaged
+        out_scan = [l for l in capsys.readouterr().out.splitlines()
+                    if l.startswith("epoch")]
+
+        m2, l2 = self._model_and_loader()
+        st2 = m2.init(seed=0)
+        # a no-op callback forces the general per-batch loop
+        st2, _ = m2.fit(st2, l2, epochs=2, verbose=True,
+                        callbacks=[Callback()])
+        assert not m2._last_fit_used_scan  # callbacks force the loop
+        out_loop = [l for l in capsys.readouterr().out.splitlines()
+                    if l.startswith("epoch")]
+
+        assert out_scan == out_loop  # identical per-epoch metric reports
+        for opn in st1.params:
+            for k in st1.params[opn]:
+                np.testing.assert_allclose(
+                    np.asarray(st1.params[opn][k]),
+                    np.asarray(st2.params[opn][k]), rtol=1e-6, atol=1e-6)
