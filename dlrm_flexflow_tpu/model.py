@@ -758,7 +758,8 @@ class FFModel:
         return self._last_metrics
 
     def fit(self, state: TrainState, dataloader, epochs: Optional[int] = None,
-            verbose: bool = True, callbacks=None) -> Tuple[TrainState, float]:
+            verbose: bool = True, callbacks=None, warmup: bool = True,
+            show_throughput: bool = True) -> Tuple[TrainState, float]:
         """Epoch loop with the reference's timing protocol: fence, warmup
         epoch outside timing, throughput print (dlrm.cc:154-198).
 
@@ -821,11 +822,14 @@ class FFModel:
             scan_data = self.place_dataset(stacked_in, stacked_lab)
         self._last_fit_used_scan = scan_data is not None
 
-        # warmup/compile batch
-        first = dataloader.peek()
-        state, _ = self.train_step(state, first[0], first[1])
+        # warmup/compile batch (a real update on the first batch — the
+        # reference's untimed epoch 0, dlrm.cc:178; warmup=False keeps
+        # exact step parity with a plain per-batch loop)
         from .profiling import device_fence
-        device_fence(state.step)
+        if warmup:
+            first = dataloader.peek()
+            state, _ = self.train_step(state, first[0], first[1])
+            device_fence(state.step)
         scan_fn = None
         if scan_data is not None:
             # AOT-compile the scanned epoch outside the timed window (the
@@ -867,7 +871,7 @@ class FFModel:
         device_fence(state.step)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
-        if verbose:
+        if verbose and show_throughput:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thpt:.2f} samples/s")
         # trained state is recoverable even if a verify callback raises
         self._fit_state = state

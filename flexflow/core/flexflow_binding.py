@@ -601,11 +601,53 @@ class FFModel:
     def train(self, dataloaders, epochs=1, batch_size=64):
         """reference flexflow_cbinding.py:789-812 — same loop shape, but the
         body is the core's fused jitted train step (fwd+bwd+metrics+update
-        in one XLA program; Legion tracing's analogue is the jit cache)."""
+        in one XLA program; Legion tracing's analogue is the jit cache).
+
+        When every dataloader is a Single/Pair loader over attached host
+        arrays, the whole run goes through the core ``fit`` so eligible
+        epochs execute as ONE on-device scan (no per-step dispatch)."""
         state = self._require_state()
         num_samples = dataloaders[0].get_num_samples()
         batch = self._ffconfig.get_batch_size()
         label_name = self._core.label_tensor.name
+
+        singles = []
+        for d in dataloaders:
+            if isinstance(d, _PairDataLoader):
+                singles.extend([d._input, d._label])
+            elif isinstance(d, SingleDataLoader):
+                singles.append(d)
+            else:
+                singles = None
+                break
+        if singles is not None:
+            n = min(s.num_samples for s in singles)
+            inputs, labels = {}, None
+            for s in singles:
+                if s._target == label_name:
+                    labels = s._data[:n]
+                else:
+                    inputs[s._target] = s._data[:n]
+            # the loaders must feed EVERY op-consumed graph input (a graph
+            # with extra attached tensors — constants staged via _pending —
+            # keeps the general per-batch loop)
+            consumed = {t.uid for op in self._core.layers
+                        for t in op.inputs}
+            required = {t.name for t in self._core._inputs
+                        if t.uid in consumed and t.name != label_name}
+            if (labels is not None and inputs and n >= batch
+                    and epochs > 0 and set(inputs) >= required):
+                from dlrm_flexflow_tpu.data.loader import ArrayDataLoader
+                loader = ArrayDataLoader(inputs, labels, batch)
+                # warmup=False + no throughput line: exact step count and
+                # stdout parity with the per-batch loop below
+                state, _ = self._core.fit(state, loader, epochs=epochs,
+                                          verbose=True, warmup=False,
+                                          show_throughput=False)
+                self._state = state
+                self._acc = self._core._last_metrics
+                return
+
         for epoch in range(epochs):
             for d in dataloaders:
                 d.reset()

@@ -410,3 +410,37 @@ class TestImperativeStateThreading:
                         loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
                         metrics=[])
         assert ffmodel._core.optimizer is core_adam
+
+
+def test_train_fast_path_step_and_stdout_parity(capsys):
+    """binding train() via the core scan fast path must run exactly
+    nb*epochs updates (no warmup extra) and print only 'epoch N:' lines,
+    like the per-batch loop it replaces."""
+    import numpy as np
+    ffconfig = FFConfig()
+    ffconfig.parse_args(["-b", "16"])
+    ffmodel = FFModel(ffconfig)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = rng.standard_normal((64, 1)).astype(np.float32)
+    inp = ffmodel.create_tensor([16, 8], DataType.DT_FLOAT)
+    ffmodel.dense(inp, 1)
+    ffmodel.compile(optimizer=SGDOptimizer(ffmodel, 0.05),
+                    loss_type=LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+                    metrics=[MetricsType.METRICS_MEAN_SQUARED_ERROR])
+    label = ffmodel.get_label_tensor()
+    fx = ffmodel.create_tensor([64, 8], DataType.DT_FLOAT)
+    fy = ffmodel.create_tensor([64, 1], DataType.DT_FLOAT)
+    fx.attach_numpy_array(ffconfig, x)
+    fy.attach_numpy_array(ffconfig, y)
+    dx = SingleDataLoader(ffmodel, inp, fx, 64, DataType.DT_FLOAT)
+    dy = SingleDataLoader(ffmodel, label, fy, 64, DataType.DT_FLOAT)
+    ffmodel.init_layers()
+    ffmodel.train([dx, dy], epochs=2)
+    out = capsys.readouterr().out
+    assert "THROUGHPUT" not in out
+    assert int(np.asarray(ffmodel._state.step)) == 2 * (64 // 16)
+    # epochs=0 must do nothing
+    step_before = int(np.asarray(ffmodel._state.step))
+    ffmodel.train([dx, dy], epochs=0)
+    assert int(np.asarray(ffmodel._state.step)) == step_before
