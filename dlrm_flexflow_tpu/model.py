@@ -757,6 +757,36 @@ class FFModel:
         ffmodel.get_perf_metrics, flexflow_cbinding.py)."""
         return self._last_metrics
 
+    def _stage_scan_dataset(self, dataloader, cbs):
+        """Stage the whole dataset on device for fit()'s fast path — each
+        epoch then runs as ONE on-device lax.scan (the Legion-tracing
+        analogue), eliminating per-step host dispatch.  Returns None (and
+        fit keeps the general per-batch loop) when per-batch work is
+        needed: callbacks, hetero CPU tables, a mesh, shuffling, a
+        non-array loader, or a dataset larger than fit_scan_max_bytes.
+        """
+        scan_cap = getattr(self.config, "fit_scan_max_bytes",
+                           2 * 1024 * 1024 * 1024)
+        if not (not cbs and not self._hetero_ops and self.mesh is None
+                and scan_cap > 0
+                and getattr(dataloader, "inputs", None) is not None
+                and getattr(dataloader, "drop_last", False)
+                and not getattr(dataloader, "shuffle", True)
+                and dataloader.num_batches > 0
+                and (sum(v.nbytes for v in dataloader.inputs.values())
+                     + dataloader.labels.nbytes) <= scan_cap):
+            return None
+        import numpy as np
+        nb = dataloader.num_batches
+        bsz = dataloader.batch_size
+        n_used = nb * bsz
+        stacked_in = {
+            k: np.asarray(v[:n_used]).reshape((nb, bsz) + v.shape[1:])
+            for k, v in dataloader.inputs.items()}
+        stacked_lab = np.asarray(dataloader.labels[:n_used]).reshape(
+            (nb, bsz) + dataloader.labels.shape[1:])
+        return self.place_dataset(stacked_in, stacked_lab)
+
     def fit(self, state: TrainState, dataloader, epochs: Optional[int] = None,
             verbose: bool = True, callbacks=None, warmup: bool = True,
             show_throughput: bool = True) -> Tuple[TrainState, float]:
@@ -793,33 +823,7 @@ class FFModel:
             for cb in cbs:
                 cb.on_epoch_begin(0)
             state = apply_pending_lr(state)
-        # Fast path: no per-batch hooks needed -> run each epoch as ONE
-        # on-device lax.scan (the Legion-tracing analogue), eliminating
-        # per-step host dispatch.  Requires an in-memory array loader with
-        # uniform sequential batches; callbacks, hetero CPU tables (host
-        # work per step) and shuffling keep the general per-batch loop.
-        scan_data = None
-        scan_cap = getattr(self.config, "fit_scan_max_bytes",
-                           2 * 1024 * 1024 * 1024)
-        if (not cbs and not self._hetero_ops and self.mesh is None
-                and scan_cap > 0
-                and getattr(dataloader, "inputs", None) is not None
-                and getattr(dataloader, "drop_last", False)
-                and not getattr(dataloader, "shuffle", True)
-                and dataloader.num_batches > 0
-                and (sum(v.nbytes for v in dataloader.inputs.values())
-                     + dataloader.labels.nbytes) <= scan_cap):
-            nb = dataloader.num_batches
-            bsz = dataloader.batch_size
-            n_used = nb * bsz
-            import numpy as _np
-            stacked_in = {
-                k: _np.asarray(v[:n_used]).reshape((nb, bsz) + v.shape[1:])
-                for k, v in dataloader.inputs.items()}
-            stacked_lab = _np.asarray(
-                dataloader.labels[:n_used]).reshape(
-                    (nb, bsz) + dataloader.labels.shape[1:])
-            scan_data = self.place_dataset(stacked_in, stacked_lab)
+        scan_data = self._stage_scan_dataset(dataloader, cbs)
         self._last_fit_used_scan = scan_data is not None
 
         # warmup/compile batch (a real update on the first batch — the
