@@ -108,3 +108,54 @@ class EpochVerifyMetrics(Callback):
         # >= (not the reference's strict >) for consistency with
         # VerifyMetrics' pass condition
         return acc >= self.accuracy
+
+
+class ModelCheckpoint(Callback):
+    """Save a full-training-state checkpoint every ``period`` epochs (and
+    at train end) — the periodic-save half of the checkpoint/resume story
+    the reference lacks entirely (SURVEY §5.4: only get/set_weights).
+
+    ``filepath`` may contain ``{epoch}``; restore with
+    ``checkpoint.restore_checkpoint`` and keep training.
+    """
+
+    def __init__(self, filepath: str, period: int = 1, verbose: bool = False):
+        super().__init__()
+        self.filepath = filepath
+        self.period = max(1, int(period))
+        self.verbose = verbose
+        self.saved: list = []
+        self._last_epoch = -1       # last epoch that finished
+        self._last_saved_epoch = -1  # last epoch actually written
+
+    def _state(self):
+        ff = _ffmodel_of(self.model)
+        state = getattr(ff, "_fit_state", None)
+        if state is None:  # keras-level model holds it after fit returns
+            state = getattr(self.model, "state", None)
+        return state
+
+    def _save(self, epoch):
+        from ..checkpoint import save_checkpoint
+        state = self._state()
+        if state is None:
+            return
+        path = self.filepath.format(epoch=epoch)
+        save_checkpoint(path, state)
+        self.saved.append(path)
+        if self.verbose:
+            print(f"checkpoint saved: {path}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._last_epoch = epoch
+        if (epoch + 1) % self.period == 0:
+            self._save(epoch)
+            self._last_saved_epoch = epoch
+
+    def on_train_end(self, logs=None):
+        # ensure the FINAL state is on disk: save again (numeric epoch, so
+        # format specs like {epoch:02d} keep working) unless the last
+        # epoch's state was already written by a periodic save
+        if self._last_epoch >= 0 and self._last_saved_epoch != self._last_epoch:
+            self._save(self._last_epoch)
+            self._last_saved_epoch = self._last_epoch

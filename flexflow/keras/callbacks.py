@@ -1,7 +1,8 @@
 """reference python/flexflow/keras/callbacks.py."""
 
 from dlrm_flexflow_tpu.frontends.keras_callbacks import (
-    Callback, EpochVerifyMetrics, LearningRateScheduler, VerifyMetrics)
+    Callback, EpochVerifyMetrics, LearningRateScheduler, ModelCheckpoint,
+    VerifyMetrics)
 
-__all__ = ["Callback", "LearningRateScheduler", "VerifyMetrics",
-           "EpochVerifyMetrics"]
+__all__ = ["Callback", "LearningRateScheduler", "ModelCheckpoint",
+           "VerifyMetrics", "EpochVerifyMetrics"]

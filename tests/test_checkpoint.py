@@ -68,3 +68,62 @@ def test_restore_onto_mesh_replaces_shardings(tmp_path):
     restored = restore_checkpoint(path, m2)
     emb = restored.params["emb"]["embedding"]
     assert emb.sharding.spec[0] == "model"
+
+
+def test_model_checkpoint_callback_saves_and_resumes(tmp_path):
+    """ModelCheckpoint saves during fit; restoring the last checkpoint
+    reproduces the exact trained state."""
+    import numpy as np
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.checkpoint import restore_checkpoint
+    from dlrm_flexflow_tpu.frontends.keras_callbacks import ModelCheckpoint
+    from dlrm_flexflow_tpu.data.loader import ArrayDataLoader
+
+    m = ff.FFModel(ff.FFConfig(batch_size=8))
+    x = m.create_tensor((8, 4), name="x")
+    m.dense(x, 1)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    st = m.init(seed=0)
+    rng = np.random.default_rng(0)
+    loader = ArrayDataLoader({"x": rng.standard_normal((32, 4)).astype(
+        np.float32)}, rng.standard_normal((32, 1)).astype(np.float32), 8)
+
+    cb = ModelCheckpoint(str(tmp_path / "ck_{epoch:02d}"), period=2)
+    st, _ = m.fit(st, loader, epochs=4, verbose=False, callbacks=[cb])
+    assert any(p.endswith("ck_01") for p in cb.saved)  # epoch index 1
+    assert any(p.endswith("ck_03") for p in cb.saved)
+    # epoch 3 was a periodic save, so no redundant final save
+    assert cb.saved[-1].endswith("ck_03")
+
+    restored = restore_checkpoint(cb.saved[-1], m)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["dense"]["kernel"]),
+        np.asarray(st.params["dense"]["kernel"]))
+    assert int(np.asarray(restored.step)) == int(np.asarray(st.step))
+
+
+def test_model_checkpoint_fixed_path_holds_final_state(tmp_path):
+    """A placeholder-free filepath must end up holding the FINAL trained
+    state even when the last epoch missed the periodic cadence."""
+    import numpy as np
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.checkpoint import restore_checkpoint
+    from dlrm_flexflow_tpu.frontends.keras_callbacks import ModelCheckpoint
+    from dlrm_flexflow_tpu.data.loader import ArrayDataLoader
+
+    m = ff.FFModel(ff.FFConfig(batch_size=8))
+    x = m.create_tensor((8, 4), name="x")
+    m.dense(x, 1)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    st = m.init(seed=0)
+    rng = np.random.default_rng(0)
+    loader = ArrayDataLoader({"x": rng.standard_normal((32, 4)).astype(
+        np.float32)}, rng.standard_normal((32, 1)).astype(np.float32), 8)
+
+    ck = str(tmp_path / "ck")  # no {epoch} placeholder
+    cb = ModelCheckpoint(ck, period=2)
+    st, _ = m.fit(st, loader, epochs=5, verbose=False, callbacks=[cb])
+    restored = restore_checkpoint(ck, m)
+    assert int(np.asarray(restored.step)) == int(np.asarray(st.step))
