@@ -178,3 +178,25 @@ def test_dlrm_profiling_flag(capsys):
          "--arch-mlp-bot", "4-8-4", "--arch-mlp-top", "12-8-1"])
     out = capsys.readouterr().out
     assert "forward(us)" in out and "bot_0" in out
+
+
+def test_dlrm_cli_budget_search_and_export(tmp_path):
+    """--budget triggers the compile-time SOAP search and --export writes
+    the found strategy (reference model.cc:1010-1016 STRATEGY_SEARCH task
+    + save_strategies_to_file), then --import loads it back."""
+    import json
+    from dlrm_flexflow_tpu.apps.dlrm import run
+    out = tmp_path / "strategy.json"
+    run(["-b", "16", "-e", "1", "--data-size", "32",
+         "--budget", "30", "--export", str(out),
+         "--arch-embedding-size", "200-200",
+         "--arch-sparse-feature-size", "4",
+         "--arch-mlp-bot", "4-8-4", "--arch-mlp-top", "12-8-1"])
+    data = json.loads(out.read_text())
+    assert data["ops"] and all("dims" in o for o in data["ops"])
+    # round-trip: a fresh run imports the exported strategy
+    run(["-b", "16", "-e", "1", "--data-size", "32",
+         "--import", str(out),
+         "--arch-embedding-size", "200-200",
+         "--arch-sparse-feature-size", "4",
+         "--arch-mlp-bot", "4-8-4", "--arch-mlp-top", "12-8-1"])
