@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import types
 
 import numpy as np
@@ -127,7 +128,15 @@ def _reuters_word_index(path="reuters_word_index.json"):
     return {f"word{i}": i for i in range(3, 30980)}
 
 
-mnist = types.SimpleNamespace(load_data=_mnist_load)
-cifar10 = types.SimpleNamespace(load_data=_cifar10_load)
-reuters = types.SimpleNamespace(load_data=_reuters_load,
-                                get_word_index=_reuters_word_index)
+# Real module objects (not SimpleNamespace) so the compat package can
+# register THE SAME objects under flexflow.keras.datasets.* — both names
+# alias one namespace and monkeypatching either is seen by both.
+mnist = types.ModuleType(__name__ + ".mnist")
+mnist.load_data = _mnist_load
+cifar10 = types.ModuleType(__name__ + ".cifar10")
+cifar10.load_data = _cifar10_load
+reuters = types.ModuleType(__name__ + ".reuters")
+reuters.load_data = _reuters_load
+reuters.get_word_index = _reuters_word_index
+for _m in (mnist, cifar10, reuters):
+    sys.modules[_m.__name__] = _m

@@ -103,6 +103,56 @@ def get_file(fname, origin=None, cache_subdir="datasets",
         f"place the file there manually (origin: {origin})")
 
 
+class Progbar:
+    """Terminal progress bar (reference utils/generic_utils.py Progbar):
+    ``update(current, values)`` prints ``current/target`` plus running
+    averages of the named values; ``add(n, values)`` advances by ``n``."""
+
+    def __init__(self, target, width=30, verbose=1, interval=0.05,
+                 stateful_metrics=None):
+        self.target = target
+        self.width = width
+        self.verbose = verbose
+        self.interval = interval
+        self.stateful_metrics = set(stateful_metrics or [])
+        self._values = {}
+        self._seen_so_far = 0
+        self._last_print = 0.0
+
+    def update(self, current, values=None):
+        import time
+        for name, v in values or []:
+            if name in self.stateful_metrics:
+                self._values[name] = (float(v), 1)
+            else:
+                tot, cnt = self._values.get(name, (0.0, 0))
+                step = current - self._seen_so_far
+                self._values[name] = (tot + float(v) * max(step, 1),
+                                      cnt + max(step, 1))
+        self._seen_so_far = current
+        if not self.verbose:
+            return
+        final = bool(self.target) and current >= self.target
+        now = time.monotonic()
+        if not final and now - self._last_print < self.interval:
+            return
+        self._last_print = now
+        if self.target:
+            frac = min(current / self.target, 1.0)
+            filled = int(self.width * frac)
+            bar = "=" * filled + "." * (self.width - filled)
+            head = f"{current}/{self.target} [{bar}]"
+        else:
+            head = f"{current}/?"
+        stats = " - ".join(f"{k}: {tot / max(cnt, 1):.4f}"
+                           for k, (tot, cnt) in self._values.items())
+        end = "\n" if self.target and current >= self.target else "\r"
+        print(f"{head} {stats}", end=end, flush=True)
+
+    def add(self, n, values=None):
+        self.update(self._seen_so_far + n, values)
+
+
 class Sequence:
     """Batch-source protocol (reference data_utils.py:305-340): implement
     __getitem__(batch_idx) -> (x, y) and __len__."""

@@ -43,6 +43,12 @@ __all__ = [
     "UniformInitializer", "NormInitializer", "ConstantInitializer",
     "PerfMetrics", "NetConfig", "SingleDataLoader", "DataLoader2D",
     "DataLoader4D", "RegionNdarray",
+    # typed layer handles (reference flexflow_cbinding.py:85-340)
+    "Exp", "Add", "Subtract", "Multiply", "Divide", "Conv2D", "Pool2D",
+    "Linear", "Flat", "Softmax", "Embedding", "Concat", "MSELoss", "Relu",
+    "Sigmoid", "Tanh", "Elu", "Dropout", "Batch_Norm", "Batch_Matmul",
+    "BatchNorm", "BatchMatmul", "Split", "Reshape", "Transpose", "Reverse",
+    "convert_op_handle_to_op",
 ]
 
 
@@ -288,6 +294,179 @@ class Op:
     def get_output_tensor(self) -> Tensor:
         return Tensor(self._core_op.outputs[0], self._ffmodel)
 
+    def init(self, model: "FFModel"):
+        """reference flexflow_op_init — per-op init task.  Weights here are
+        initialized for the whole model at once; ensure that happened."""
+        model._require_state()
+
+    def forward(self, model: "FFModel"):
+        """reference flexflow_op_forward — runs the op's forward task.  The
+        functional core executes the whole (fused) graph; per-op stepping
+        scripts observe the same outputs via the cached layer values."""
+        model.forward()
+
+
+# Typed layer-handle classes (reference flexflow_cbinding.py:85-287 —
+# trivial named subclasses returned by convert_op_handle_to_op:289-340).
+class Exp(Op):
+    pass
+
+
+class Add(Op):
+    pass
+
+
+class Subtract(Op):
+    pass
+
+
+class Multiply(Op):
+    pass
+
+
+class Divide(Op):
+    pass
+
+
+class Conv2D(Op):
+    pass
+
+
+class Pool2D(Op):
+    pass
+
+
+class Linear(Op):
+    pass
+
+
+class Flat(Op):
+    pass
+
+
+class Softmax(Op):
+    pass
+
+
+class Embedding(Op):
+    pass
+
+
+class Concat(Op):
+    pass
+
+
+class MSELoss(Op):
+    pass
+
+
+class Relu(Op):
+    pass
+
+
+class Sigmoid(Op):
+    pass
+
+
+class Tanh(Op):
+    pass
+
+
+class Elu(Op):
+    pass
+
+
+class Dropout(Op):
+    pass
+
+
+class Batch_Norm(Op):
+    pass
+
+
+class Batch_Matmul(Op):
+    pass
+
+
+class Split(Op):
+    pass
+
+
+class Reshape(Op):
+    pass
+
+
+class Transpose(Op):
+    pass
+
+
+class Reverse(Op):
+    pass
+
+
+BatchNorm = Batch_Norm
+BatchMatmul = Batch_Matmul
+
+_OP_CLASS = {
+    OpType.CONV2D: Conv2D, OpType.POOL2D: Pool2D, OpType.LINEAR: Linear,
+    OpType.EMBEDDING: Embedding, OpType.FLAT: Flat, OpType.CONCAT: Concat,
+    OpType.SOFTMAX: Softmax, OpType.EXP: Exp, OpType.ADD: Add,
+    OpType.SUBTRACT: Subtract, OpType.MULTIPLY: Multiply,
+    OpType.DIVIDE: Divide, OpType.MSELOSS: MSELoss, OpType.RELU: Relu,
+    OpType.SIGMOID: Sigmoid, OpType.TANH: Tanh, OpType.ELU: Elu,
+    OpType.DROPOUT: Dropout, OpType.BATCH_NORM: Batch_Norm,
+    OpType.BATCH_MATMUL: Batch_Matmul, OpType.SPLIT: Split,
+    OpType.RESHAPE: Reshape, OpType.TRANSPOSE: Transpose,
+    OpType.REVERSE: Reverse,
+}
+
+
+def convert_op_handle_to_op(op_type: OpType, handle, idx=None, name=None):
+    """reference flexflow_cbinding.py:289-340 — wrap a layer handle in its
+    typed Op subclass.  ``handle`` here is the (ffmodel, core_op) pair the
+    functional binding uses instead of an opaque C pointer."""
+    ffmodel, core_op = handle
+    cls = _OP_CLASS.get(op_type, Op)
+    return cls(ffmodel, core_op, op_type, idx, name)
+
+
+_CORE_OP_TYPE = {
+    "Dense": OpType.LINEAR, "Conv2D": OpType.CONV2D,
+    "Pool2D": OpType.POOL2D, "BatchNorm": OpType.BATCH_NORM,
+    "Embedding": OpType.EMBEDDING, "StackedEmbedding": OpType.EMBEDDING,
+    "Concat": OpType.CONCAT, "Split": OpType.SPLIT,
+    "Reshape": OpType.RESHAPE, "Transpose": OpType.TRANSPOSE,
+    "Reverse": OpType.REVERSE, "Flat": OpType.FLAT,
+    "BatchMatmul": OpType.BATCH_MATMUL, "Softmax": OpType.SOFTMAX,
+    "Dropout": OpType.DROPOUT,
+}
+_UNARY_OP_TYPE = {"exp": OpType.EXP, "relu": OpType.RELU,
+                  "sigmoid": OpType.SIGMOID, "tanh": OpType.TANH,
+                  "elu": OpType.ELU}
+_BINARY_OP_TYPE = {"add": OpType.ADD, "subtract": OpType.SUBTRACT,
+                   "multiply": OpType.MULTIPLY, "divide": OpType.DIVIDE}
+
+
+def op_type_of_core_op(core_op) -> OpType:
+    """Map a core graph op to the compat OpType enum (ElementUnary/Binary
+    resolve through their ``fn`` kind)."""
+    kind = getattr(core_op, "op_type", "op")
+    if kind == "ElementUnary":
+        return _UNARY_OP_TYPE.get(core_op.fn, OpType.OUTPUT)
+    if kind == "ElementBinary":
+        return _BINARY_OP_TYPE.get(core_op.fn, OpType.OUTPUT)
+    return _CORE_OP_TYPE.get(kind, OpType.OUTPUT)
+
+
+def track_core_layers(ffmodel: "FFModel", nb_before: int):
+    """Wrap core layers created outside the factory methods (torch/onnx
+    importers) in typed Op handles, like ``_track`` does for factories."""
+    for core_op in ffmodel._core.layers[nb_before:]:
+        ffmodel._layers[ffmodel._nb_layers] = convert_op_handle_to_op(
+            op_type_of_core_op(core_op), (ffmodel, core_op),
+            ffmodel._nb_layers, core_op.name)
+        ffmodel._nb_layers += 1
+
 
 # ------------------------------------------------------------------- FFModel
 class FFModel:
@@ -320,8 +499,8 @@ class FFModel:
 
     def _track(self, out, op_type: OpType, name: Optional[str]):
         core_op = self._core.layers[-1]
-        self._layers[self._nb_layers] = Op(self, core_op, op_type,
-                                           self._nb_layers, name)
+        self._layers[self._nb_layers] = convert_op_handle_to_op(
+            op_type, (self, core_op), self._nb_layers, name)
         self._nb_layers += 1
         if isinstance(out, (list, tuple)):
             return [Tensor(t, self, self._layers[self._nb_layers - 1])
@@ -690,6 +869,15 @@ class FFModel:
             if op.name == layer_name or op._core_op.name == layer_name:
                 return op
         raise KeyError(f"no layer named {layer_name}")
+
+    def add_layer(self, op_type: OpType, name=None):
+        """reference flexflow_cbinding.py:579-583 — wrap the next untracked
+        core layer in its typed Op handle (used by frontends that build
+        layers through the core graph rather than the factory methods)."""
+        core_op = self._core.layers[self._nb_layers]
+        self._layers[self._nb_layers] = convert_op_handle_to_op(
+            op_type, (self, core_op), self._nb_layers, name)
+        self._nb_layers += 1
 
     def get_tensor_by_id(self, id) -> Parameter:
         """reference flexflow_model_get_parameter_by_id: flat index over all

@@ -1,5 +1,18 @@
-"""reference python/flexflow/keras/datasets/ — mnist / cifar10 / reuters."""
+"""reference python/flexflow/keras/datasets/ — mnist / cifar10 / reuters.
+
+The impl modules are shared objects (real ModuleType instances defined in
+``dlrm_flexflow_tpu.frontends.keras_datasets``), registered here under the
+flexflow names so both reference idioms work and both paths alias one
+namespace: ``from flexflow.keras.datasets import mnist`` and
+``import flexflow.keras.datasets.mnist``.
+"""
+
+import sys as _sys
 
 from dlrm_flexflow_tpu.frontends.keras_datasets import cifar10, mnist, reuters
+
+for _name, _mod in (("mnist", mnist), ("cifar10", cifar10),
+                    ("reuters", reuters)):
+    _sys.modules[f"{__name__}.{_name}"] = _mod
 
 __all__ = ["mnist", "cifar10", "reuters"]

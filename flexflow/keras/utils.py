@@ -1,15 +1,32 @@
 """reference python/flexflow/keras/utils/ (np_utils.py to_categorical /
-normalize, data_utils Sequence, pad_sequences)."""
+normalize, generic_utils.py Progbar, data_utils.py get_file/validate_file/
+Sequence, pad_sequences).
 
+Both import styles work: ``from flexflow.keras.utils import to_categorical``
+and ``from flexflow.keras.utils.np_utils import to_categorical``.
+"""
+
+import sys as _sys
 import types as _types
 
-from dlrm_flexflow_tpu.frontends.keras_utils import (Sequence, normalize,
+from dlrm_flexflow_tpu.frontends.keras_utils import (Progbar, Sequence,
+                                                     get_file, normalize,
                                                      pad_sequences,
-                                                     to_categorical)
+                                                     to_categorical,
+                                                     validate_file)
 
-np_utils = _types.SimpleNamespace(to_categorical=to_categorical,
-                                  normalize=normalize)
-data_utils = _types.SimpleNamespace(Sequence=Sequence)
+np_utils = _types.ModuleType(__name__ + ".np_utils")
+np_utils.to_categorical = to_categorical
+np_utils.normalize = normalize
+data_utils = _types.ModuleType(__name__ + ".data_utils")
+data_utils.Sequence = Sequence
+data_utils.get_file = get_file
+data_utils.validate_file = validate_file
+generic_utils = _types.ModuleType(__name__ + ".generic_utils")
+generic_utils.Progbar = Progbar
+for _m in (np_utils, data_utils, generic_utils):
+    _sys.modules[_m.__name__] = _m
 
 __all__ = ["to_categorical", "normalize", "pad_sequences", "Sequence",
-           "np_utils", "data_utils"]
+           "Progbar", "get_file", "validate_file", "np_utils", "data_utils",
+           "generic_utils"]

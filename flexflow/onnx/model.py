@@ -11,18 +11,15 @@ class ONNXModel:
         self._om = _CoreOnnx(filename_or_model)
 
     def apply(self, ffmodel, input_dict):
-        from ..core.flexflow_binding import FFModel, Op, OpType, Tensor
+        from ..core.flexflow_binding import (FFModel, Tensor,
+                                             track_core_layers)
 
         assert isinstance(ffmodel, FFModel), \
             "apply expects a flexflow.core FFModel"
         nb_before = len(ffmodel._core.layers)
         bound = {name: t._t for name, t in input_dict.items()}
         outs = self._om.lower_onto(ffmodel._core, bound)
-        for core_op in ffmodel._core.layers[nb_before:]:
-            ffmodel._layers[ffmodel._nb_layers] = Op(
-                ffmodel, core_op, OpType.OUTPUT, ffmodel._nb_layers,
-                core_op.name)
-            ffmodel._nb_layers += 1
+        track_core_layers(ffmodel, nb_before)
         wrapped = [Tensor(t, ffmodel) for t in outs]
         return wrapped[0] if len(wrapped) == 1 else wrapped
 

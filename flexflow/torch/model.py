@@ -20,7 +20,8 @@ class PyTorchModel:
     def apply(self, ffmodel, input_tensors: List):
         """Replay onto the compat ``ffmodel``; ``input_tensors`` bind the
         traced placeholders in order.  Returns compat output tensors."""
-        from ..core.flexflow_binding import FFModel, Op, OpType, Tensor
+        from ..core.flexflow_binding import (FFModel, Tensor,
+                                             track_core_layers)
 
         assert isinstance(ffmodel, FFModel), \
             "apply expects a flexflow.core FFModel"
@@ -30,12 +31,8 @@ class PyTorchModel:
         nb_before = len(ffmodel._core.layers)
         bound = {n: t._t for n, t in zip(names, input_tensors)}
         outs = self._ptm.lower_onto(ffmodel._core, bound)
-        # register the newly created core ops as compat layers
-        for core_op in ffmodel._core.layers[nb_before:]:
-            ffmodel._layers[ffmodel._nb_layers] = Op(
-                ffmodel, core_op, OpType.OUTPUT, ffmodel._nb_layers,
-                core_op.name)
-            ffmodel._nb_layers += 1
+        # register the newly created core ops as typed compat layers
+        track_core_layers(ffmodel, nb_before)
         return [Tensor(t, ffmodel) for t in outs]
 
     def import_weights(self, ffmodel):

@@ -1,0 +1,30 @@
+"""Probe TPU contention: fenced 1024^3 bf16 matmul, ~15us when quiet.
+
+Prints one line: ``probe_us=<N>``.  >1000 means the shared chip is
+contended and absolute timing measurements are meaningless (PERF.md).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dlrm_flexflow_tpu.profiling import device_fence
+
+
+def probe(n=30):
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    device_fence(f(x))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y = f(x)
+        for _ in range(n - 1):
+            y = f(y)
+        device_fence(y)
+        best = min(best, (time.perf_counter() - t0) / n * 1e6)
+    return best
+
+
+if __name__ == "__main__":
+    print(f"probe_us={probe():.1f}")

@@ -292,6 +292,55 @@ class TestKerasScriptParity:
         assert oh.shape == (3, 3)
         assert np_utils.to_categorical is to_categorical
 
+    def test_typed_op_handles(self):
+        # reference flexflow_cbinding.py:85-340 — get_layers() returns typed
+        # Op subclasses; op.init/forward drive per-op stepping scripts
+        import flexflow.core as fc
+        ffconfig = fc.FFConfig()
+        ffconfig.parse_args(["x", "-b", "4"])
+        ffmodel = fc.FFModel(ffconfig)
+        t = ffmodel.create_tensor([4, 8], fc.DataType.DT_FLOAT)
+        d = ffmodel.dense(t, 16, fc.ActiMode.AC_MODE_RELU)
+        ffmodel.dense(d, 1)
+        layers = ffmodel.get_layers()
+        assert isinstance(layers[0], fc.Linear)
+        assert isinstance(layers[1], fc.Linear)
+        ffmodel.optimizer = fc.SGDOptimizer(ffmodel, 0.01)
+        ffmodel.compile(
+            loss_type=fc.LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE,
+            metrics=[fc.MetricsType.METRICS_MEAN_SQUARED_ERROR])
+        t.attach_numpy_array(
+            ffconfig, np.random.randn(4, 8).astype(np.float32))
+        layers[0].init(ffmodel)
+        layers[0].forward(ffmodel)
+        assert layers[0].get_weight_tensor().get_weights(
+            ffmodel).shape == (8, 16)
+        converted = fc.convert_op_handle_to_op(
+            fc.OpType.LINEAR, (ffmodel, layers[0]._core_op), 0, "l0")
+        assert isinstance(converted, fc.Linear)
+
+    def test_submodule_import_styles(self):
+        # reference idioms: `import flexflow.keras.datasets.mnist` and
+        # `from flexflow.keras.utils.np_utils import to_categorical`
+        import importlib
+        for mod in ("flexflow.keras.datasets.mnist",
+                    "flexflow.keras.datasets.cifar10",
+                    "flexflow.keras.datasets.reuters",
+                    "flexflow.keras.utils.np_utils",
+                    "flexflow.keras.utils.data_utils",
+                    "flexflow.keras.utils.generic_utils"):
+            importlib.import_module(mod)
+        from flexflow.keras.utils.generic_utils import Progbar
+        import contextlib
+        import io
+        p = Progbar(4)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            p.update(2, values=[("loss", 0.5)])
+            p.add(2, values=[("loss", 0.3)])
+        out = buf.getvalue()
+        assert "4/4" in out and "loss" in out
+
 
 class TestTorchScriptParity:
     """reference examples/python/pytorch/mnist_mlp.py shape."""
