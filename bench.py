@@ -84,11 +84,34 @@ def _emit(metric, thpt, key, extra=None):
     }))
 
 
+def _probe_us():
+    """Fenced 1024^3 bf16 matmul time in us — ~15us on a quiet v5e chip;
+    >~200us means a noisy neighbor is degrading the shared chip and any
+    absolute number measured in that window understates the framework.
+    One shared implementation (scripts/probe_chip.py) so bench history
+    and standalone probes report the same statistic."""
+    from scripts.probe_chip import probe
+
+    return probe()
+
+
+# a window measured while the probe is at most this slow counts as clean
+_QUIET_US = float(os.environ.get("BENCH_QUIET_US", 200.0))
+
+
 def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
              place=True):
-    """Fenced best-of-reps timing over scanned epochs (the one shared
-    timing protocol: warmup/compile epoch, then ``reps`` windows of
-    ``epochs`` chained epochs each closed by a real device fence)."""
+    """Fenced best-window timing over scanned epochs.
+
+    The shared timing protocol: warmup/compile epoch, then windows of
+    ``epochs`` chained epochs, each closed by a real device fence
+    (PERF.md: block_until_ready returns early on this platform).  The chip
+    is shared and contention windows degrade it 100-1000x, so each timing
+    window is bracketed by ``_probe_us`` probes; after the ``reps``
+    mandatory windows, if none was measured on a quiet chip, keep sampling
+    (with pauses) until one is or BENCH_TIME_BUDGET seconds (default 600)
+    elapse.  Returns (samples_per_sec, probe_us_of_best_window).
+    """
     from dlrm_flexflow_tpu.profiling import device_fence
 
     if place:
@@ -99,14 +122,37 @@ def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
         inputs, labels = model.place_dataset(inputs, labels)
     state, _ = model.train_epoch(state, inputs, labels)
     device_fence(state.step)
-    times = []
-    for _ in range(reps):
+
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 600.0))
+    deadline = time.monotonic() + budget
+    best_any = (float("inf"), float("inf"))    # (dt, probe)
+    best_quiet = None                          # best among CLEAN windows
+    n_windows = 0
+    while True:
+        pre = _probe_us()
         t0 = time.perf_counter()
         for _ in range(epochs):
             state, _ = model.train_epoch(state, inputs, labels)
         device_fence(state.step)
-        times.append(time.perf_counter() - t0)
-    return epochs * num_batches * batch / float(min(times))
+        dt = time.perf_counter() - t0
+        post = _probe_us()
+        probe = max(pre, post)  # window is clean only if quiet on both ends
+        n_windows += 1
+        if dt < best_any[0]:
+            best_any = (dt, probe)
+        if probe <= _QUIET_US and (best_quiet is None or dt < best_quiet[0]):
+            best_quiet = (dt, probe)
+        if n_windows >= reps:
+            # one clean window is enough — a clean measurement can only be
+            # beaten by jitter, never by contention
+            if best_quiet is not None or time.monotonic() >= deadline:
+                break
+            # contended so far: wait out the noisy neighbor, then resample
+            time.sleep(min(20.0, max(deadline - time.monotonic(), 0)))
+            if time.monotonic() >= deadline:
+                break
+    best_t, best_probe = best_quiet if best_quiet is not None else best_any
+    return epochs * num_batches * batch / float(best_t), best_probe
 
 
 def main():
@@ -144,9 +190,9 @@ def main():
     labels = rng.integers(0, 2,
                           size=(num_batches, batch, 1)).astype(np.float32)
     reps = int(os.environ.get("BENCH_REPS", 5))
-    thpt = _windows(model, state, inputs, labels, batch, num_batches,
-                    epochs, reps,
-                    place=not os.environ.get("BENCH_HOST_INPUTS"))
+    thpt, probe_us = _windows(model, state, inputs, labels, batch,
+                              num_batches, epochs, reps,
+                              place=not os.environ.get("BENCH_HOST_INPUTS"))
     # vs_baseline: FIRST fenced history entry of the same config is the
     # anchor, so improvements accumulate instead of drifting with the
     # previous run's noise (the reference publishes no numbers,
@@ -155,7 +201,7 @@ def main():
     _emit("dlrm_synthetic_samples_per_sec", thpt,
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
            "epochs": epochs, "rows": rows},
-          extra={"dtype": dtype})
+          extra={"dtype": dtype, "probe_us": round(probe_us, 1)})
 
 
 # --------------------------------------------------------------------------
@@ -273,11 +319,13 @@ def bench_app(app: str):
         raise SystemExit(f"unknown BENCH_APP {app!r}")
 
     state = model.init(seed=0)
-    thpt = _windows(model, state, inputs, labels, batch, nb, epochs, reps)
+    thpt, probe_us = _windows(model, state, inputs, labels, batch, nb,
+                              epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     if app in ("dlrm_kaggle", "dlrm_hybrid"):
         key["rows"] = max(cfg.embedding_size)
-    _emit(f"{app}_samples_per_sec", thpt, key, extra={"dtype": dtype})
+    _emit(f"{app}_samples_per_sec", thpt, key,
+          extra={"dtype": dtype, "probe_us": round(probe_us, 1)})
 
 
 if __name__ == "__main__":

@@ -1,3 +1,5 @@
-from .loader import ArrayDataLoader, SyntheticDLRMLoader, load_criteo_h5
+from .loader import (ArrayDataLoader, SyntheticDLRMLoader, load_criteo_h5,
+                     preprocess_criteo_npz)
 
-__all__ = ["ArrayDataLoader", "SyntheticDLRMLoader", "load_criteo_h5"]
+__all__ = ["ArrayDataLoader", "SyntheticDLRMLoader", "load_criteo_h5",
+           "preprocess_criteo_npz"]

@@ -115,3 +115,35 @@ def load_criteo_h5(path: str, stacked: bool = False):
         for i in range(x_cat.shape[1]):
             inputs[f"sparse_{i}"] = x_cat[:, i:i + 1]
     return inputs, y
+
+
+def preprocess_criteo_npz(input_path: str, output_path: str):
+    """Criteo .npz -> training HDF5 (reference
+    examples/cpp/DLRM/preprocess_hdf.py): ``X_cat`` cast to int64,
+    ``X_int`` -> log(x + 1) float32, ``y`` float32."""
+    import h5py  # gated: optional dependency
+
+    data = np.load(input_path)
+    with h5py.File(output_path, "w") as hdf:
+        hdf.create_dataset("X_cat", data=data["X_cat"].astype(np.int64))
+        hdf.create_dataset(
+            "X_int", data=np.log(data["X_int"].astype(np.float32) + 1))
+        hdf.create_dataset("y", data=data["y"].astype(np.float32))
+    return output_path
+
+
+def _preprocess_main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Criteo npz -> HDF5 (reference preprocess_hdf.py)")
+    p.add_argument("-i", "--input", required=True,
+                   help="Path to input numpy file")
+    p.add_argument("-o", "--output", required=True,
+                   help="Path to output HDF file")
+    args = p.parse_args(argv)
+    preprocess_criteo_npz(args.input, args.output)
+
+
+if __name__ == "__main__":  # python -m dlrm_flexflow_tpu.data.loader -i .. -o ..
+    _preprocess_main()

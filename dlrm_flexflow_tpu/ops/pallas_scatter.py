@@ -31,7 +31,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_BLOCK = 16  # update slots per grid step (unrolled in-kernel)
+_BLOCK = int(__import__("os").environ.get("FF_SCATTER_BLOCK", 16))
+# ^ update slots per grid step (unrolled in-kernel); env-overridable for
+#   block-size sweeps on real hardware (scripts/ab_scatter.py)
+_PIPELINE = __import__("os").environ.get("FF_SCATTER_PIPELINE", "0") == "1"
+# ^ opt-in software-pipelined kernel (_row_update_kernel_v2)
 
 
 def _row_update_kernel(ids_ref, table_hbm, upd_ref, out_hbm,
@@ -101,7 +105,111 @@ def _row_update_kernel(ids_ref, table_hbm, upd_ref, out_hbm,
             wb(k).wait()
 
 
-def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False):
+def _row_update_kernel_v2(ids_ref, table_hbm, upd_ref, out_hbm,
+                          scratch, acc_ref, carry_ref, sems, out_sems,
+                          *, block: int, nblocks: int):
+    """Software-pipelined variant: row fetches for block b+1 and row
+    writebacks of block b both overlap block b+1's compute.
+
+    Why cross-step overlap cannot race: ids are sorted, so a row id
+    appearing in two different blocks fills every slot between them —
+    its run crosses the intermediate block boundaries and is CARRIED, not
+    written back, until the run's final block.  Hence a row fetched in
+    step b never has an outstanding writeback from any earlier step, and
+    a writeback started in step b targets a row no later step fetches.
+    Buffers and semaphores are double-buffered by grid-step parity; the
+    only waits on the critical path are this step's own fetches (started
+    one step ahead) and the buffer-reuse wait for writebacks started two
+    steps ago."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    p = blk % 2
+    q = 1 - p
+    base = blk * block
+
+    def fetch(b, k, buf):
+        return pltpu.make_async_copy(
+            out_hbm.at[pl.ds(ids_ref[b * block + k], 1)],
+            scratch.at[buf, pl.ds(k, 1)], sems.at[buf, k])
+
+    def wb(b, k, buf):
+        return pltpu.make_async_copy(
+            acc_ref.at[buf, pl.ds(k, 1)],
+            out_hbm.at[pl.ds(ids_ref[b * block + k], 1)],
+            out_sems.at[buf, k])
+
+    # prologue: nothing prefetched our first block
+    @pl.when(blk == 0)
+    def _():
+        for k in range(block):
+            fetch(0, k, 0).start()
+
+    for k in range(block):
+        fetch(blk, k, p).wait()
+
+    # prefetch the next block into the other buffer
+    @pl.when(blk + 1 < nblocks)
+    def _():
+        for k in range(block):
+            fetch(blk + 1, k, q).start()
+
+    # before overwriting acc[p], drain writebacks issued from it 2 steps ago
+    @pl.when(blk >= 2)
+    def _():
+        for k in range(block):
+            g = (blk - 2) * block + k
+
+            @pl.when(ids_ref[g] != ids_ref[g + 1])
+            def _():
+                wb(blk - 2, k, p).wait()
+
+    for k in range(block):
+        g = base + k
+        u = upd_ref[k, :]
+        if k == 0:
+            prev = carry_ref[0, :]
+            prev_id = ids_ref[jnp.maximum(base - 1, 0)]
+            same = (blk > 0) & (ids_ref[base] == prev_id)
+        else:
+            prev = acc_ref[p, k - 1, :]
+            same = ids_ref[g] == ids_ref[g - 1]
+        fetched = scratch[p, k, :]
+        acc_ref[p, k, :] = jnp.where(same, prev, fetched) + u
+
+    carry_ref[0, :] = acc_ref[p, block - 1, :]
+
+    for k in range(block):
+        g = base + k
+
+        @pl.when(ids_ref[g] != ids_ref[g + 1])
+        def _():
+            wb(blk, k, p).start()
+
+    # epilogue: drain everything still in flight (parity q from blk-1 has
+    # not been waited; parity p from blk was just started)
+    @pl.when(blk == nblocks - 1)
+    def _():
+        @pl.when(blk >= 1)
+        def _():
+            for k in range(block):
+                g = (blk - 1) * block + k
+
+                @pl.when(ids_ref[g] != ids_ref[g + 1])
+                def _():
+                    wb(blk - 1, k, q).wait()
+
+        for k in range(block):
+            g = blk * block + k
+
+            @pl.when(ids_ref[g] != ids_ref[g + 1])
+            def _():
+                wb(blk, k, p).wait()
+
+
+def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False,
+                       pipeline=None):
     """table (R, d) f32; ids_sorted (n,) int32 ascending (padded tail
     repeats the last id with zero updates); upd_sorted (n, d).  Returns
     the updated table, aliased in place."""
@@ -114,22 +222,37 @@ def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False):
     ids_padded = jnp.concatenate(
         [ids_sorted, jnp.full((1,), -1, jnp.int32)])
 
-    kern = functools.partial(_row_update_kernel, block=_BLOCK)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # ids
-        grid=(n // _BLOCK,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
-            pl.BlockSpec((_BLOCK, d), lambda b, ids: (b, 0)),  # updates
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased table
-        scratch_shapes=[
+    nblocks = n // _BLOCK
+    if pipeline is None:
+        pipeline = _PIPELINE
+    if pipeline:
+        kern = functools.partial(_row_update_kernel_v2, block=_BLOCK,
+                                 nblocks=nblocks)
+        scratch_shapes = [
+            pltpu.VMEM((2, _BLOCK, d), table.dtype),  # fetched rows (x2)
+            pltpu.VMEM((2, _BLOCK, d), table.dtype),  # accumulated (x2)
+            pltpu.VMEM((1, d), table.dtype),          # cross-block carry
+            pltpu.SemaphoreType.DMA((2, _BLOCK)),
+            pltpu.SemaphoreType.DMA((2, _BLOCK)),
+        ]
+    else:
+        kern = functools.partial(_row_update_kernel, block=_BLOCK)
+        scratch_shapes = [
             pltpu.VMEM((_BLOCK, d), table.dtype),   # fetched rows
             pltpu.VMEM((_BLOCK, d), table.dtype),   # accumulated rows
             pltpu.VMEM((1, d), table.dtype),        # cross-block carry
             pltpu.SemaphoreType.DMA((_BLOCK,)),
             pltpu.SemaphoreType.DMA((_BLOCK,)),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # ids
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
+            pl.BlockSpec((_BLOCK, d), lambda b, ids: (b, 0)),  # updates
         ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased table
+        scratch_shapes=scratch_shapes,
     )
     return pl.pallas_call(
         kern,
@@ -154,7 +277,7 @@ def supports_pallas_row_update(num_rows: int, dim: int, n: int) -> bool:
 
 
 def sparse_row_update(table, ids, updates, scale, *, interpret=False,
-                      force=False, allow_kernel=True):
+                      force=False, allow_kernel=True, pipeline=None):
     """``table[ids] += scale * updates`` with duplicate accumulation.
 
     table (R, d); ids (...,) int; updates (..., d).  Uses the pallas
@@ -188,8 +311,8 @@ def sparse_row_update(table, ids, updates, scale, *, interpret=False,
         view = table.reshape(r // pack, d * pack)
         order = jnp.argsort(q)
         out = _row_update_pallas(view, q[order], upd_flat[order],
-                                 interpret=interpret)
+                                 interpret=interpret, pipeline=pipeline)
         return out.reshape(r, d)
     order = jnp.argsort(ids_flat)
     return _row_update_pallas(table, ids_flat[order], upd_flat[order],
-                              interpret=interpret)
+                              interpret=interpret, pipeline=pipeline)

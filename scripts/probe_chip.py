@@ -3,7 +3,11 @@
 Prints one line: ``probe_us=<N>``.  >1000 means the shared chip is
 contended and absolute timing measurements are meaningless (PERF.md).
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

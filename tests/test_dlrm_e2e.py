@@ -261,3 +261,33 @@ class TestFitScanFastPath:
                 np.testing.assert_allclose(
                     np.asarray(st1.params[opn][k]),
                     np.asarray(st2.params[opn][k]), rtol=1e-6, atol=1e-6)
+
+
+class TestCriteoDataPipeline:
+    """reference examples/cpp/DLRM/preprocess_hdf.py (npz -> HDF5 with
+    log1p dense transform) + dlrm.cc:266-382 HDF5 read."""
+
+    def test_preprocess_and_load_roundtrip(self, tmp_path):
+        from dlrm_flexflow_tpu.data import load_criteo_h5, preprocess_criteo_npz
+
+        rng = np.random.default_rng(0)
+        n, num_dense, num_tables = 64, 13, 26
+        x_int = rng.integers(0, 1000, size=(n, num_dense)).astype(np.int64)
+        x_cat = rng.integers(0, 100, size=(n, num_tables)).astype(np.int32)
+        y = rng.integers(0, 2, size=(n,))
+        npz = tmp_path / "day.npz"
+        np.savez(npz, X_int=x_int, X_cat=x_cat, y=y)
+
+        h5 = preprocess_criteo_npz(str(npz), str(tmp_path / "day.h5"))
+        inputs, labels = load_criteo_h5(h5)
+        # dense went through log(x + 1), labels are (N, 1) float32
+        np.testing.assert_allclose(
+            inputs["dense"], np.log(x_int.astype(np.float32) + 1), rtol=1e-6)
+        assert labels.shape == (n, 1) and labels.dtype == np.float32
+        # per-table single-hot columns, int64 (reference X_cat astype long)
+        assert inputs["sparse_0"].shape == (n, 1)
+        assert inputs["sparse_0"].dtype == np.int64
+        assert len([k for k in inputs if k.startswith("sparse_")]) == num_tables
+
+        stacked, _ = load_criteo_h5(h5, stacked=True)
+        assert stacked["sparse"].shape == (n, num_tables, 1)

@@ -64,9 +64,10 @@ class TestSparseRowUpdatePallas:
     interpret mode, including duplicate runs, cross-block runs and the
     d<128 packed-row variant."""
 
+    @pytest.mark.parametrize("pipeline", [False, True])
     @pytest.mark.parametrize("shape", [(64, 128, 32), (128, 64, 64),
                                        (64, 32, 32), (256, 8, 64)])
-    def test_matches_scatter_add(self, rng, shape):
+    def test_matches_scatter_add(self, rng, shape, pipeline):
         import jax.numpy as jnp
         from dlrm_flexflow_tpu.ops.pallas_scatter import sparse_row_update
         R, d, n = shape
@@ -75,10 +76,12 @@ class TestSparseRowUpdatePallas:
         upd = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
         ref = np.asarray(table.at[ids].add(-0.1 * upd))
         got = np.asarray(sparse_row_update(table, ids, upd, -0.1,
-                                           interpret=True))
+                                           interpret=True,
+                                           pipeline=pipeline))
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
-    def test_heavy_duplicates_cross_blocks(self, rng):
+    @pytest.mark.parametrize("pipeline", [False, True])
+    def test_heavy_duplicates_cross_blocks(self, rng, pipeline):
         import jax.numpy as jnp
         from dlrm_flexflow_tpu.ops.pallas_scatter import sparse_row_update
         R, d, n = 64, 128, 64
@@ -88,10 +91,12 @@ class TestSparseRowUpdatePallas:
         upd = jnp.ones((n, d), jnp.float32)
         ref = np.asarray(table.at[ids].add(upd))
         got = np.asarray(sparse_row_update(table, ids, upd, 1.0,
-                                           interpret=True))
+                                           interpret=True,
+                                           pipeline=pipeline))
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
-    def test_packed_neighbor_conflicts(self, rng):
+    @pytest.mark.parametrize("pipeline", [False, True])
+    def test_packed_neighbor_conflicts(self, rng, pipeline):
         """d=32 -> pack=4: updates to rows sharing a 128-lane view row
         must serialize through the run chain, not race."""
         import jax.numpy as jnp
@@ -102,7 +107,8 @@ class TestSparseRowUpdatePallas:
         upd = jnp.ones((n, d), jnp.float32)
         ref = np.asarray(table.at[ids].add(upd))
         got = np.asarray(sparse_row_update(table, ids, upd, 1.0,
-                                           interpret=True))
+                                           interpret=True,
+                                           pipeline=pipeline))
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
     def test_eligibility(self):
