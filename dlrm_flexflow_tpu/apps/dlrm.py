@@ -163,7 +163,6 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
 
 def run(argv: Sequence[str] = ()):  # pragma: no cover - CLI
     """CLI mirroring the reference app (MSE loss + accuracy, dlrm.cc:150)."""
-    import numpy as np
     from ..data.loader import SyntheticDLRMLoader, load_criteo_h5, ArrayDataLoader
 
     ffconfig = FFConfig.parse_args(argv)

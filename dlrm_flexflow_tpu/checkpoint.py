@@ -15,7 +15,6 @@ import os
 from typing import Optional
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from .model import TrainState

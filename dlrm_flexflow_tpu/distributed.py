@@ -17,7 +17,7 @@ analogue of DataParallelShardingFunctor's last-dim sharding).
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+from typing import Optional
 
 import numpy as np
 import jax

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import Optional, Sequence as _Seq
+from typing import Optional
 
 import numpy as np
 

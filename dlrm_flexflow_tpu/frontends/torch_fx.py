@@ -12,7 +12,7 @@ converted models agree numerically with the source module.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import numpy as np
 

@@ -54,7 +54,6 @@ def compute_metrics(preds, labels, metrics: Sequence[str],
             eps = 1e-12
             out["cce"] = jnp.sum(-labels * jnp.log(preds + eps))
         elif m in ("sparse_categorical_crossentropy", "sparse_cce"):
-            import jax
             lab = labels
             if lab.ndim == preds.ndim:
                 lab = jnp.squeeze(lab, axis=-1)

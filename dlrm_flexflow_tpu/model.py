@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +47,7 @@ from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
                   Split, StackedEmbedding, Transpose)
 from .parallel.mesh import (DATA_AXIS, constrain, make_mesh, param_pspec,
                             pspec_for_config, sharding)
-from .parallel.parallel_config import ParallelConfig, Strategy
+from .parallel.parallel_config import Strategy
 from .tensor import Tensor, as_dtype
 
 

@@ -17,7 +17,7 @@ activation in ParallelConfig translation.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ is kept out of HBM entirely — the point of the hetero strategy.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -29,7 +29,6 @@ must fall back to XLA otherwise (dim=64 hits a Mosaic lowering bug).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

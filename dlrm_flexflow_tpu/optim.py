@@ -22,7 +22,7 @@ per-element math, exactly mirroring the kernel bodies:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ the standard TPU pipeline regime (transformer blocks, stacked MLP layers).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax

@@ -19,7 +19,7 @@ sample dim LAST; ours are batch-first (ParallelConfig.from_reference_dims).
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, Tuple
+from typing import List, Tuple
 
 from .parallel_config import ParallelConfig, Strategy
 

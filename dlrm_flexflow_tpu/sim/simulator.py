@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..parallel.parallel_config import ParallelConfig, Strategy
-from .cost_model import CostModel, TPUMachineModel
+from .cost_model import CostModel
 
 
 @dataclass
