@@ -171,7 +171,12 @@ def main():
 
     cfg = DLRMConfig()  # run_random.sh architecture
     cfg.embedding_size = [rows] * 8
-    ffconfig = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
+    # bf16 table storage halves the full-table sweep that dominates the
+    # step (PERF.md); like compute_dtype, credited as a framework
+    # optimization (BENCH_EMB_DTYPE=float32 for fp32 tables)
+    emb_dtype = os.environ.get("BENCH_EMB_DTYPE", "bfloat16")
+    ffconfig = ff.FFConfig(batch_size=batch, compute_dtype=dtype,
+                           embedding_dtype=emb_dtype)
     model = build_dlrm(cfg, ffconfig)
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                   loss_type="mean_squared_error",
@@ -201,7 +206,8 @@ def main():
     _emit("dlrm_synthetic_samples_per_sec", thpt,
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
            "epochs": epochs, "rows": rows},
-          extra={"dtype": dtype, "probe_us": round(probe_us, 1)})
+          extra={"dtype": dtype, "emb_dtype": emb_dtype,
+                 "probe_us": round(probe_us, 1)})
 
 
 # --------------------------------------------------------------------------

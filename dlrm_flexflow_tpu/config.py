@@ -48,6 +48,12 @@ class FFConfig:
     simulator_work_space_size: int = 2 * 1024 * 1024 * 1024
     # Numerics
     compute_dtype: str = "float32"  # per-op matmuls may run bf16 on TPU
+    # Embedding-table storage dtype.  Big-table gather/scatter lowers to
+    # a full-table sweep on TPU backends, so "bfloat16" halves the
+    # dominant per-step cost of embedding-heavy models (measured 1.8x on
+    # DLRM run_random.sh, PERF.md).  Default float32 matches the
+    # reference's fp32 tables bit-for-bit.
+    embedding_dtype: str = "float32"
     # Row-sparse embedding updates under plain SGD ("auto"|"on"|"off").
     # "auto" enables them on cpu/gpu (scatter aliases in place) and on
     # single-device tpu where the in-place pallas row-update kernel
@@ -99,6 +105,10 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--seed":
                 cfg.seed = int(nxt())
+            elif a == "--compute-dtype":
+                cfg.compute_dtype = nxt()
+            elif a == "--embedding-dtype":
+                cfg.embedding_dtype = nxt()
             elif a in ("-d", "--devices", "-ll:gpu"):
                 # reference -ll:gpu N => N workers; here: device count
                 cfg.num_devices = int(nxt())

@@ -127,18 +127,25 @@ class FFModel:
                     compute_dtype or self._op_compute_dtype())
         return self._add(op)
 
+    def _table_dtype(self, table_dtype):
+        if table_dtype is not None:
+            return table_dtype
+        return jnp.dtype(getattr(self.config, "embedding_dtype", "float32"))
+
     def embedding(self, input_tensor, num_entries, out_dim, aggr="sum",
-                  kernel_initializer=None, name=None):
+                  kernel_initializer=None, name=None, table_dtype=None):
         op = Embedding(self._name("embedding", name), input_tensor,
-                       num_entries, out_dim, aggr, kernel_initializer)
+                       num_entries, out_dim, aggr, kernel_initializer,
+                       table_dtype=self._table_dtype(table_dtype))
         return self._add(op)
 
     def stacked_embedding(self, input_tensor, num_tables, num_entries,
                           out_dim, aggr="sum", kernel_initializer=None,
-                          name=None):
+                          name=None, table_dtype=None):
         op = StackedEmbedding(self._name("stacked_embedding", name),
                               input_tensor, num_tables, num_entries, out_dim,
-                              aggr, kernel_initializer)
+                              aggr, kernel_initializer,
+                              table_dtype=self._table_dtype(table_dtype))
         return self._add(op)
 
     def conv2d(self, input_tensor, out_channels, kernel_h, kernel_w,
@@ -470,14 +477,18 @@ class FFModel:
                               "auto")
         backend = jax.default_backend()
         if sparse_mode == "auto":
-            # the win depends on updating the table in place.  cpu/gpu
-            # scatter aliases cleanly.  XLA:TPU's scatter emitter forces
-            # its own operand layout and wraps the update in FULL-TABLE
-            # layout copies (measured ~4x slower than dense autodiff on a
-            # v5e), so on tpu the path is taken only where the in-place
-            # pallas row-update kernel applies: single-device (SPMD cannot
-            # partition a pallas_call) and kernel-compatible shapes,
-            # checked per op below.
+            # the win depends on updating the table in place with NO
+            # full-table layout copies in the loop.  cpu/gpu scatter
+            # aliases cleanly.  On tpu, gather and scatter of a (R, d<128)
+            # table pick CONFLICTING layouts and XLA materializes
+            # full-table copies every step; the fast path routes both
+            # through the lane-packed (R/pack, 128) view instead
+            # (pallas_scatter.packed_gather/packed_scatter_add — measured
+            # 14x faster than the in-place pallas row-update kernel, which
+            # FF_SCATTER_IMPL=kernel still selects).  Single-device only:
+            # under a mesh the packed view fights the sharded layout (and
+            # SPMD cannot partition a pallas_call); eligibility per op
+            # checked below (sparse_update_ok).
             sparse_ok = (backend in ("cpu", "gpu")
                          or (backend == "tpu" and self.mesh is None))
         elif sparse_mode in ("on", "off"):
@@ -496,7 +507,7 @@ class FFModel:
                         and not getattr(op, "use_pallas", False)
                         and op.inputs[0].uid in input_name_of
                         and not (sparse_mode == "auto" and backend == "tpu"
-                                 and not op.pallas_update_ok())):
+                                 and not op.sparse_update_ok())):
                     sparse_emb.append(op)
         self._sparse_emb_ops = [op.name for op in sparse_emb]
         emb_names = {op.name for op in sparse_emb}

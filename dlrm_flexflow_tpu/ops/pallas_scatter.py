@@ -36,6 +36,16 @@ _BLOCK = int(__import__("os").environ.get("FF_SCATTER_BLOCK", 16))
 #   block-size sweeps on real hardware (scripts/ab_scatter.py)
 _PIPELINE = __import__("os").environ.get("FF_SCATTER_PIPELINE", "0") == "1"
 # ^ opt-in software-pipelined kernel (_row_update_kernel_v2)
+_IMPL = __import__("os").environ.get("FF_SCATTER_IMPL", "auto")
+# ^ TPU sparse-update implementation (A/B on real hardware):
+#   "auto"   — lane-packed XLA scatter-add on the (R/pack, 128) view
+#              (default: measured 14x faster than the pallas kernel on the
+#              bench slice — the packed view aligns the gather's and the
+#              scatter's preferred table layouts, see PERF.md)
+#   "kernel" — the in-place pallas row-update kernel
+#   "xla"    — direct table.at[ids].add on the logical (R, d) shape
+#              (slow when a gather of the same table sits in the program:
+#              the layout conflict materializes full-table copies)
 
 
 def _row_update_kernel(ids_ref, table_hbm, upd_ref, out_hbm,
@@ -263,6 +273,77 @@ def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False,
     )(ids_padded, table, upd_sorted)
 
 
+def pack_factor(num_rows: int, dim: int) -> int:
+    """Rows per 128-lane view row for the lane-packed table view, or 0
+    when the (num_rows, dim) table cannot be viewed as (R/pack, 128*k)
+    with a free row-major bitcast."""
+    if dim >= 128:
+        return 1 if dim % 128 == 0 else 0
+    if 128 % dim != 0:
+        return 0
+    pack = 128 // dim
+    return pack if num_rows % pack == 0 else 0
+
+
+def packed_gather(table, ids):
+    """``table[ids]`` read through the lane-packed (R/pack, 128) view.
+
+    Numerically identical to ``jnp.take(table, ids, axis=0)`` (pure data
+    movement), but keeps the table in the SAME layout the packed scatter
+    update uses — gathering the logical (R, d<128) shape instead makes
+    XLA pick conflicting layouts for gather vs scatter and materialize
+    full-table copies every step (PERF.md).  ``ids`` may have any shape;
+    returns ``ids.shape + (d,)`` rows."""
+    r, d = table.shape
+    pack = pack_factor(r, d)
+    if pack <= 1:
+        return jnp.take(table, ids, axis=0)
+    q = ids // pack
+    h = ids % pack
+    view = table.reshape(r // pack, d * pack)
+    vrows = jnp.take(view, q, axis=0)          # ids.shape + (pack*d,)
+    vrows = vrows.reshape(ids.shape + (pack, d))
+    return jnp.take_along_axis(
+        vrows, h[..., None, None].astype(jnp.int32), axis=-2).squeeze(-2)
+
+
+def use_packed_view(mesh) -> bool:
+    """THE predicate for the lane-packed table view: gather_rows and the
+    scatter update must answer identically or XLA picks conflicting
+    table layouts and re-materializes full-table copies every step.
+    Single-device TPU only (under a mesh the packed view fights the
+    sharded layout), and only for the default packed-XLA impl."""
+    return (mesh is None and _IMPL == "auto"
+            and jax.default_backend() == "tpu")
+
+
+def _lane_pack(table, ids_flat, upd_flat, pack):
+    """Shared lane-pack expansion: (view, view_ids, packed_updates) where
+    each (d,) update occupies its slot of the 128-lane view row (other
+    slots exact 0.0).  Used by both the packed-XLA and the kernel path —
+    they must stay numerically identical."""
+    r, d = table.shape
+    n = ids_flat.shape[0]
+    q = ids_flat // pack
+    h = ids_flat % pack
+    lanes = jax.nn.one_hot(h, pack, dtype=table.dtype)      # (n, pack)
+    packed = (lanes[:, :, None] * upd_flat[:, None, :]).reshape(
+        n, d * pack)
+    return table.reshape(r // pack, d * pack), q, packed
+
+
+def packed_scatter_add(table, ids_flat, upd_flat):
+    """``table.at[ids].add(upd)`` through the lane-packed view: each
+    (d,) update lands in its slot of the 128-lane view row via a one-hot
+    expansion (the other slots add exact 0.0).  Duplicates accumulate."""
+    r, d = table.shape
+    pack = pack_factor(r, d)
+    if pack <= 1:
+        return table.at[ids_flat].add(upd_flat)
+    view, q, packed = _lane_pack(table, ids_flat, upd_flat, pack)
+    return view.at[q].add(packed).reshape(r, d)
+
+
 def supports_pallas_row_update(num_rows: int, dim: int, n: int) -> bool:
     """Static eligibility of the kernel for a (num_rows, dim) table with
     ``n`` updates per step (Mosaic needs 128-lane rows; narrower dims are
@@ -296,21 +377,24 @@ def sparse_row_update(table, ids, updates, scale, *, interpret=False,
     upd_flat = (scale * updates.reshape(-1, d)).astype(table.dtype)
     n = ids_flat.shape[0]
     # allow_kernel=False (e.g. a sharded table under a mesh — SPMD cannot
-    # partition a pallas_call) forces the XLA scatter path
+    # partition a pallas_call; the packed view would also fight the
+    # sharded layout) forces the XLA scatter path
+    on_tpu = jax.default_backend() == "tpu"
     use_kernel = force or interpret or (
-        allow_kernel and jax.default_backend() == "tpu")
+        allow_kernel and _IMPL == "kernel" and on_tpu)
     if not (use_kernel and supports_pallas_row_update(r, d, n)):
+        # allow_kernel is the caller's mesh-is-None bit, so
+        # allow_kernel + use_packed_view(None) == use_packed_view(mesh) —
+        # the same predicate gather_rows uses (layouts must agree)
+        if (allow_kernel and not interpret and use_packed_view(None)
+                and pack_factor(r, d)):
+            return packed_scatter_add(table, ids_flat, upd_flat)
         return table.at[ids_flat].add(upd_flat)
     pack = 1 if d >= 128 else 128 // d
     if pack > 1:
-        q = ids_flat // pack
-        h = ids_flat % pack
-        lanes = jax.nn.one_hot(h, pack, dtype=table.dtype)  # (n, pack)
-        upd_flat = (lanes[:, :, None] * upd_flat[:, None, :]).reshape(
-            n, d * pack)
-        view = table.reshape(r // pack, d * pack)
+        view, q, packed = _lane_pack(table, ids_flat, upd_flat, pack)
         order = jnp.argsort(q)
-        out = _row_update_pallas(view, q[order], upd_flat[order],
+        out = _row_update_pallas(view, q[order], packed[order],
                                  interpret=interpret, pipeline=pipeline)
         return out.reshape(r, d)
     order = jnp.argsort(ids_flat)

@@ -52,7 +52,11 @@ def main():
     for pipe in ("0", "1"):
         for b in blocks:
             env = dict(os.environ, FF_SCATTER_BLOCK=str(b),
-                       FF_SCATTER_PIPELINE=pipe)
+                       FF_SCATTER_PIPELINE=pipe,
+                       # this script A/Bs the pallas kernel's tuning knobs;
+                       # without this the default impl (packed XLA scatter)
+                       # would be timed instead and labeled as kernel data
+                       FF_SCATTER_IMPL="kernel")
             subprocess.run([sys.executable, "-c", _CHILD], env=env,
                            cwd=os.path.dirname(os.path.dirname(
                                os.path.abspath(__file__))))
