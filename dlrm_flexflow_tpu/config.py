@@ -61,6 +61,12 @@ class FFConfig:
     # full-table layout copies, see PERF.md).  "on"/"off" force the
     # choice.
     sparse_embedding_updates: str = "auto"
+    # Epoch row-cache ("auto"|"on"|"off"): train_epoch pulls the epoch's
+    # touched embedding rows into a small cache with one table sweep,
+    # scans against the cache, and writes back once — exact numerics,
+    # per-step table cost becomes O(touched rows) (PERF.md).  "auto"
+    # enables it on TPU; "on" forces it on any backend; "off" disables.
+    epoch_row_cache: str = "auto"
     # fit()'s scanned-epoch fast path stages the whole dataset on device;
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)

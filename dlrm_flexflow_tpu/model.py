@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .config import FFConfig
 from .losses import get_loss
@@ -525,19 +526,37 @@ class FFModel:
             preds = values[final_uid]
             return self._loss_fn(preds, labels), (preds, new_bn)
 
-        def train_step(state: TrainState, inputs, labels):
+        def _cache_gather(cache, slots):
+            from .ops.pallas_scatter import packed_gather, use_packed_view
+            if use_packed_view(self.mesh):
+                return packed_gather(cache, slots)
+            return jnp.take(cache, slots, axis=0)
+
+        def train_step(state: TrainState, inputs, labels, slot_override=None):
+            """One SGD step.  ``slot_override`` (epoch row-cache mode) maps
+            op name -> cache-slot ids for this batch; the op's "embedding"
+            param then holds the small epoch cache instead of the full
+            table, and gather/scatter address it directly by slot."""
             if has_stochastic:
                 rng, next_rng = jax.random.split(state.rng)
             else:
                 rng, next_rng = None, state.rng
             if sparse_emb:
+                from .ops.pallas_scatter import sparse_row_update
                 dense_params = {k: v for k, v in state.params.items()
                                 if k not in emb_names}
                 tables = {op.name: state.params[op.name]["embedding"]
                           for op in sparse_emb}
-                rows_dict = {op.name: op.gather_rows(
-                    tables[op.name], inputs[id_name[op.name]])
-                    for op in sparse_emb}
+                slot_override = slot_override or {}
+                rows_dict = {}
+                for op in sparse_emb:
+                    slots = slot_override.get(op.name)
+                    if slots is None:
+                        rows_dict[op.name] = op.gather_rows(
+                            tables[op.name], inputs[id_name[op.name]])
+                    else:
+                        rows_dict[op.name] = _cache_gather(
+                            tables[op.name], slots)
                 grad_fn = jax.value_and_grad(loss_rows, argnums=(0, 1),
                                              has_aux=True)
                 (loss, (preds, new_bn)), (dgrads, rgrads) = grad_fn(
@@ -548,9 +567,15 @@ class FFModel:
                 lr = state.opt_state.get("lr", self.optimizer.lr)
                 new_params = dict(new_params)
                 for op in sparse_emb:
-                    new_params[op.name] = {"embedding": op.scatter_apply(
-                        tables[op.name], inputs[id_name[op.name]],
-                        rgrads[op.name], -lr)}
+                    slots = slot_override.get(op.name)
+                    if slots is None:
+                        upd = op.scatter_apply(
+                            tables[op.name], inputs[id_name[op.name]],
+                            rgrads[op.name], -lr)
+                    else:
+                        upd = sparse_row_update(
+                            tables[op.name], slots, rgrads[op.name], -lr)
+                    new_params[op.name] = {"embedding": upd}
             else:
                 grad_fn = jax.value_and_grad(loss_and_preds, has_aux=True)
                 (loss, (preds, new_bn)), grads = grad_fn(
@@ -576,6 +601,26 @@ class FFModel:
                                     bn_state=bn_state or {})
             return values[final_uid]
 
+        # Epoch row-cache: big-table gather/scatter lowers to a full-table
+        # SWEEP per step on TPU (cost scales with table bytes, PERF.md).
+        # But train_epoch knows the WHOLE epoch's ids up front, so the
+        # touched rows can be pulled into a small cache with ONE sweep,
+        # the scan then gathers/scatters the cache by slot (exact: unique
+        # slots keep cross-step updates coherent), and one scatter-set
+        # writes the final rows back.  Per-step table cost becomes
+        # O(cache bytes) instead of O(table bytes).
+        cache_mode = getattr(self.config, "epoch_row_cache", "auto")
+        if cache_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"epoch_row_cache must be 'auto'|'on'|'off', "
+                f"got {cache_mode!r}")
+        # "auto": tpu only (the sweep it amortizes is a TPU lowering;
+        # cpu/gpu scatter is already per-row).  "on": force anywhere
+        # (tests exercise the cached path on the CPU suite).  "off": never.
+        epoch_cache = (bool(sparse_emb) and self.mesh is None
+                       and (cache_mode == "on"
+                            or (cache_mode == "auto" and backend == "tpu")))
+
         def train_epoch(state: TrainState, inputs, labels):
             """Scan a whole epoch on device — one dispatch for nb steps.
 
@@ -585,13 +630,68 @@ class FFModel:
             dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
             batches resident on device; ``labels``: (nb, batch, ...).
             """
+            from .ops.pallas_scatter import pack_factor
+
+            # epoch row-cache prologue: per eligible op, map the epoch's
+            # ids to unique cache slots and pull the touched rows in with
+            # one table sweep
+            params = dict(state.params)
+            slots_ep, writebacks = {}, []
+            orig_tables = {}
+            for op in (sparse_emb if epoch_cache else ()):
+                ids = inputs[id_name[op.name]].astype(jnp.int32)
+                tb = params[op.name]["embedding"]
+                d = tb.shape[-1]
+                flat = tb.reshape(-1, d)
+                gids = op.flat_ids(ids)
+                n_tot = int(np.prod(gids.shape))
+                # distinct rows can never exceed the table or the id count
+                size = min(n_tot, flat.shape[0])
+                sentinel = flat.shape[0]  # OOB -> dropped at writeback
+                # pad the cache to the lane-pack multiple so the packed
+                # view applies to it too
+                pack = max(pack_factor(flat.shape[0], d), 1)
+                m = -(-size // pack) * pack
+                if m >= flat.shape[0]:
+                    # cache would be as big as the table — no win; keep
+                    # this op on the direct per-step path
+                    continue
+                uniq, inv = jnp.unique(gids.reshape(-1), size=size,
+                                       fill_value=sentinel,
+                                       return_inverse=True)
+                if m > size:
+                    uniq = jnp.concatenate(
+                        [uniq, jnp.full((m - size,), sentinel, uniq.dtype)])
+                cache = jnp.take(flat, uniq, axis=0, mode="clip")
+                orig_tables[op.name] = tb
+                params[op.name] = {"embedding": cache}
+                slots_ep[op.name] = inv.reshape(ids.shape)
+                writebacks.append((op.name, tb.shape, uniq))
+            state = TrainState(params, state.opt_state, state.bn_state,
+                               state.rng, state.step)
+
             def body(st, batch):
-                binputs, blabels = batch
-                new_st, mets = train_step(st, binputs, blabels)
+                binputs, blabels, bslots = batch
+                new_st, mets = train_step(st, binputs, blabels,
+                                          slot_override=bslots)
                 return new_st, mets
 
-            state, mets = jax.lax.scan(body, state, (inputs, labels))
-            # fold per-step metrics into epoch sums (loss: mean)
+            state, mets = jax.lax.scan(body, state,
+                                       (inputs, labels, slots_ep))
+            # epoch row-cache epilogue: write the final rows back, each
+            # unique slot exactly once (set, not add — bit-exact with the
+            # per-step path); sentinel indices (padding/duplicate fill)
+            # are dropped
+            new_params = dict(state.params)
+            for name, tb_shape, uniq in writebacks:
+                d = tb_shape[-1]
+                cache_final = state.params[name]["embedding"]
+                flat = orig_tables[name].reshape(-1, d)
+                flat = flat.at[uniq].set(cache_final, mode="drop")
+                new_params[name] = {"embedding": flat.reshape(tb_shape)}
+            if writebacks:
+                state = TrainState(new_params, state.opt_state,
+                                   state.bn_state, state.rng, state.step)
             folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
                       for k, v in mets.items()}
             return state, folded

@@ -257,3 +257,91 @@ class TestBF16Tables:
             losses[dt] = ls
         assert losses["bfloat16"][-1] < losses["bfloat16"][0]  # learns
         assert abs(losses["bfloat16"][-1] - losses["float32"][-1]) < 0.05
+
+
+class TestEpochRowCache:
+    """train_epoch's epoch row-cache (epoch_row_cache="on" forces it off
+    TPU): one table sweep in, scan against the small cache by unique
+    slot, one scatter-set back — must equal the stepwise path exactly."""
+
+    def _run(self, stacked, emb_dtype, cache_mode, nb=6, batch=16,
+             tables=4, bag=2, big=True):
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        # big tables: the cache engages (epoch ids < rows); small tables:
+        # the clamp skips caching (cache would be >= the table)
+        if big:
+            rows = [4096, 8192, 2048, 4096][:tables] if not stacked \
+                else [4096] * tables
+        else:
+            rows = [64, 96, 32, 80][:tables] if not stacked \
+                else [64] * tables
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=list(rows),
+                         embedding_bag_size=bag,
+                         mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * tables + 8, 16, 1])
+        fc = ff.FFConfig(batch_size=batch, embedding_dtype=emb_dtype,
+                         epoch_row_cache=cache_mode)
+        m = build_dlrm(cfg, fc, stacked_embeddings=stacked)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=("accuracy",),
+                  mesh=False)
+        rng = np.random.default_rng(0)
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32)}
+        if stacked:
+            inputs["sparse"] = rng.integers(
+                0, rows[0], size=(nb, batch, tables, bag), dtype=np.int64)
+        else:
+            for i, r in enumerate(rows):
+                inputs[f"sparse_{i}"] = rng.integers(
+                    0, r, size=(nb, batch, bag), dtype=np.int64)
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        st = m.init(seed=0)
+        st, mets = m.train_epoch(st, inputs, labels)
+        return st, mets
+
+    @pytest.mark.parametrize("big", [True, False])
+    @pytest.mark.parametrize("stacked", [True, False])
+    @pytest.mark.parametrize("emb_dtype", ["float32", "bfloat16"])
+    def test_cached_equals_uncached_epoch(self, stacked, emb_dtype, big):
+        st_c, mets_c = self._run(stacked, emb_dtype, "on", big=big)
+        st_u, mets_u = self._run(stacked, emb_dtype, "off", big=big)
+        for opn in st_c.params:
+            for k in st_c.params[opn]:
+                np.testing.assert_array_equal(
+                    np.asarray(st_c.params[opn][k]),
+                    np.asarray(st_u.params[opn][k]),
+                    err_msg=f"{opn}/{k} (stacked={stacked}, {emb_dtype})")
+        for k in mets_c:
+            np.testing.assert_allclose(np.asarray(mets_c[k]),
+                                       np.asarray(mets_u[k]), rtol=1e-6)
+
+    def test_heavy_duplicate_ids_across_steps(self):
+        # many cross-step collisions: ids drawn from just 8 rows
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        rng = np.random.default_rng(1)
+        nb, batch = 5, 16
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            "sparse": rng.integers(0, 8, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        states = {}
+        for mode in ("on", "off"):
+            fc = ff.FFConfig(batch_size=batch, epoch_row_cache=mode)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
+            st = m.init(seed=0)
+            st, _ = m.train_epoch(st, inputs, labels)
+            states[mode] = st
+        a, b = states["on"].params, states["off"].params
+        for opn in a:
+            for k in a[opn]:
+                np.testing.assert_array_equal(np.asarray(a[opn][k]),
+                                              np.asarray(b[opn][k]))
