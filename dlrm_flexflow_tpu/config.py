@@ -67,6 +67,11 @@ class FFConfig:
     # per-step table cost becomes O(touched rows) (PERF.md).  "auto"
     # enables it on TPU; "on" forces it on any backend; "off" disables.
     epoch_row_cache: str = "auto"
+    # Scan steps per dispatched chunk when the epoch row-cache is active:
+    # the per-step cache sweep scales with the chunk's unique rows while
+    # the two table sweeps amortize over it (measured optimum ~256 on the
+    # headline config, PERF.md).  0 disables chunking.
+    epoch_cache_chunk: int = 256
     # fit()'s scanned-epoch fast path stages the whole dataset on device;
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)

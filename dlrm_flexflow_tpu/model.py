@@ -95,6 +95,7 @@ class FFModel:
         self._last_metrics = MetricsAccumulator(())
         self._pending_lr: Optional[float] = None
         self._fit_state: Optional[TrainState] = None
+        self._epoch_cache_active = False
 
     # ------------------------------------------------------------------ utils
     def _name(self, base: str, name: Optional[str] = None) -> str:
@@ -620,6 +621,7 @@ class FFModel:
         epoch_cache = (bool(sparse_emb) and self.mesh is None
                        and (cache_mode == "on"
                             or (cache_mode == "auto" and backend == "tpu")))
+        self._epoch_cache_active = epoch_cache
 
         def train_epoch(state: TrainState, inputs, labels):
             """Scan a whole epoch on device — one dispatch for nb steps.
@@ -833,9 +835,58 @@ class FFModel:
     def train_epoch(self, state: TrainState, inputs: Dict[str, Any], labels):
         """Run all batches in one on-device scan.  ``inputs`` arrays have a
         leading (num_batches, batch, ...) layout; they are placed with the
-        batch dim (axis 1) on the data axis."""
+        batch dim (axis 1) on the data axis.
+
+        With the epoch row-cache active, long epochs are dispatched in
+        chunks of ``epoch_cache_chunk`` scan steps (see
+        ``_run_epoch_chunks``).
+        """
         inputs, labels = self.place_dataset(inputs, labels)
-        return self._train_epoch(state, inputs, labels)
+        bounds = self._epoch_chunk_bounds(labels.shape[0])
+        if bounds is None:
+            return self._train_epoch(state, inputs, labels)
+        return self._run_epoch_chunks(state, inputs, labels, bounds)
+
+    def _epoch_chunk_bounds(self, nb: int):
+        """(lo, hi) chunk slices for a chunked epoch dispatch, or None
+        when chunking doesn't apply.  Chunks are equalized
+        (nb // ceil(nb/chunk)) so a non-divisible epoch compiles at most
+        TWO scan shapes (equal chunks + one remainder-folded tail)."""
+        chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
+        if not (self._epoch_cache_active and chunk > 0 and nb > chunk):
+            return None
+        k = -(-nb // chunk)
+        base = nb // k
+        sizes = [base] * k
+        sizes[-1] += nb - base * k
+        bounds, lo = [], 0
+        for s in sizes:
+            bounds.append((lo, lo + s))
+            lo += s
+        return bounds
+
+    def _run_epoch_chunks(self, state: TrainState, inputs, labels, bounds,
+                          aot=None):
+        """Dispatch one epoch as chunked scans: with the epoch row-cache,
+        the per-step cache sweep scales with the chunk's unique rows
+        while the two full-table sweeps amortize over the chunk, so a
+        mid-size chunk beats both extremes (PERF.md).  ``aot`` optionally
+        maps chunk length -> precompiled epoch executable (fit's untimed
+        AOT compile)."""
+        sums, loss_num, n_steps = {}, 0.0, 0
+        for lo, hi in bounds:
+            cin = {k: v[lo:hi] for k, v in inputs.items()}
+            fn = (aot or {}).get(hi - lo, self._train_epoch)
+            state, mets = fn(state, cin, labels[lo:hi])
+            w = hi - lo
+            for k, v in mets.items():
+                if k == "loss":
+                    loss_num = loss_num + v * w  # fold of means, weighted
+                else:
+                    sums[k] = sums.get(k, 0.0) + v
+            n_steps += w
+        sums["loss"] = loss_num / n_steps
+        return state, sums
 
     def eval_step(self, state: TrainState, inputs, labels):
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
@@ -945,12 +996,24 @@ class FFModel:
             first = dataloader.peek()
             state, _ = self.train_step(state, first[0], first[1])
             device_fence(state.step)
-        scan_fn = None
+        scan_fn, chunk_bounds, chunk_aot = None, None, None
         if scan_data is not None:
             # AOT-compile the scanned epoch outside the timed window (the
             # reference's untimed epoch 0, dlrm.cc:178) without running
             # it; the compiled executable is invoked directly in the loop
-            scan_fn = self._train_epoch.lower(state, *scan_data).compile()
+            chunk_bounds = self._epoch_chunk_bounds(scan_data[1].shape[0])
+            if chunk_bounds is None:
+                scan_fn = self._train_epoch.lower(state, *scan_data).compile()
+            else:
+                # chunked epoch (epoch row-cache): precompile each
+                # distinct chunk shape
+                sin, slab = scan_data
+                chunk_aot = {}
+                for lo, hi in chunk_bounds:
+                    if hi - lo not in chunk_aot:
+                        chunk_aot[hi - lo] = self._train_epoch.lower(
+                            state, {k: v[lo:hi] for k, v in sin.items()},
+                            slab[lo:hi]).compile()
         t0 = time.perf_counter()
         samples = 0
         for epoch in range(epochs):
@@ -960,7 +1023,12 @@ class FFModel:
                 state = apply_pending_lr(state)
             acc.reset()
             if scan_data is not None:
-                state, mets = scan_fn(state, *scan_data)
+                if chunk_bounds is not None:
+                    state, mets = self._run_epoch_chunks(
+                        state, scan_data[0], scan_data[1], chunk_bounds,
+                        aot=chunk_aot)
+                else:
+                    state, mets = scan_fn(state, *scan_data)
                 samples += dataloader.num_batches * dataloader.batch_size
                 acc.update({k: v for k, v in mets.items() if k != "loss"})
             else:

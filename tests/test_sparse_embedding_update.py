@@ -345,3 +345,61 @@ class TestEpochRowCache:
             for k in a[opn]:
                 np.testing.assert_array_equal(np.asarray(a[opn][k]),
                                               np.asarray(b[opn][k]))
+
+    def test_chunked_equals_unchunked(self):
+        # chunk boundary correctness: rows updated in chunk k must be
+        # re-cached with their new values by chunk k+1
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[4096] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        rng = np.random.default_rng(2)
+        nb, batch = 9, 16  # 9 steps, chunk 4 -> chunks of 4+4+1
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            # ids from a narrow range so chunks share rows
+            "sparse": rng.integers(0, 32, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        states = {}
+        for chunk in (4, 0):
+            fc = ff.FFConfig(batch_size=batch, epoch_row_cache="on",
+                             epoch_cache_chunk=chunk)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
+            st = m.init(seed=0)
+            st, mets = m.train_epoch(st, inputs, labels)
+            states[chunk] = (st, mets)
+        a, b = states[4][0].params, states[0][0].params
+        for opn in a:
+            for k in a[opn]:
+                np.testing.assert_array_equal(np.asarray(a[opn][k]),
+                                              np.asarray(b[opn][k]))
+        np.testing.assert_allclose(
+            float(states[4][1]["loss"]), float(states[0][1]["loss"]),
+            rtol=1e-6)
+
+    def test_fit_scan_path_uses_chunks(self):
+        # fit()'s staged-scan fast path must route through the chunked
+        # dispatch when the epoch row-cache is active
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[4096] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        fc = ff.FFConfig(batch_size=16, epoch_row_cache="on",
+                         epoch_cache_chunk=4)
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=("accuracy",),
+                  mesh=False)
+        loader = SyntheticDLRMLoader(
+            num_samples=16 * 9, num_dense=4, table_sizes=cfg.embedding_size,
+            bag_size=2, batch_size=16)
+        st = m.init(seed=0)
+        st, _ = m.fit(st, loader, epochs=2, verbose=False)
+        assert m._last_fit_used_scan
+        # 9 batches x 2 epochs + fit's one warmup update
+        assert int(st.step) == 19
