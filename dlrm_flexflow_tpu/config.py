@@ -72,6 +72,11 @@ class FFConfig:
     # the two table sweeps amortize over it (measured optimum ~256 on the
     # headline config, PERF.md).  0 disables chunking.
     epoch_cache_chunk: int = 256
+    # Second, in-graph cache level: every `epoch_cache_inner` scan steps
+    # pull their rows from the chunk cache into a block cache (L0) so the
+    # per-step sweep scales with the block, not the chunk (measured
+    # optimum 8 with chunk 256, PERF.md).  0 disables.
+    epoch_cache_inner: int = 8
     # fit()'s scanned-epoch fast path stages the whole dataset on device;
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)

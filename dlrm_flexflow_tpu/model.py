@@ -637,37 +637,51 @@ class FFModel:
             # epoch row-cache prologue: per eligible op, map the epoch's
             # ids to unique cache slots and pull the touched rows in with
             # one table sweep
-            params = dict(state.params)
-            slots_ep, writebacks = {}, []
-            orig_tables = {}
-            for op in (sparse_emb if epoch_cache else ()):
-                ids = inputs[id_name[op.name]].astype(jnp.int32)
-                tb = params[op.name]["embedding"]
-                d = tb.shape[-1]
-                flat = tb.reshape(-1, d)
-                gids = op.flat_ids(ids)
-                n_tot = int(np.prod(gids.shape))
-                # distinct rows can never exceed the table or the id count
+            def build_cache(flat, ids, pack):
+                """Unique-slot cache of the rows ``ids`` touches in the
+                (R, d) source ``flat``: (cache, slots, uniq) or None when
+                the cache would not be smaller than the source.  Works on
+                concrete arrays (epoch prologue) and on traced values
+                (the in-scan inner level) alike — shapes are static."""
+                n_tot = int(np.prod(ids.shape))
+                # distinct rows can never exceed the source or the ids
                 size = min(n_tot, flat.shape[0])
                 sentinel = flat.shape[0]  # OOB -> dropped at writeback
-                # pad the cache to the lane-pack multiple so the packed
-                # view applies to it too
-                pack = max(pack_factor(flat.shape[0], d), 1)
+                # pad to the lane-pack multiple so the packed view
+                # applies to the cache too
                 m = -(-size // pack) * pack
                 if m >= flat.shape[0]:
-                    # cache would be as big as the table — no win; keep
-                    # this op on the direct per-step path
-                    continue
-                uniq, inv = jnp.unique(gids.reshape(-1), size=size,
+                    return None
+                uniq, inv = jnp.unique(ids.reshape(-1), size=size,
                                        fill_value=sentinel,
                                        return_inverse=True)
                 if m > size:
                     uniq = jnp.concatenate(
                         [uniq, jnp.full((m - size,), sentinel, uniq.dtype)])
                 cache = jnp.take(flat, uniq, axis=0, mode="clip")
+                return cache, inv.reshape(ids.shape), uniq
+
+            op_pack = {op.name: max(pack_factor(
+                int(np.prod(op.param_specs()[0].shape[:-1])),
+                op.param_specs()[0].shape[-1]), 1) for op in sparse_emb}
+
+            params = dict(state.params)
+            slots_ep, writebacks = {}, []
+            orig_tables = {}
+            for op in (sparse_emb if epoch_cache else ()):
+                ids = inputs[id_name[op.name]].astype(jnp.int32)
+                tb = params[op.name]["embedding"]
+                flat = tb.reshape(-1, tb.shape[-1])
+                built = build_cache(flat, op.flat_ids(ids),
+                                    op_pack[op.name])
+                if built is None:
+                    # cache would be as big as the table — no win; keep
+                    # this op on the direct per-step path
+                    continue
+                cache, slots, uniq = built
                 orig_tables[op.name] = tb
                 params[op.name] = {"embedding": cache}
-                slots_ep[op.name] = inv.reshape(ids.shape)
+                slots_ep[op.name] = slots
                 writebacks.append((op.name, tb.shape, uniq))
             state = TrainState(params, state.opt_state, state.bn_state,
                                state.rng, state.step)
@@ -678,8 +692,55 @@ class FFModel:
                                           slot_override=bslots)
                 return new_st, mets
 
-            state, mets = jax.lax.scan(body, state,
-                                       (inputs, labels, slots_ep))
+            nb = labels.shape[0]
+            inner = int(getattr(self.config, "epoch_cache_inner", 8))
+            if slots_ep and 0 < inner < nb and nb % inner == 0:
+                # Second cache level, in-graph: the chunk cache's own
+                # per-step sweep still scales with the CHUNK's rows, so
+                # each ``inner``-step block pulls its rows into an L0
+                # cache from the chunk cache (exact, same construction),
+                # scans against L0, and writes back — per-step cache
+                # bytes now scale with the BLOCK's rows (PERF.md).
+                def blk(x):
+                    return x.reshape((nb // inner, inner) + x.shape[1:])
+
+                cached = [op.name for op in sparse_emb
+                          if op.name in slots_ep]
+
+                def outer_body(st, xs_k):
+                    in_k, lab_k, sl_k = xs_k
+                    params2 = dict(st.params)
+                    sl0_k = dict(sl_k)
+                    l0_meta = []
+                    for name in cached:
+                        l1 = st.params[name]["embedding"]
+                        built = build_cache(l1, sl_k[name], op_pack[name])
+                        if built is None:  # static: tiny L1, skip L0
+                            continue
+                        l0, sl0, u0 = built
+                        params2[name] = {"embedding": l0}
+                        sl0_k[name] = sl0
+                        l0_meta.append((name, u0, l1))
+                    st2 = TrainState(params2, st.opt_state, st.bn_state,
+                                     st.rng, st.step)
+                    st2, mets_k = jax.lax.scan(body, st2,
+                                               (in_k, lab_k, sl0_k))
+                    new_p = dict(st2.params)
+                    for name, u0, l1 in l0_meta:
+                        l0_final = st2.params[name]["embedding"]
+                        new_p[name] = {"embedding": l1.at[u0].set(
+                            l0_final, mode="drop")}
+                    st3 = TrainState(new_p, st2.opt_state, st2.bn_state,
+                                     st2.rng, st2.step)
+                    return st3, mets_k
+
+                state, mets = jax.lax.scan(
+                    outer_body, state,
+                    (jax.tree.map(blk, inputs), blk(labels),
+                     jax.tree.map(blk, slots_ep)))
+            else:
+                state, mets = jax.lax.scan(body, state,
+                                           (inputs, labels, slots_ep))
             # epoch row-cache epilogue: write the final rows back, each
             # unique slot exactly once (set, not add — bit-exact with the
             # per-step path); sentinel indices (padding/duplicate fill)
@@ -851,12 +912,17 @@ class FFModel:
         """(lo, hi) chunk slices for a chunked epoch dispatch, or None
         when chunking doesn't apply.  Chunks are equalized
         (nb // ceil(nb/chunk)) so a non-divisible epoch compiles at most
-        TWO scan shapes (equal chunks + one remainder-folded tail)."""
+        TWO scan shapes (equal chunks + one remainder-folded tail), and
+        rounded to a multiple of the inner cache block so the in-graph
+        L0 level stays engaged for non-divisible epoch lengths."""
         chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
         if not (self._epoch_cache_active and chunk > 0 and nb > chunk):
             return None
         k = -(-nb // chunk)
         base = nb // k
+        inner = int(getattr(self.config, "epoch_cache_inner", 8))
+        if inner > 0 and base > inner:
+            base = (base // inner) * inner
         sizes = [base] * k
         sizes[-1] += nb - base * k
         bounds, lo = [], 0

@@ -403,3 +403,51 @@ class TestEpochRowCache:
         assert m._last_fit_used_scan
         # 9 batches x 2 epochs + fit's one warmup update
         assert int(st.step) == 19
+
+    def test_inner_block_cache_equals_stepwise(self):
+        # nb divisible by epoch_cache_inner so the in-graph L0 nested
+        # scan actually executes (the other cases fall back)
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[8192] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        rng = np.random.default_rng(3)
+        nb, batch = 12, 16  # inner=4 -> 3 L0 blocks
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            # narrow id range: heavy duplicates within and across blocks
+            "sparse": rng.integers(0, 48, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        states = {}
+        for mode, inner in (("on", 4), ("off", 0)):
+            fc = ff.FFConfig(batch_size=batch, epoch_row_cache=mode,
+                             epoch_cache_inner=inner)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
+            st = m.init(seed=0)
+            st, mets = m.train_epoch(st, inputs, labels)
+            states[mode] = (st, mets)
+        a, b = states["on"][0].params, states["off"][0].params
+        for opn in a:
+            for k in a[opn]:
+                np.testing.assert_array_equal(np.asarray(a[opn][k]),
+                                              np.asarray(b[opn][k]))
+        for k in states["on"][1]:
+            np.testing.assert_allclose(
+                np.asarray(states["on"][1][k]),
+                np.asarray(states["off"][1][k]), rtol=1e-6)
+
+    def test_chunk_bounds_round_to_inner(self):
+        import dlrm_flexflow_tpu as ffm
+        m = ffm.FFModel(ff.FFConfig(epoch_cache_chunk=256,
+                                    epoch_cache_inner=8))
+        m._epoch_cache_active = True
+        bounds = m._epoch_chunk_bounds(1000)
+        sizes = [hi - lo for lo, hi in bounds]
+        assert sum(sizes) == 1000
+        # all but the tail are multiples of the inner block
+        assert all(s % 8 == 0 for s in sizes[:-1])
+        assert bounds[-1][1] == 1000
