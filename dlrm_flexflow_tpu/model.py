@@ -509,7 +509,9 @@ class FFModel:
                         and not getattr(op, "use_pallas", False)
                         and op.inputs[0].uid in input_name_of
                         and not (sparse_mode == "auto" and backend == "tpu"
-                                 and not op.sparse_update_ok())):
+                                 and not op.sparse_update_ok(
+                                     getattr(self.config, "epoch_row_cache",
+                                             "auto") != "off"))):
                     sparse_emb.append(op)
         self._sparse_emb_ops = [op.name for op in sparse_emb]
         emb_names = {op.name for op in sparse_emb}
@@ -632,7 +634,7 @@ class FFModel:
             dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
             batches resident on device; ``labels``: (nb, batch, ...).
             """
-            from .ops.pallas_scatter import pack_factor
+            from .ops.pallas_scatter import lane_pack
 
             # epoch row-cache prologue: per eligible op, map the epoch's
             # ids to unique cache slots and pull the touched rows in with
@@ -661,9 +663,8 @@ class FFModel:
                 cache = jnp.take(flat, uniq, axis=0, mode="clip")
                 return cache, inv.reshape(ids.shape), uniq
 
-            op_pack = {op.name: max(pack_factor(
-                int(np.prod(op.param_specs()[0].shape[:-1])),
-                op.param_specs()[0].shape[-1]), 1) for op in sparse_emb}
+            op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
+                       for op in sparse_emb}
 
             params = dict(state.params)
             slots_ep, writebacks = {}, []
@@ -918,13 +919,24 @@ class FFModel:
         chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
         if not (self._epoch_cache_active and chunk > 0 and nb > chunk):
             return None
-        k = -(-nb // chunk)
-        base = nb // k
         inner = int(getattr(self.config, "epoch_cache_inner", 8))
-        if inner > 0 and base > inner:
-            base = (base // inner) * inner
-        sizes = [base] * k
-        sizes[-1] += nb - base * k
+        if inner > 1 and chunk > inner:
+            # work in whole inner blocks so every main chunk keeps the
+            # in-graph L0 level; a sub-block remainder becomes one tiny
+            # tail chunk (flat scan).  At most 3 compiled scan shapes,
+            # all chunk sizes <= epoch_cache_chunk.
+            q, r = divmod(nb, inner)
+            per = chunk // inner                   # blocks per chunk
+            k = max(-(-q // per), 1)
+            bq, br = divmod(q, k)                  # equalized blocks
+            sizes = [(bq + (1 if i < br else 0)) * inner for i in range(k)]
+            if r:
+                sizes.append(r)
+        else:
+            k = -(-nb // chunk)
+            base = nb // k
+            sizes = [base] * k
+            sizes[-1] += nb - base * k
         bounds, lo = [], 0
         for s in sizes:
             bounds.append((lo, lo + s))

@@ -273,15 +273,32 @@ def _row_update_pallas(table, ids_sorted, upd_sorted, interpret=False,
     )(ids_padded, table, upd_sorted)
 
 
+def lane_compatible(dim: int) -> bool:
+    """d fits the 128-lane packed view (d | 128 or 128 | d).  Weaker than
+    ``pack_factor`` > 0: the epoch row-cache only needs ITS OWN row count
+    to divide the pack (it rounds it up itself), not the table's."""
+    if dim >= 128:
+        return dim % 128 == 0
+    return 128 % dim == 0
+
+
+def lane_pack(dim: int) -> int:
+    """Rows per 128-lane view row by DIM alone (for sizing structures
+    whose row count the caller rounds up itself, e.g. the epoch
+    row-cache); 1 when the dim is not lane-compatible."""
+    if dim < 128 and 128 % dim == 0:
+        return 128 // dim
+    return 1
+
+
 def pack_factor(num_rows: int, dim: int) -> int:
     """Rows per 128-lane view row for the lane-packed table view, or 0
     when the (num_rows, dim) table cannot be viewed as (R/pack, 128*k)
-    with a free row-major bitcast."""
-    if dim >= 128:
-        return 1 if dim % 128 == 0 else 0
-    if 128 % dim != 0:
+    with a free row-major bitcast.  (One lane rule: lane_compatible +
+    lane_pack; this adds the table-row divisibility requirement.)"""
+    if not lane_compatible(dim):
         return 0
-    pack = 128 // dim
+    pack = lane_pack(dim)
     return pack if num_rows % pack == 0 else 0
 
 

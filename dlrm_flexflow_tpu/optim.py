@@ -54,7 +54,10 @@ class SGDOptimizer(Optimizer):
                 "lr": jnp.asarray(self.lr, jnp.float32)}
         if self.momentum == 0.0:
             return base
-        base["v"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # momentum buffer always f32 (bf16-stored params keep f32
+        # optimizer statistics)
+        base["v"] = jax.tree_util.tree_map(
+            lambda w: jnp.zeros(w.shape, jnp.float32), params)
         return base
 
     def update(self, params, grads, opt_state):
@@ -63,16 +66,18 @@ class SGDOptimizer(Optimizer):
 
         if mu == 0.0:
             def upd(w, g):
-                gt = g + wd * w
-                return w - lr * gt
+                # math in f32, result in the param's storage dtype (bf16
+                # embedding tables must not be promoted by the f32 lr)
+                gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
+                return (w.astype(jnp.float32) - lr * gt).astype(w.dtype)
             new_params = jax.tree_util.tree_map(upd, params, grads)
             return new_params, {**opt_state, "step": opt_state["step"] + 1}
 
         def upd(w, g, v):
-            gt = g + wd * w
+            gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
             v = mu * v + gt
             nxt = gt + mu * v if self.nesterov else v
-            return w - lr * nxt, v
+            return (w.astype(jnp.float32) - lr * nxt).astype(w.dtype), v
 
         flat = jax.tree_util.tree_map(upd, params, grads, opt_state["v"])
         new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
@@ -101,7 +106,10 @@ class AdamOptimizer(Optimizer):
         self.epsilon = epsilon
 
     def init(self, params):
-        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        # moments always f32 (bf16-stored params keep f32 optimizer
+        # statistics — the usual mixed-precision treatment)
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda w: jnp.zeros(w.shape, jnp.float32), params)
         return {"step": jnp.zeros((), jnp.int32),
                 "lr": jnp.asarray(self.lr, jnp.float32),
                 "m": zeros(), "v": zeros()}
@@ -115,10 +123,12 @@ class AdamOptimizer(Optimizer):
         alpha_t = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
 
         def upd(w, g, m, v):
-            gt = g + wd * w
+            gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
             m = b1 * m + (1 - b1) * gt
             v = b2 * v + (1 - b2) * jnp.square(gt)
-            w = w - alpha_t * m / (jnp.sqrt(v) + eps)
+            # f32 moments/math, result in the param's storage dtype
+            w = (w.astype(jnp.float32)
+                 - alpha_t * m / (jnp.sqrt(v) + eps)).astype(w.dtype)
             return w, m, v
 
         flat = jax.tree_util.tree_map(upd, params, grads,
