@@ -478,27 +478,17 @@ class FFModel:
         sparse_mode = getattr(self.config, "sparse_embedding_updates",
                               "auto")
         backend = jax.default_backend()
-        if sparse_mode == "auto":
-            # the win depends on updating the table in place with NO
-            # full-table layout copies in the loop.  cpu/gpu scatter
-            # aliases cleanly.  On tpu, gather and scatter of a (R, d<128)
-            # table pick CONFLICTING layouts and XLA materializes
-            # full-table copies every step; the fast path routes both
-            # through the lane-packed (R/pack, 128) view instead
-            # (pallas_scatter.packed_gather/packed_scatter_add — measured
-            # 14x faster than the in-place pallas row-update kernel, which
-            # FF_SCATTER_IMPL=kernel still selects).  Single-device only:
-            # under a mesh the packed view fights the sharded layout (and
-            # SPMD cannot partition a pallas_call); eligibility per op
-            # checked below (sparse_update_ok).
-            sparse_ok = (backend in ("cpu", "gpu")
-                         or (backend == "tpu" and self.mesh is None))
-        elif sparse_mode in ("on", "off"):
-            sparse_ok = sparse_mode == "on"
-        else:
+        if sparse_mode not in ("auto", "on", "off"):
             raise ValueError(
                 f"sparse_embedding_updates must be 'auto'|'on'|'off', "
                 f"got {sparse_mode!r}")
+        # "auto" enables the path on every backend, mesh or not; the only
+        # backend-specific gating left is the per-op packed-view
+        # eligibility below (single-device tpu routes gather/scatter
+        # through the lane-packed view to avoid the gather-vs-scatter
+        # layout war, PERF.md; under a mesh both run on the logical shape
+        # and XLA SPMD owns layouts and collectives).
+        sparse_ok = sparse_mode != "off"
         if (sparse_ok
                 and isinstance(self.optimizer, SGDOptimizer)
                 and self.optimizer.momentum == 0.0
@@ -509,6 +499,7 @@ class FFModel:
                         and not getattr(op, "use_pallas", False)
                         and op.inputs[0].uid in input_name_of
                         and not (sparse_mode == "auto" and backend == "tpu"
+                                 and self.mesh is None
                                  and not op.sparse_update_ok(
                                      getattr(self.config, "epoch_row_cache",
                                              "auto") != "off"))):
@@ -576,8 +567,12 @@ class FFModel:
                             tables[op.name], inputs[id_name[op.name]],
                             rgrads[op.name], -lr)
                     else:
+                        # allow_kernel doubles as the mesh-is-None bit:
+                        # under a mesh the packed view / pallas kernel
+                        # must not be used (layouts are SPMD-owned)
                         upd = sparse_row_update(
-                            tables[op.name], slots, rgrads[op.name], -lr)
+                            tables[op.name], slots, rgrads[op.name], -lr,
+                            allow_kernel=mesh_ is None)
                     new_params[op.name] = {"embedding": upd}
             else:
                 grad_fn = jax.value_and_grad(loss_and_preds, has_aux=True)
@@ -620,7 +615,11 @@ class FFModel:
         # "auto": tpu only (the sweep it amortizes is a TPU lowering;
         # cpu/gpu scatter is already per-row).  "on": force anywhere
         # (tests exercise the cached path on the CPU suite).  "off": never.
-        epoch_cache = (bool(sparse_emb) and self.mesh is None
+        # Mesh-compatible: the cache is built from the full epoch's ids
+        # inside the jitted epoch program, so under a mesh XLA SPMD owns
+        # its placement (the two full-table sweeps it amortizes are then
+        # per-shard sweeps of the table's local rows).
+        epoch_cache = (bool(sparse_emb)
                        and (cache_mode == "on"
                             or (cache_mode == "auto" and backend == "tpu")))
         self._epoch_cache_active = epoch_cache
@@ -1002,12 +1001,14 @@ class FFModel:
         epoch then runs as ONE on-device lax.scan (the Legion-tracing
         analogue), eliminating per-step host dispatch.  Returns None (and
         fit keeps the general per-batch loop) when per-batch work is
-        needed: callbacks, hetero CPU tables, a mesh, shuffling, a
-        non-array loader, or a dataset larger than fit_scan_max_bytes.
+        needed: callbacks, hetero CPU tables, shuffling, a non-array
+        loader, or a dataset larger than fit_scan_max_bytes.  Under a
+        mesh the staged arrays are placed with the batch dim on the data
+        axis (place_dataset), so the scanned epoch runs SPMD.
         """
         scan_cap = getattr(self.config, "fit_scan_max_bytes",
                            2 * 1024 * 1024 * 1024)
-        if not (not cbs and not self._hetero_ops and self.mesh is None
+        if not (not cbs and not self._hetero_ops
                 and scan_cap > 0
                 and getattr(dataloader, "inputs", None) is not None
                 and getattr(dataloader, "drop_last", False)

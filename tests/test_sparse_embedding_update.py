@@ -454,3 +454,110 @@ class TestEpochRowCache:
         # all but the tail are multiples of the inner block
         assert all(s % 8 == 0 for s in sizes[:-1])
         assert bounds[-1][1] == 1000
+
+
+class TestMeshSparseFastPath:
+    """The sparse-update fast path + epoch row-cache under a mesh: the
+    flagship distributed-DLRM configuration (table-parallel embeddings +
+    DP MLPs, reference dlrm_strategy.cc:242-296) must keep the row-sparse
+    path ACTIVE and train to the same result as single-device (exact but
+    for the DP gradient-reduction order, same tolerance as the
+    device-count matrix in test_parallel.py)."""
+
+    def _epoch_data(self, cfg, nb=8, batch=16, tables=4, stacked=True,
+                    seed=0):
+        rng = np.random.default_rng(seed)
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32)}
+        if stacked:
+            inputs["sparse"] = rng.integers(
+                0, cfg.embedding_size[0],
+                size=(nb, batch, tables, cfg.embedding_bag_size),
+                dtype=np.int64)
+        else:
+            for i in range(tables):
+                inputs[f"sparse_{i}"] = rng.integers(
+                    0, cfg.embedding_size[i],
+                    size=(nb, batch, cfg.embedding_bag_size),
+                    dtype=np.int64)
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        return inputs, labels
+
+    @pytest.mark.parametrize("cache", ["on", "off"])
+    @pytest.mark.parametrize("stacked", [True, False])
+    def test_mesh_matches_single_device(self, stacked, cache):
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 4, "model": 2})
+
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+        def build(mesh_arg):
+            tables = 4
+            cfg = DLRMConfig(sparse_feature_size=8,
+                             embedding_size=[64] * tables,
+                             embedding_bag_size=2,
+                             mlp_bot=[4, 16, 8],
+                             mlp_top=[8 * tables + 8, 16, 1])
+            fc = ff.FFConfig(batch_size=16, epoch_row_cache=cache,
+                             epoch_cache_inner=2)
+            m = build_dlrm(cfg, fc, stacked_embeddings=stacked,
+                           table_parallel=mesh_arg is not False)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=mesh_arg)
+            return cfg, m
+
+        cfg, m_mesh = build(mesh)
+        _, m_single = build(False)
+
+        # THE assertion of VERDICT item 1: fast path active under mesh
+        assert m_mesh._sparse_emb_ops, "sparse fast path inactive under mesh"
+        assert m_mesh._sparse_emb_ops == m_single._sparse_emb_ops
+        if cache == "on":
+            assert m_mesh._epoch_cache_active
+
+        inputs, labels = self._epoch_data(cfg, stacked=stacked)
+        st_m, st_s = m_mesh.init(seed=0), m_single.init(seed=0)
+        for _ in range(3):
+            st_m, mets_m = m_mesh.train_epoch(st_m, inputs, labels)
+            st_s, mets_s = m_single.train_epoch(st_s, inputs, labels)
+        assert float(mets_m["loss"]) == pytest.approx(
+            float(mets_s["loss"]), rel=1e-5)
+        for opn in st_s.params:
+            for k in st_s.params[opn]:
+                np.testing.assert_allclose(
+                    np.asarray(st_m.params[opn][k]),
+                    np.asarray(st_s.params[opn][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"{opn}/{k}")
+
+    def test_mesh_table_parallel_sharding_applied(self):
+        """The stacked table must actually be sharded over 'model' under
+        the table-parallel strategy (not replicated)."""
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 4, "model": 2})
+        _, m = _dlrm(stacked=True, mesh=mesh, table_parallel=True)
+        st = m.init(seed=0)
+        spec = st.params["emb"]["embedding"].sharding.spec
+        assert spec and spec[0] == "model", spec
+
+    def test_mesh_train_step_sparse(self):
+        """Per-step (non-epoch) path under mesh: fast path active and one
+        train_step matches the single-device step."""
+        from dlrm_flexflow_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 4, "model": 2})
+        cfg, m_mesh = _dlrm(stacked=True, mesh=mesh, table_parallel=True)
+        _, m_single = _dlrm(stacked=True)
+        assert m_mesh._sparse_emb_ops
+        inputs, labels = _batch(cfg)
+        st_m, st_s = m_mesh.init(seed=0), m_single.init(seed=0)
+        st_m, _ = m_mesh.train_step(st_m, inputs, labels)
+        st_s, _ = m_single.train_step(st_s, inputs, labels)
+        for opn in st_s.params:
+            for k in st_s.params[opn]:
+                np.testing.assert_allclose(
+                    np.asarray(st_m.params[opn][k]),
+                    np.asarray(st_s.params[opn][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"{opn}/{k}")
