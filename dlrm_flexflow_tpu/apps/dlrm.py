@@ -114,9 +114,12 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
                table_parallel: bool = False) -> FFModel:
     """Build the DLRM graph (reference top_level_task dlrm.cc:77-153).
 
-    ``stacked_embeddings``: fuse same-size tables into one sharded
-    (T, rows, dim) weight — the TPU-idiomatic table-parallel layout.
-    Defaults to True when all tables are the same size.
+    ``stacked_embeddings``: fuse the tables into one sharded weight — the
+    TPU-idiomatic table-parallel layout.  Same-size tables stack into a
+    (T, rows, dim) weight; different-size tables fuse into one ragged
+    (R_total, dim) row space with static offsets (the non-uniform
+    per-table placement of dlrm_strategy.cc:251-256 /
+    run_criteo_kaggle.sh).  Defaults to True.
     ``table_parallel``: mark embedding + interaction ops with model-axis
     strategies (the hybrid strategy of dlrm_strategy.cc:242-296).
     """
@@ -125,7 +128,7 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
     b = ffconfig.batch_size
     uniform = len(set(cfg.embedding_size)) == 1
     if stacked_embeddings is None:
-        stacked_embeddings = uniform
+        stacked_embeddings = True
     t = len(cfg.embedding_size)
     d = cfg.sparse_feature_size
 
@@ -134,11 +137,14 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
 
     emb_out = []
     if stacked_embeddings:
-        assert uniform, "stacked embeddings need uniform table sizes"
         ids = model.create_tensor((b, t, cfg.embedding_bag_size), "int64",
                                   name="sparse")
-        stacked = model.stacked_embedding(ids, t, cfg.embedding_size[0], d,
-                                          aggr="sum", name="emb")
+        if uniform:
+            stacked = model.stacked_embedding(ids, t, cfg.embedding_size[0],
+                                              d, aggr="sum", name="emb")
+        else:
+            stacked = model.ragged_stacked_embedding(
+                ids, cfg.embedding_size, d, aggr="sum", name="emb")
         if table_parallel:
             # shard the table axis (dim 1 of (B, T, d)) over "model"
             model.get_op("emb").parallel_config = ParallelConfig(

@@ -81,12 +81,13 @@ class SyntheticDLRMLoader(ArrayDataLoader):
         dense = rng.standard_normal((num_samples, num_dense), dtype=np.float32)
         inputs = {"dense": dense}
         if stacked:
-            sizes = set(int(s) for s in table_sizes)
-            assert len(sizes) == 1, "stacked path needs uniform table sizes"
-            rows = sizes.pop()
-            t = len(table_sizes)
-            inputs["sparse"] = rng.integers(
-                0, rows, size=(num_samples, t, bag_size), dtype=np.int64)
+            # per-column id ranges: column t draws from [0, rows_t) — the
+            # same (B, T, bag) layout serves uniform (StackedEmbedding)
+            # and ragged (RaggedStackedEmbedding) table sets
+            inputs["sparse"] = np.stack(
+                [rng.integers(0, int(rows), size=(num_samples, bag_size),
+                              dtype=np.int64) for rows in table_sizes],
+                axis=1)
         else:
             for i, rows in enumerate(table_sizes):
                 inputs[f"sparse_{i}"] = rng.integers(

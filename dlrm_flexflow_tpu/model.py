@@ -44,8 +44,9 @@ from .metrics import MetricsAccumulator, compute_metrics
 from .optim import Optimizer, SGDOptimizer
 from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
                   ElementBinary, ElementUnary, Embedding, Flat, Linear,
-                  MultiHeadAttention, Op, Pool2D, Reshape, Reverse, Softmax,
-                  Split, StackedEmbedding, Transpose)
+                  MultiHeadAttention, Op, Pool2D, RaggedStackedEmbedding,
+                  Reshape, Reverse, Softmax, Split, StackedEmbedding,
+                  Transpose)
 from .parallel.mesh import (DATA_AXIS, constrain, make_mesh, param_pspec,
                             pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
@@ -148,6 +149,17 @@ class FFModel:
                               input_tensor, num_tables, num_entries, out_dim,
                               aggr, kernel_initializer,
                               table_dtype=self._table_dtype(table_dtype))
+        return self._add(op)
+
+    def ragged_stacked_embedding(self, input_tensor, row_counts, out_dim,
+                                 aggr="sum", kernel_initializer=None,
+                                 name=None, table_dtype=None):
+        """T different-sized tables fused into one sharded row space (the
+        non-uniform per-table placement of dlrm_strategy.cc:251-256)."""
+        op = RaggedStackedEmbedding(
+            self._name("ragged_stacked_embedding", name), input_tensor,
+            row_counts, out_dim, aggr, kernel_initializer,
+            table_dtype=self._table_dtype(table_dtype))
         return self._add(op)
 
     def conv2d(self, input_tensor, out_channels, kernel_h, kernel_w,
@@ -494,7 +506,8 @@ class FFModel:
                 and self.optimizer.momentum == 0.0
                 and self.optimizer.weight_decay == 0.0):
             for op in self.layers:
-                if (isinstance(op, (Embedding, StackedEmbedding))
+                if (isinstance(op, (Embedding, StackedEmbedding,
+                                    RaggedStackedEmbedding))
                         and getattr(op, "placement", "tpu") != "cpu"
                         and not getattr(op, "use_pallas", False)
                         and op.inputs[0].uid in input_name_of
@@ -624,50 +637,42 @@ class FFModel:
                             or (cache_mode == "auto" and backend == "tpu")))
         self._epoch_cache_active = epoch_cache
 
-        def train_epoch(state: TrainState, inputs, labels):
-            """Scan a whole epoch on device — one dispatch for nb steps.
+        # ---- epoch row-cache pieces (shared by the single-epoch and the
+        # multi-epoch scanned programs) -----------------------------------
+        def build_cache(flat, ids, pack):
+            """Unique-slot cache of the rows ``ids`` touches in the
+            (R, d) source ``flat``: (cache, slots, uniq) or None when
+            the cache would not be smaller than the source.  Works on
+            concrete arrays (epoch prologue) and on traced values
+            (the in-scan inner level) alike — shapes are static."""
+            n_tot = int(np.prod(ids.shape))
+            # distinct rows can never exceed the source or the ids
+            size = min(n_tot, flat.shape[0])
+            sentinel = flat.shape[0]  # OOB -> dropped at writeback
+            # pad to the lane-pack multiple so the packed view
+            # applies to the cache too
+            m = -(-size // pack) * pack
+            if m >= flat.shape[0]:
+                return None
+            uniq, inv = jnp.unique(ids.reshape(-1), size=size,
+                                   fill_value=sentinel,
+                                   return_inverse=True)
+            if m > size:
+                uniq = jnp.concatenate(
+                    [uniq, jnp.full((m - size,), sentinel, uniq.dtype)])
+            cache = jnp.take(flat, uniq, axis=0, mode="clip")
+            return cache, inv.reshape(ids.shape), uniq
 
-            The TPU analogue of Legion tracing around the iteration body
-            (reference dlrm.cc:178-185 begin_trace/end_trace): the repeated
-            step is captured once and replayed without per-step host
-            dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
-            batches resident on device; ``labels``: (nb, batch, ...).
-            """
-            from .ops.pallas_scatter import lane_pack
+        from .ops.pallas_scatter import lane_pack
+        op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
+                   for op in sparse_emb}
 
-            # epoch row-cache prologue: per eligible op, map the epoch's
-            # ids to unique cache slots and pull the touched rows in with
-            # one table sweep
-            def build_cache(flat, ids, pack):
-                """Unique-slot cache of the rows ``ids`` touches in the
-                (R, d) source ``flat``: (cache, slots, uniq) or None when
-                the cache would not be smaller than the source.  Works on
-                concrete arrays (epoch prologue) and on traced values
-                (the in-scan inner level) alike — shapes are static."""
-                n_tot = int(np.prod(ids.shape))
-                # distinct rows can never exceed the source or the ids
-                size = min(n_tot, flat.shape[0])
-                sentinel = flat.shape[0]  # OOB -> dropped at writeback
-                # pad to the lane-pack multiple so the packed view
-                # applies to the cache too
-                m = -(-size // pack) * pack
-                if m >= flat.shape[0]:
-                    return None
-                uniq, inv = jnp.unique(ids.reshape(-1), size=size,
-                                       fill_value=sentinel,
-                                       return_inverse=True)
-                if m > size:
-                    uniq = jnp.concatenate(
-                        [uniq, jnp.full((m - size,), sentinel, uniq.dtype)])
-                cache = jnp.take(flat, uniq, axis=0, mode="clip")
-                return cache, inv.reshape(ids.shape), uniq
-
-            op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
-                       for op in sparse_emb}
-
+        def cache_prologue(state, inputs):
+            """Per eligible op, map the epoch's ids to unique cache slots
+            and pull the touched rows in with one table sweep.  Returns
+            (state-with-caches, slots, writebacks, orig_tables)."""
             params = dict(state.params)
-            slots_ep, writebacks = {}, []
-            orig_tables = {}
+            slots_ep, writebacks, orig_tables = {}, [], {}
             for op in (sparse_emb if epoch_cache else ()):
                 ids = inputs[id_name[op.name]].astype(jnp.int32)
                 tb = params[op.name]["embedding"]
@@ -685,7 +690,11 @@ class FFModel:
                 writebacks.append((op.name, tb.shape, uniq))
             state = TrainState(params, state.opt_state, state.bn_state,
                                state.rng, state.step)
+            return state, slots_ep, writebacks, orig_tables
 
+        def epoch_scan(state, inputs, labels, slots_ep):
+            """Scan one epoch's steps against the (cached) tables; returns
+            (state, per-epoch folded metrics)."""
             def body(st, batch):
                 binputs, blabels, bslots = batch
                 new_st, mets = train_step(st, binputs, blabels,
@@ -741,10 +750,16 @@ class FFModel:
             else:
                 state, mets = jax.lax.scan(body, state,
                                            (inputs, labels, slots_ep))
-            # epoch row-cache epilogue: write the final rows back, each
-            # unique slot exactly once (set, not add — bit-exact with the
-            # per-step path); sentinel indices (padding/duplicate fill)
-            # are dropped
+            folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
+                      for k, v in mets.items()}
+            return state, folded
+
+        def cache_epilogue(state, writebacks, orig_tables):
+            """Write the final rows back, each unique slot exactly once
+            (set, not add — bit-exact with the per-step path); sentinel
+            indices (padding/duplicate fill) are dropped."""
+            if not writebacks:
+                return state
             new_params = dict(state.params)
             for name, tb_shape, uniq in writebacks:
                 d = tb_shape[-1]
@@ -752,16 +767,46 @@ class FFModel:
                 flat = orig_tables[name].reshape(-1, d)
                 flat = flat.at[uniq].set(cache_final, mode="drop")
                 new_params[name] = {"embedding": flat.reshape(tb_shape)}
-            if writebacks:
-                state = TrainState(new_params, state.opt_state,
-                                   state.bn_state, state.rng, state.step)
-            folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
-                      for k, v in mets.items()}
-            return state, folded
+            return TrainState(new_params, state.opt_state,
+                              state.bn_state, state.rng, state.step)
+
+        def train_epoch(state: TrainState, inputs, labels):
+            """Scan a whole epoch on device — one dispatch for nb steps.
+
+            The TPU analogue of Legion tracing around the iteration body
+            (reference dlrm.cc:178-185 begin_trace/end_trace): the repeated
+            step is captured once and replayed without per-step host
+            dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
+            batches resident on device; ``labels``: (nb, batch, ...).
+            """
+            state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
+            state, folded = epoch_scan(state, inputs, labels, slots_ep)
+            return cache_epilogue(state, writebacks, orig), folded
+
+        def train_epochs(state: TrainState, inputs, labels, n_epochs: int):
+            """``n_epochs`` passes over the same stacked batches in ONE
+            dispatch: the row-cache prologue/epilogue (two full-table
+            sweeps) and the launch overhead amortize over ALL epochs
+            instead of one.  Bit-exact with ``n_epochs`` successive
+            ``train_epoch`` calls: each epoch's writeback/re-cache pair
+            is the identity on the cached rows, so keeping the cache live
+            across epochs performs the same adds on the same values.
+            Returns per-epoch folded metrics stacked on a leading
+            (n_epochs,) axis."""
+            state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
+
+            def ep_body(st, _):
+                return epoch_scan(st, inputs, labels, slots_ep)
+
+            state, stacked = jax.lax.scan(ep_body, state, None,
+                                          length=n_epochs)
+            return cache_epilogue(state, writebacks, orig), stacked
 
         donate = (0,) if donate_state else ()
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._train_epoch = jax.jit(train_epoch, donate_argnums=donate)
+        self._train_epochs = jax.jit(train_epochs, donate_argnums=donate,
+                                     static_argnums=(3,))
         self._eval_step = jax.jit(eval_step)
         self._forward_fn = jax.jit(forward)
         return self
@@ -907,6 +952,27 @@ class FFModel:
         if bounds is None:
             return self._train_epoch(state, inputs, labels)
         return self._run_epoch_chunks(state, inputs, labels, bounds)
+
+    def train_epochs(self, state: TrainState, inputs: Dict[str, Any],
+                     labels, epochs: int):
+        """``epochs`` passes over the stacked batches, fused into ONE
+        device dispatch when the epoch is unchunked — the row-cache's two
+        full-table sweeps and the launch overhead then amortize over all
+        epochs (short-epoch workloads like the Criteo-Kaggle config are
+        dominated by exactly those per-epoch fixed costs).  Falls back to
+        per-epoch dispatches for chunked epochs.  Returns per-epoch
+        folded metrics stacked on a leading (epochs,) axis."""
+        inputs, labels = self.place_dataset(inputs, labels)
+        bounds = self._epoch_chunk_bounds(labels.shape[0])
+        if bounds is None:
+            return self._train_epochs(state, inputs, labels, int(epochs))
+        mets = []
+        for _ in range(int(epochs)):
+            state, m = self._run_epoch_chunks(state, inputs, labels, bounds)
+            mets.append(m)
+        stacked = {k: np.stack([np.asarray(m[k]) for m in mets])
+                   for k in mets[0]}
+        return state, stacked
 
     def _epoch_chunk_bounds(self, nb: int):
         """(lo, hi) chunk slices for a chunked epoch dispatch, or None
@@ -1075,13 +1141,19 @@ class FFModel:
             first = dataloader.peek()
             state, _ = self.train_step(state, first[0], first[1])
             device_fence(state.step)
-        scan_fn, chunk_bounds, chunk_aot = None, None, None
+        scan_fn, chunk_bounds, chunk_aot, fused_fn = None, None, None, None
         if scan_data is not None:
             # AOT-compile the scanned epoch outside the timed window (the
             # reference's untimed epoch 0, dlrm.cc:178) without running
             # it; the compiled executable is invoked directly in the loop
             chunk_bounds = self._epoch_chunk_bounds(scan_data[1].shape[0])
-            if chunk_bounds is None:
+            if chunk_bounds is None and epochs > 1 and not cbs:
+                # no per-epoch host work pending: fuse ALL epochs into ONE
+                # dispatch (train_epochs) — launch overhead + row-cache
+                # sweeps amortize over the whole run
+                fused_fn = self._train_epochs.lower(
+                    state, *scan_data, epochs).compile()
+            elif chunk_bounds is None:
                 scan_fn = self._train_epoch.lower(state, *scan_data).compile()
             else:
                 # chunked epoch (epoch row-cache): precompile each
@@ -1095,7 +1167,18 @@ class FFModel:
                             slab[lo:hi]).compile()
         t0 = time.perf_counter()
         samples = 0
-        for epoch in range(epochs):
+        if fused_fn is not None:
+            # single-dispatch multi-epoch run (no callbacks to honor)
+            state, stacked = fused_fn(state, *scan_data)
+            samples = epochs * dataloader.num_batches * dataloader.batch_size
+            for epoch in range(epochs):
+                acc.reset()
+                acc.update({k: v[epoch] for k, v in stacked.items()
+                            if k != "loss"})
+                if verbose:
+                    print(f"epoch {epoch}: {acc.report()}")
+            self._fit_state = state
+        for epoch in range(epochs) if fused_fn is None else ():
             if epoch > 0:
                 for cb in cbs:
                     cb.on_epoch_begin(epoch)

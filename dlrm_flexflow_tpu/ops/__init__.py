@@ -2,7 +2,8 @@
 
 from .base import Op, activation_fn, matmul
 from .linear import Linear
-from .embedding import Embedding, StackedEmbedding
+from .embedding import (Embedding, RaggedStackedEmbedding,
+                        StackedEmbedding)
 from .elementwise import ElementBinary, ElementUnary
 from .shape_ops import (BatchMatmul, Concat, Flat, Reshape, Reverse, Split,
                         Transpose)
@@ -14,7 +15,7 @@ from .moe import MixtureOfExperts
 
 __all__ = [
     "Op", "activation_fn", "matmul",
-    "Linear", "Embedding", "StackedEmbedding",
+    "Linear", "Embedding", "StackedEmbedding", "RaggedStackedEmbedding",
     "ElementBinary", "ElementUnary",
     "BatchMatmul", "Concat", "Flat", "Reshape", "Reverse", "Split", "Transpose",
     "BatchNorm", "Conv2D", "Pool2D",

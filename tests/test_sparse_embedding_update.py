@@ -561,3 +561,75 @@ class TestMeshSparseFastPath:
                     np.asarray(st_m.params[opn][k]),
                     np.asarray(st_s.params[opn][k]),
                     rtol=1e-5, atol=1e-6, err_msg=f"{opn}/{k}")
+
+
+class TestMultiEpochFusion:
+    """train_epochs(n) — one dispatch for n epochs — must be bit-exact
+    with n successive train_epoch calls (the row cache stays live across
+    epochs; each epoch's writeback/re-cache pair is the identity)."""
+
+    @pytest.mark.parametrize("cache", ["on", "off"])
+    def test_train_epochs_matches_repeated_train_epoch(self, cache):
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=[64] * 4, embedding_bag_size=2,
+                         mlp_bot=[4, 16, 8], mlp_top=[8 * 4 + 8, 16, 1])
+
+        def build():
+            fc = ff.FFConfig(batch_size=16, epoch_row_cache=cache,
+                             epoch_cache_inner=2)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
+            return m
+
+        rng = np.random.default_rng(0)
+        nb = 4
+        inputs = {"dense": rng.standard_normal(
+            (nb, 16, 4)).astype(np.float32),
+            "sparse": rng.integers(0, 64, size=(nb, 16, 4, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, 16, 1)).astype(np.float32)
+
+        m1 = build()
+        st1 = m1.init(seed=0)
+        per_epoch = []
+        for _ in range(3):
+            st1, mets = m1.train_epoch(st1, inputs, labels)
+            per_epoch.append(mets)
+
+        m2 = build()
+        st2 = m2.init(seed=0)
+        st2, stacked = m2.train_epochs(st2, inputs, labels, 3)
+
+        for opn in st1.params:
+            for k in st1.params[opn]:
+                np.testing.assert_array_equal(
+                    np.asarray(st1.params[opn][k]),
+                    np.asarray(st2.params[opn][k]), err_msg=f"{opn}/{k}")
+        for k in stacked:
+            np.testing.assert_allclose(
+                np.asarray(stacked[k]),
+                np.asarray([m[k] for m in per_epoch]), rtol=1e-6)
+
+    def test_fit_uses_fused_multi_epoch(self):
+        """fit() with a scan-eligible loader and no callbacks runs all
+        epochs in one dispatch and reports per-epoch metrics."""
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=[64] * 4, embedding_bag_size=2,
+                         mlp_bot=[4, 16, 8], mlp_top=[8 * 4 + 8, 16, 1])
+        fc = ff.FFConfig(batch_size=16)
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy",), mesh=False)
+        st = m.init(seed=0)
+        loader = SyntheticDLRMLoader(64, 4, [64] * 4, 2, 16, stacked=True)
+        loader.shuffle = False
+        st, thpt = m.fit(st, loader, epochs=3, verbose=False)
+        assert m._last_fit_used_scan
+        assert thpt > 0
+        assert int(st.step) == 1 + 3 * loader.num_batches  # warmup + 3 ep
