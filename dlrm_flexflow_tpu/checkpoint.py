@@ -41,20 +41,37 @@ def _unflatten(flat):
     return tree
 
 
+def _host_tables_of(model) -> dict:
+    """CPU-placed embedding tables (hetero strategy) live OUTSIDE the
+    device params — in the host-RAM side store (ops/hetero.py); a full
+    checkpoint must carry them too, keyed by op name."""
+    if model is None:
+        return {}
+    return {op.name: op.host_table.array
+            for op in getattr(model, "_hetero_ops", [])
+            if hasattr(op, "host_table")}
+
+
 def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
-                    use_orbax: Optional[bool] = None) -> str:
-    """Write a checkpoint directory; returns the path written."""
+                    use_orbax: Optional[bool] = None, model=None) -> str:
+    """Write a checkpoint directory; returns the path written.
+
+    Pass ``model`` to include its CPU-placed (hetero) embedding tables —
+    they are host-resident and invisible to the TrainState pytree."""
     os.makedirs(path, exist_ok=True)
     if use_orbax is None:
         use_orbax = _orbax_available()
     meta = {"step": int(state.step) if step is None else step,
             "format": "orbax" if use_orbax else "npz"}
+    host_tables = _host_tables_of(model)
     if use_orbax:
         import orbax.checkpoint as ocp
 
         ckpt = {"params": state.params, "opt_state": state.opt_state,
                 "bn_state": state.bn_state, "rng": state.rng,
                 "step": state.step}
+        if host_tables:
+            ckpt["host_tables"] = host_tables
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(os.path.join(path, "state"), ckpt, force=True)
     else:
@@ -65,6 +82,8 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
                      _flatten(state.opt_state).items()})
         flat.update({f"bn_state/{k}": v for k, v in
                      _flatten(state.bn_state).items()})
+        flat.update({f"host_tables/{k}": v
+                     for k, v in host_tables.items()})
         flat["rng"] = state.rng
         flat["step"] = state.step
         np.savez(os.path.join(path, "state.npz"),
@@ -79,6 +98,7 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
     mesh, parameters are re-placed with their strategy shardings."""
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    host_tables = {}
     if meta["format"] == "orbax":
         import orbax.checkpoint as ocp
 
@@ -87,9 +107,11 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
         state = TrainState(ckpt["params"], ckpt["opt_state"],
                            ckpt["bn_state"], jnp.asarray(ckpt["rng"]),
                            jnp.asarray(ckpt["step"]))
+        host_tables = ckpt.get("host_tables", {}) or {}
     else:
         data = np.load(os.path.join(path, "state.npz"))
-        groups: dict = {"params": {}, "opt_state": {}, "bn_state": {}}
+        groups: dict = {"params": {}, "opt_state": {}, "bn_state": {},
+                        "host_tables": {}}
         rng = step = None
         for k in data.files:
             if k == "rng":
@@ -102,8 +124,15 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
         state = TrainState(_unflatten(groups["params"]),
                            _unflatten(groups["opt_state"]),
                            _unflatten(groups["bn_state"]), rng, step)
-    if model is not None and getattr(model, "mesh", None) is not None:
-        state = model._place_state(state)
+        host_tables = {k: np.asarray(v)
+                       for k, v in groups["host_tables"].items()}
+    if model is not None:
+        # put hetero CPU tables back into the host-RAM side store
+        for op in getattr(model, "_hetero_ops", []):
+            if op.name in host_tables and hasattr(op, "host_table"):
+                op.host_table.array = np.asarray(host_tables[op.name])
+        if getattr(model, "mesh", None) is not None:
+            state = model._place_state(state)
     return state
 
 

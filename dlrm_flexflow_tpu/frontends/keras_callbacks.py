@@ -141,7 +141,8 @@ class ModelCheckpoint(Callback):
         if state is None:
             return
         path = self.filepath.format(epoch=epoch)
-        save_checkpoint(path, state)
+        # pass the model so hetero CPU-placed tables are included
+        save_checkpoint(path, state, model=_ffmodel_of(self.model))
         self.saved.append(path)
         if self.verbose:
             print(f"checkpoint saved: {path}")

@@ -22,6 +22,33 @@ import jax.numpy as jnp
 from ..tensor import ParameterSpec, Tensor
 
 
+def part_coords(pc, ndim: int, idx: int):
+    """Decompose a flat part index into per-dim coordinates of the op's
+    N-D part grid (dim 0 fastest — matches the simulator's rect walk)."""
+    dims = list(pc.dims) + [1] * (ndim - len(pc.dims))
+    coords, rem = [], idx
+    for d in range(ndim):
+        coords.append(rem % dims[d])
+        rem //= dims[d]
+    return coords
+
+
+def rect_of_part(pc, shape, idx: int):
+    """The (lo, hi) sub-rectangle of a ``shape``-shaped tensor owned by
+    part ``idx`` under ParallelConfig ``pc`` (reference N-D block
+    partitioning, config.h:41-50)."""
+    dims = list(pc.dims) + [1] * (len(shape) - len(pc.dims))
+    coords = part_coords(pc, len(shape), idx)
+    lo, hi = [], []
+    for d in range(len(shape)):
+        nd = max(dims[d], 1)
+        sz = shape[d] // nd
+        c = coords[d]
+        lo.append(c * sz)
+        hi.append((c + 1) * sz if c < nd - 1 else shape[d])
+    return tuple(lo), tuple(hi)
+
+
 class Op:
     """One graph node.
 
@@ -78,6 +105,29 @@ class Op:
         (the reference instead times real kernels, simulator.cc:235-273;
         we support both measured and analytic costs)."""
         return 0
+
+    def input_rect(self, pc, input_idx: int, part_idx: int):
+        """The (lo, hi) sub-rectangle of input ``input_idx`` that output
+        part ``part_idx`` READS under output ParallelConfig ``pc`` — the
+        per-op hook the simulator uses to size comm tasks (the reference
+        computes these true input rects when inserting xfer tasks,
+        simulator.cc:200-233).
+
+        Default: a batch (dim 0) partition maps through when the input
+        shares the output's batch extent; every other input dim is read
+        in FULL (e.g. a channel-parallel Linear part holds a weight
+        column shard but consumes the whole input row — the replica
+        semantics of linear.cu:214-263)."""
+        ishape = self.inputs[input_idx].shape
+        oshape = self.outputs[0].shape
+        lo, hi = [0] * len(ishape), list(ishape)
+        nd0 = pc.dims[0] if pc.dims else 1
+        if (nd0 > 1 and ishape and oshape and ishape[0] == oshape[0]):
+            c = part_coords(pc, len(oshape), part_idx)[0]
+            sz = ishape[0] // nd0
+            lo[0] = c * sz
+            hi[0] = (c + 1) * sz if c < nd0 - 1 else ishape[0]
+        return tuple(lo), tuple(hi)
 
     def __repr__(self):
         return f"{type(self).__name__}({self.name})"

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..initializers import DEFAULT_BIAS_INIT, DEFAULT_KERNEL_INIT
 from ..tensor import ParameterSpec
-from .base import Op, activation_fn
+from .base import Op, rect_of_part, activation_fn
 
 
 def _out_dim(size, kernel, stride, pad):
@@ -105,6 +105,38 @@ class Conv2D(Op):
         return 2 * batch * co * oh * ow * kh * kw * self.in_channels // self.groups
 
 
+    def input_rect(self, pc, input_idx, part_idx):
+        """Spatial parts read kernel halos (conv_2d.cu partitions); a
+        conv part reads ALL input channels, a pool part (depthwise) only
+        its own channel range."""
+        return _spatial_input_rect(self, pc, part_idx,
+                                   channels_map_through=False)
+
+
+
+def _spatial_input_rect(op, pc, part_idx, channels_map_through):
+    """True (N, C, H, W) input rectangle of one output part: batch maps
+    through; channels map through for depthwise ops (pooling) and are
+    read in full otherwise (conv reads every input channel); H/W extend
+    by the kernel footprint (out*stride - pad .. (out_hi-1)*stride - pad
+    + k), clipped (reference 4-D conv partitions, conv_2d.cu)."""
+    lo, hi = rect_of_part(pc, op.outputs[0].shape, part_idx)
+    ishape = op.inputs[0].shape
+    if channels_map_through:
+        clo, chi = lo[1], hi[1]
+    else:
+        clo, chi = 0, ishape[1]
+    kh, kw = op.kernel
+    sh, sw = op.stride
+    ph, pw = op.padding
+    return ((lo[0], clo,
+             max(lo[2] * sh - ph, 0),
+             max(lo[3] * sw - pw, 0)),
+            (hi[0], chi,
+             min((hi[2] - 1) * sh - ph + kh, ishape[2]),
+             min((hi[3] - 1) * sw - pw + kw, ishape[3])))
+
+
 class Pool2D(Op):
     op_type = "Pool2D"
 
@@ -138,6 +170,13 @@ class Pool2D(Op):
             y = s / (kh * kw)
         y = activation_fn(self.activation)(y)
         return [y]
+
+
+    def input_rect(self, pc, input_idx, part_idx):
+        """Pooling is depthwise: the channel range maps through; H/W read
+        kernel halos."""
+        return _spatial_input_rect(self, pc, part_idx,
+                                   channels_map_through=True)
 
 
 class BatchNorm(Op):

@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import Op
+from .base import Op, rect_of_part
 
 _UNARY = {
     "exp": jnp.exp,
@@ -79,6 +79,10 @@ class ElementUnary(Op):
             return [jnp.power(x, self.scalar)]
         return [_UNARY[self.fn](x)]
 
+    def input_rect(self, pc, input_idx, part_idx):
+        """Pointwise: each part reads exactly its own rectangle."""
+        return rect_of_part(pc, self.inputs[0].shape, part_idx)
+
 
 class ElementBinary(Op):
     """Binary pointwise op.  The reference requires identical shapes
@@ -98,3 +102,11 @@ class ElementBinary(Op):
     def forward(self, params, xs, *, training=False, rng=None):
         a, b = xs
         return [_BINARY[self.fn](a, b)]
+
+    def input_rect(self, pc, input_idx, part_idx):
+        """Same-shape elementwise: each part reads exactly its own
+        rectangle of the input (broadcast inputs fall back to the
+        default batch-maps-through rule)."""
+        if self.inputs[input_idx].shape != self.outputs[0].shape:
+            return super().input_rect(pc, input_idx, part_idx)
+        return rect_of_part(pc, self.inputs[input_idx].shape, part_idx)

@@ -23,29 +23,43 @@ import numpy as np
 
 
 class HostEmbeddingTable:
-    """A table resident in host RAM (never device_put).  Registered as a
-    side store keyed by name because jit traces cannot close over
-    mutable host arrays through the params pytree."""
+    """A table resident in host RAM (never device_put).  Registered in a
+    side store because jit traces cannot close over mutable host arrays
+    through the params pytree.
+
+    The store key is INSTANCE-unique (``<op name>@<op id>``), not the op
+    name: two models that both have an op called "emb" must not collide
+    in the process-wide store (the trace bakes the key in as a static
+    callback argument, so it must also be stable across re-inits of the
+    same op — which it is, the op object persists)."""
 
     _tables = {}
 
-    def __init__(self, name: str, array: np.ndarray):
-        self.name = name
-        HostEmbeddingTable._tables[name] = np.ascontiguousarray(
+    def __init__(self, key: str, array: np.ndarray):
+        self.key = key
+        HostEmbeddingTable._tables[key] = np.ascontiguousarray(
             array, np.float32)
 
     @property
     def array(self) -> np.ndarray:
-        return HostEmbeddingTable._tables[self.name]
+        return HostEmbeddingTable._tables[self.key]
 
     @array.setter
     def array(self, v):
-        HostEmbeddingTable._tables[self.name] = np.ascontiguousarray(
+        HostEmbeddingTable._tables[self.key] = np.ascontiguousarray(
             v, np.float32)
+
+    @classmethod
+    def drop(cls, key: str):
+        """Evict a table (and its deposited grad) from the store —
+        registered as a weakref finalizer on the owning op so dead
+        models release their host RAM."""
+        cls._tables.pop(key, None)
+        cls._tables.pop(key + "/grad", None)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def host_embedding_bag(ids, handle, table_name: str, dim: int,
+def host_embedding_bag(ids, handle, table_key: str, dim: int,
                        mode: str = "sum"):
     """(B, bag) int ids -> (B, dim) via the host-resident table.
 
@@ -55,14 +69,14 @@ def host_embedding_bag(ids, handle, table_name: str, dim: int,
     scatter-add.  The forward multiplies by ``handle`` (=1, a no-op); the
     cotangent path through it forces the backward callback to run.
     """
-    return _host_fwd_impl(ids, table_name, dim, mode) * handle
+    return _host_fwd_impl(ids, table_key, dim, mode) * handle
 
 
-def _host_fwd_impl(ids, table_name, dim, mode):
+def _host_fwd_impl(ids, table_key, dim, mode):
     def cb(ids_np):
         from ..data import native as N
 
-        table = HostEmbeddingTable._tables[table_name]
+        table = HostEmbeddingTable._tables[table_key]
         if N.native_available():
             return N.embedding_bag_cpu(table, ids_np, mode)
         rows = table[ids_np]
@@ -72,12 +86,12 @@ def _host_fwd_impl(ids, table_name, dim, mode):
     return jax.pure_callback(cb, out_shape, ids)
 
 
-def _fwd(ids, handle, table_name, dim, mode):
-    out = _host_fwd_impl(ids, table_name, dim, mode) * handle
+def _fwd(ids, handle, table_key, dim, mode):
+    out = _host_fwd_impl(ids, table_key, dim, mode) * handle
     return out, (ids, handle, out)
 
 
-def _bwd(table_name, dim, mode, res, g):
+def _bwd(table_key, dim, mode, res, g):
     """Deposit the scatter-add gradient for the HOST table (the hetero
     optimizer path: CPU tables update on the host, reference
     dlrm_strategy_hetero.cc semantics); cotangents flow only to the
@@ -86,7 +100,7 @@ def _bwd(table_name, dim, mode, res, g):
     def cb(ids_np, g_np):
         from ..data import native as N
 
-        table = HostEmbeddingTable._tables[table_name]
+        table = HostEmbeddingTable._tables[table_key]
         if N.native_available():
             gw = N.embedding_bag_cpu_grad(g_np, ids_np, table.shape[0], mode)
         else:
@@ -95,7 +109,7 @@ def _bwd(table_name, dim, mode, res, g):
             for b in range(ids_np.shape[0]):
                 for j in range(ids_np.shape[1]):
                     gw[ids_np[b, j]] += g_np[b] * scale
-        HostEmbeddingTable._tables[table_name + "/grad"] = gw
+        HostEmbeddingTable._tables[table_key + "/grad"] = gw
         return np.zeros((), np.float32)
 
     token = jax.pure_callback(cb, jax.ShapeDtypeStruct((), jnp.float32),
@@ -112,6 +126,6 @@ host_embedding_bag.defvjp(_fwd, _bwd)
 def apply_host_sgd(table: HostEmbeddingTable, lr: float):
     """Host-side SGD step for a CPU-placed table using the gradient the
     backward callback deposited."""
-    g = HostEmbeddingTable._tables.get(table.name + "/grad")
+    g = HostEmbeddingTable._tables.get(table.key + "/grad")
     if g is not None:
         table.array = table.array - lr * g

@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .base import Op
+from .base import Op, rect_of_part
 
 
 class Softmax(Op):
@@ -28,6 +28,11 @@ class Softmax(Op):
 
     def forward(self, params, xs, *, training=False, rng=None):
         return [jax.nn.softmax(xs[0], axis=self.axis)]
+
+    def input_rect(self, pc, input_idx, part_idx):
+        """Pointwise over the non-softmax dims; parts never split the
+        softmax axis in practice, so the identity rect is exact."""
+        return rect_of_part(pc, self.inputs[0].shape, part_idx)
 
 
 class Dropout(Op):
@@ -50,3 +55,7 @@ class Dropout(Op):
         keep = 1.0 - self.rate
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]
+
+    def input_rect(self, pc, input_idx, part_idx):
+        """Pointwise: each part reads exactly its own rectangle."""
+        return rect_of_part(pc, self.inputs[0].shape, part_idx)

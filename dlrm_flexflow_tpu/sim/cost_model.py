@@ -88,11 +88,12 @@ class CostModel:
     """
 
     def __init__(self, machine: Optional[TPUMachineModel] = None,
-                 measure: bool = False, measure_iters: int = 5):
+                 measure: bool = False, measure_iters: int = 24):
         self.machine = machine or TPUMachineModel()
         self.measure = measure
         self.measure_iters = measure_iters
         self._cache: Dict[Tuple, Tuple[float, float]] = {}
+        self._null_dispatch: Optional[float] = None  # measured lazily
 
     # ---- helpers -----------------------------------------------------------
     @staticmethod
@@ -114,7 +115,14 @@ class CostModel:
         if self.measure:
             try:
                 fwd, bwd = self._measure_op(op, num_parts)
-            except Exception:
+            except Exception as e:
+                # fall back, but LOUDLY — a silent fallback would bias the
+                # search with analytic numbers while claiming measured ones
+                import warnings
+                warnings.warn(
+                    f"measured cost for {op.name} ({type(op).__name__}) "
+                    f"failed ({type(e).__name__}: {e}); using analytic "
+                    "estimate", RuntimeWarning)
                 fwd, bwd = self._analytic_op(op, num_parts)
         else:
             fwd, bwd = self._analytic_op(op, num_parts)
@@ -122,18 +130,26 @@ class CostModel:
         return fwd, bwd
 
     # ---- analytic ----------------------------------------------------------
+    @staticmethod
+    def _nbytes(dtype) -> int:
+        return int(np.dtype(dtype).itemsize)
+
     def _analytic_op(self, op, num_parts: int) -> Tuple[float, float]:
         m = self.machine
         batch = op.outputs[0].shape[0] if op.outputs[0].ndim else 1
         flops = op.flops(batch) / max(num_parts, 1)
-        in_bytes = sum(4 * t.numel() for t in op.inputs) / max(num_parts, 1)
-        out_bytes = sum(4 * t.numel() for t in op.outputs) / max(num_parts, 1)
-        w_bytes = sum(4 * int(np.prod(s.shape)) for s in op.param_specs())
-        fwd = max(m.matmul_time(flops),
+        compute_dtype = getattr(op, "compute_dtype", None) or "float32"
+        in_bytes = sum(self._nbytes(t.dtype) * t.numel()
+                       for t in op.inputs) / max(num_parts, 1)
+        out_bytes = sum(self._nbytes(t.dtype) * t.numel()
+                        for t in op.outputs) / max(num_parts, 1)
+        w_bytes = sum(self._nbytes(s.dtype) * int(np.prod(s.shape))
+                      for s in op.param_specs())
+        fwd = max(m.matmul_time(flops, str(compute_dtype)),
                   m.memory_time(in_bytes + out_bytes + w_bytes))
         fwd += m.kernel_launch_overhead
         # backward ~ 2x forward FLOPs (dgrad+wgrad), same traffic + grads
-        bwd = max(m.matmul_time(2 * flops),
+        bwd = max(m.matmul_time(2 * flops, str(compute_dtype)),
                   m.memory_time(2 * (in_bytes + out_bytes) + 2 * w_bytes))
         bwd += m.kernel_launch_overhead
         return fwd, bwd
@@ -157,8 +173,10 @@ class CostModel:
             shp = part_shape(t.shape)
             if "int" in str(np.dtype(t.dtype)):
                 hi = getattr(op, "num_entries", 2)
-                xs.append(jnp.asarray(rng.integers(0, hi, size=shp),
-                                      dtype=t.dtype))
+                ids = rng.integers(0, hi, size=shp)
+                if not jax.config.jax_enable_x64:
+                    ids = ids.astype(np.int32)
+                xs.append(jnp.asarray(ids))
             else:
                 xs.append(jnp.asarray(
                     rng.standard_normal(shp).astype(np.float32)))
@@ -167,33 +185,77 @@ class CostModel:
         def fwd_fn(params, xs):
             return op.forward(params, list(xs), training=False)[0]
 
-        jfwd = jax.jit(fwd_fn)
+        # embedding-family ops train through the row-sparse kernels
+        # (gather_rows + scatter_apply); their dense-autodiff backward —
+        # a table-shaped scatter-add — never runs in training under plain
+        # SGD, and its compile is pathological at big-table sizes, so
+        # measure the kernels the step actually executes.
+        sparse_capable = (hasattr(op, "gather_rows")
+                          and hasattr(op, "scatter_apply")
+                          and "embedding" in params)
 
-        def loss_fn(params, xs):
-            outs = op.forward(params, list(xs), training=False)
-            return sum(jnp.sum(o * o) for o in outs
-                       if jnp.issubdtype(o.dtype, jnp.floating))
+        if sparse_capable:
+            def bwd_fn(params, xs):
+                tb = params["embedding"]
+                rows = op.gather_rows(tb, xs[0])
+                return op.scatter_apply(tb, xs[0], rows, -0.01)
+        else:
+            def loss_fn(params, xs):
+                outs = op.forward(params, list(xs), training=False)
+                return sum(jnp.sum(o * o) for o in outs
+                           if jnp.issubdtype(o.dtype, jnp.floating))
 
-        diff_x = [i for i, t in enumerate(op.inputs)
-                  if not np.issubdtype(np.dtype(t.dtype), np.integer)]
-
-        def bwd_fn(params, xs):
-            grads = jax.grad(loss_fn, argnums=0)(params, xs)
-            return grads
-
-        jbwd = jax.jit(bwd_fn)
+            def bwd_fn(params, xs):
+                return jax.grad(loss_fn, argnums=0)(params, xs)
 
         from ..profiling import device_fence
 
-        def timeit(f, *args):
-            out = f(*args)
-            device_fence(out)  # block_until_ready can return early (tunnel)
-            t0 = time.perf_counter()
-            for _ in range(self.measure_iters):
-                out = f(*args)
-            device_fence(out)
-            return (time.perf_counter() - t0) / self.measure_iters
+        # On the tunneled platform every host->device dispatch costs
+        # ~5 ms (PERF.md) — per-launch timing would swamp sub-ms kernels.
+        # So chain ``measure_iters`` executions INSIDE one compiled
+        # lax.scan (an optimization_barrier threads the carry through the
+        # inputs so XLA cannot hoist the loop-invariant computation) and
+        # subtract one measured null-dispatch.
+        iters = self.measure_iters
 
-        fwd = timeit(jfwd, params, xs)
-        bwd = timeit(jbwd, params, xs) if params else fwd
+        def chained(f):
+            def body(c, _):
+                xs_b, c_b = jax.lax.optimization_barrier((tuple(xs), c))
+                out = f(params, list(xs_b))
+                leaves = [o for o in jax.tree_util.tree_leaves(out)
+                          if hasattr(o, "dtype")
+                          and jnp.issubdtype(o.dtype, jnp.floating)]
+                nxt = (jnp.ravel(leaves[0])[0].astype(jnp.float32)
+                       if leaves else jnp.float32(0.0))
+                return nxt + 0.0 * c_b, None
+
+            return jax.jit(lambda: jax.lax.scan(
+                body, jnp.float32(0.0), None, length=iters)[0])
+
+        if self._null_dispatch is None:
+            null = jax.jit(lambda: jnp.float32(0.0))
+            device_fence(null())
+            best_null = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                device_fence(null())
+                best_null = min(best_null, time.perf_counter() - t0)
+            self._null_dispatch = best_null
+
+        def timeit(f):
+            g = chained(f)
+            device_fence(g())  # compile
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                device_fence(g())
+                best = min(best, time.perf_counter() - t0)
+            # iters is large enough that kernel time dominates the one
+            # dispatch; subtracting the best-case null keeps small ops
+            # from being billed the launch overhead
+            return max((best - self._null_dispatch) / iters,
+                       best / (4 * iters), 1e-9)
+
+        fwd = timeit(fwd_fn)
+        bwd = timeit(bwd_fn) if params else fwd
         return fwd, bwd

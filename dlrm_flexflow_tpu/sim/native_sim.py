@@ -35,7 +35,7 @@ def get_lib() -> ctypes.CDLL:
         p = ctypes.c_void_p
         d = ctypes.c_double
         lib.ffsim_create.argtypes = [i64, i64] + [p] * 11 + [i64] + \
-            [p] * 4 + [d, d]
+            [p] * 6 + [i64, ctypes.c_int32, d, d]
         lib.ffsim_create.restype = p
         lib.ffsim_simulate.argtypes = [p, p]
         lib.ffsim_simulate.restype = d
@@ -76,9 +76,11 @@ class NativeSimulator:
 
     def __init__(self, model, num_devices: int,
                  candidates: Dict[str, List[ParallelConfig]],
-                 cost_model: Optional[CostModel] = None):
+                 cost_model: Optional[CostModel] = None,
+                 overlap_backward_update: bool = False):
         self.model = model
         self.num_devices = num_devices
+        self.overlap = overlap_backward_update
         self.costs = cost_model or CostModel()
         self.machine = self.costs.machine
         self.op_names = [op.name for op in model.layers]
@@ -136,14 +138,23 @@ class NativeSimulator:
         # the traversal order the engine's edge cursor assumes
         name_to_idx = {op.name: i for i, op in enumerate(ops)}
         e_src, e_dst, e_ndim, e_shape = [], [], [], []
+        # per-edge TRUE input rects for every (dst candidate, part) —
+        # the host-side evaluation of Op.input_rect the engine indexes by
+        # (edge_rect_off + candidate part_prefix + part)
+        e_rect_off, rect_pool = [], []
         for i, op in enumerate(ops):
-            for inp in op.inputs:
+            for input_idx, inp in enumerate(op.inputs):
                 if inp.owner_op is None:
                     continue
                 e_src.append(name_to_idx[inp.owner_op.name])
                 e_dst.append(i)
                 e_ndim.append(len(inp.shape))
                 e_shape.append(_pad_dims(inp.shape))
+                e_rect_off.append(len(rect_pool))
+                for pc in self.candidates[op.name]:
+                    for part in range(pc.num_parts):
+                        lo, hi = op.input_rect(pc, input_idx, part)
+                        rect_pool.append(_pad_dims(lo) + _pad_dims(hi))
 
         self._arrays = dict(
             op_ndim=op_ndim, op_shape=op_shape.ravel(),
@@ -159,6 +170,9 @@ class NativeSimulator:
             edge_ndim=np.asarray(e_ndim, np.int64),
             edge_shape=(np.asarray(e_shape, np.int64).ravel()
                         if e_shape else np.zeros(0, np.int64)),
+            edge_rect_off=np.asarray(e_rect_off, np.int64),
+            rect_pool=(np.asarray(rect_pool, np.int64).ravel()
+                       if rect_pool else np.zeros(0, np.int64)),
         )
         a = self._arrays
         lib = get_lib()
@@ -170,7 +184,9 @@ class NativeSimulator:
             _ptr(a["cand_bwd"]), _ptr(a["cand_dev_off"]),
             _ptr(a["cand_dev_pool"]), len(e_src),
             _ptr(a["edge_src"]), _ptr(a["edge_dst"]), _ptr(a["edge_ndim"]),
-            _ptr(a["edge_shape"]),
+            _ptr(a["edge_shape"]), _ptr(a["edge_rect_off"]),
+            _ptr(a["rect_pool"]), len(a["rect_pool"]),
+            1 if self.overlap else 0,
             float(self.machine.ici_bandwidth),
             float(self.machine.hbm_bandwidth))
         if not self._handle:
@@ -178,7 +194,8 @@ class NativeSimulator:
 
     @classmethod
     def for_strategy(cls, model, num_devices: int, strategy: Strategy,
-                     cost_model: Optional[CostModel] = None
+                     cost_model: Optional[CostModel] = None,
+                     overlap_backward_update: bool = False
                      ) -> "NativeSimulator":
         """A one-candidate-per-op instance for evaluating a fixed
         strategy (parity with Simulator.simulate)."""
@@ -189,7 +206,8 @@ class NativeSimulator:
                 pc = ParallelConfig.data_parallel(op.outputs[0].ndim,
                                                   num_devices)
             cands[op.name] = [pc]
-        return cls(model, num_devices, cands, cost_model)
+        return cls(model, num_devices, cands, cost_model,
+                   overlap_backward_update=overlap_backward_update)
 
     def _indices_for(self, strategy: Strategy) -> np.ndarray:
         idx = np.zeros(len(self.op_names), np.int64)

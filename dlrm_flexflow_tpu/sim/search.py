@@ -90,8 +90,22 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
     in C++ too, model.cc:1093-1144); "python" forces the in-process
     implementation; "auto" prefers native when the library builds and no
     custom ``simulator``/``on_iteration`` hooks are requested.
+
+    Cost source: when no ``simulator`` is passed and the active backend
+    is a real TPU, per-op costs are MEASURED on the chip (the
+    reference's approach — real kernels on simulator scratch,
+    simulator.cc:235-273, linear.cu:973-1049); elsewhere (CPU test
+    meshes) the analytic roofline is used.
     """
     rng = random.Random(seed)
+
+    cost_model = None
+    if simulator is None:
+        import jax
+
+        from .cost_model import CostModel
+        if jax.default_backend() == "tpu":
+            cost_model = CostModel(measure=True)
 
     # start from data-parallel (reference model.cc:1102)
     current = data_parallel_strategy(model, num_devices)
@@ -121,7 +135,7 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
         try:
             nsim = NativeSimulator(
                 model, num_devices, full_cands,
-                cost_model=simulator.costs if simulator else None)
+                cost_model=simulator.costs if simulator else cost_model)
         except (OSError, subprocess.CalledProcessError):
             # build/load failure only — anything else is a real bug and
             # propagates; without a toolchain fall back to Python
@@ -136,7 +150,7 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
                       f"{best_time*1e3:.3f} ms over {budget} iters")
             return best
 
-    sim = simulator or Simulator(model, num_devices)
+    sim = simulator or Simulator(model, num_devices, cost_model=cost_model)
     ops = [op for op in model.layers if len(candidates[op.name]) > 1]
 
     def copy_strategy(s: Strategy) -> Strategy:

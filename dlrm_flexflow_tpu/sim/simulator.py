@@ -63,20 +63,7 @@ def _part_devices(pc: ParallelConfig) -> List[int]:
     return list(range(pc.num_parts))
 
 
-def _rect_of_part(pc: ParallelConfig, shape: Tuple[int, ...], idx: int):
-    """The sub-rectangle of the output tensor owned by part ``idx``
-    (reference ParallelConfig N-D block partitioning, config.h:41-50)."""
-    dims = list(pc.dims) + [1] * (len(shape) - len(pc.dims))
-    lo, hi = [], []
-    rem = idx
-    for d in range(len(shape)):
-        nd = dims[d]
-        coord = rem % nd
-        rem //= nd
-        sz = shape[d] // max(nd, 1)
-        lo.append(coord * sz)
-        hi.append((coord + 1) * sz if coord < nd - 1 else shape[d])
-    return tuple(lo), tuple(hi)
+from ..ops.base import rect_of_part as _rect_of_part  # noqa: E402
 
 
 def _overlap_bytes(lo1, hi1, lo2, hi2, dtype_bytes=4) -> int:
@@ -101,6 +88,19 @@ class Simulator:
         self.costs = cost_model or CostModel()
         self.machine = self.costs.machine
         self.overlap = overlap_backward_update
+        # multiplicative calibration against a real measured step (the
+        # reference tunes its simulator the same way — hard-coded
+        # bandwidth constants fitted to the cluster, simulator.cu:27-29);
+        # set via calibrate().
+        self.scale = 1.0
+
+    def calibrate(self, strategy: Strategy, real_step_time: float) -> float:
+        """Fit ``scale`` so simulate(strategy) == real_step_time; returns
+        the factor.  Use one config to calibrate, others to validate —
+        relative comparisons (what the search needs) are unaffected."""
+        raw = self.simulate(strategy) / self.scale
+        self.scale = real_step_time / raw if raw > 0 else 1.0
+        return self.scale
 
     # ------------------------------------------------------------------ build
     def _build_tasks(self, strategy: Strategy):
@@ -131,7 +131,7 @@ class Simulator:
             dst_pc = _parts_of(strategy.configs.get(op.name),
                                op.outputs[0].ndim, self.num_devices)
             dst_devs = _part_devices(dst_pc)
-            for inp in op.inputs:
+            for input_idx, inp in enumerate(op.inputs):
                 src = inp.owner_op
                 if src is None:
                     continue
@@ -140,9 +140,11 @@ class Simulator:
                 src_devs = _part_devices(src_pc)
                 shape = inp.shape
                 for di in range(dst_pc.num_parts):
-                    # destination reads its input rectangle = its output
-                    # rect projected onto the input (approx: batch dim only)
-                    dlo, dhi = _rect_of_part(dst_pc, shape, di)
+                    # TRUE input rectangle this part reads (per-op hook —
+                    # e.g. a channel-parallel Linear part reads the FULL
+                    # input, a Concat part reads an axis-shifted slice;
+                    # reference simulator.cc:200-233)
+                    dlo, dhi = op.input_rect(dst_pc, input_idx, di)
                     for si in range(src_pc.num_parts):
                         slo, shi = _rect_of_part(src_pc, shape, si)
                         nbytes = _overlap_bytes(slo, shi, dlo, dhi)
@@ -177,6 +179,17 @@ class Simulator:
         # weight synchronization (reference simulator.cc:327-408): for each
         # op with params replicated over K parts, add a ring all-reduce of
         # the gradient + an update task.
+        #   overlap mode — each op's grad sync + update starts as soon as
+        #   ITS OWN backward parts finish, overlapping the rest of the
+        #   backward pass (the reference's overlap branch).
+        #   bulk-sync mode — a global barrier after the LAST backward
+        #   precedes every update (barrier + update phase, the reference's
+        #   non-overlap branch).
+        barrier = None
+        if not self.overlap:
+            barrier = new_task("bwd-barrier", 0, 0.0, "barrier")
+            for t in bwd_of.values():
+                t.add_next(barrier)
         update_tasks = []
         for op in self.model.layers:
             specs = op.param_specs()
@@ -191,11 +204,25 @@ class Simulator:
             replicas = pc.dims[0] if pc.dims else 1
             shard = wbytes / max(k // max(replicas, 1), 1)
             ar = self.machine.all_reduce_time(shard, replicas)
-            upd = SimTask(f"{op.name}:update", _part_devices(pc)[0],
-                          ar + self.machine.memory_time(2 * shard), "update")
+            dev0 = _part_devices(pc)[0]
+            upd = SimTask(f"{op.name}:update", dev0,
+                          self.machine.memory_time(2 * shard), "update")
+            # the grad all-reduce is a comm task on the NETWORK rail: ICI
+            # collectives run asynchronously with compute, so in overlap
+            # mode an op's grad sync rides under later backwards — the
+            # modeled win of reference simulator.cc:327-408's overlap
+            # branch (bulk-sync holds it behind the barrier instead)
+            sync = None
+            if ar > 0.0:
+                sync = new_task(f"{op.name}:gradsync", dev0, ar, "comm")
+                sync.add_next(upd)
             tasks.append(upd)
-            for i in range(k):
-                bwd_of[(op.name, i)].add_next(upd)
+            head = sync if sync is not None else upd
+            if barrier is not None:
+                barrier.add_next(head)
+            else:
+                for i in range(k):
+                    bwd_of[(op.name, i)].add_next(head)
             update_tasks.append(upd)
 
         return tasks, update_tasks
@@ -205,7 +232,11 @@ class Simulator:
         """Event-driven simulation over per-device timelines
         (reference simulator.cc:410-447)."""
         tasks, update_tasks = self._build_tasks(strategy)
+        # two rails per device: compute units and the ICI/network DMA
+        # engine — TPU collectives overlap with compute (async DMA), so
+        # comm tasks contend only with other comm on the same chip
         device_free = [0.0] * self.num_devices
+        net_free = [0.0] * self.num_devices
         ready: List[Tuple[float, int, SimTask]] = []
         seq = 0
         for t in tasks:
@@ -217,9 +248,10 @@ class Simulator:
         while ready:
             rt, _, t = heapq.heappop(ready)
             dev = t.device % self.num_devices if t.device >= 0 else 0
-            start = max(rt, device_free[dev])
+            rail = net_free if t.kind == "comm" else device_free
+            start = max(rt, rail[dev])
             end = start + t.run_time
-            device_free[dev] = end
+            rail[dev] = end
             makespan = max(makespan, end)
             done += 1
             for nxt in t.next_tasks:
@@ -231,8 +263,4 @@ class Simulator:
         if done != len(tasks):
             raise RuntimeError(f"simulated {done}/{len(tasks)} tasks — "
                                "dependency cycle in SimTask DAG")
-        if not self.overlap:
-            # bulk-sync: updates happen after the last backward; already
-            # modeled through dependencies, nothing extra
-            pass
-        return makespan
+        return makespan * self.scale

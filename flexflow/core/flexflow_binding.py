@@ -712,8 +712,22 @@ class FFModel:
     def forward(self):
         state = self._require_state()
         inputs, _ = self._batch_inputs()
+        # memoize per (state, batch): reference scripts that step layers
+        # one-by-one call each Op's forward(), which funnels here — without
+        # the cache that re-executes the whole fused graph per op
+        # (O(layers^2) work per step).  Only jax.Arrays are cacheable by
+        # identity: an attached numpy buffer can be refilled IN PLACE
+        # between calls (same id, new contents), so those always recompute.
+        immutable = all(isinstance(v, jax.Array) for v in inputs.values())
+        token = (id(state), tuple(sorted((k, id(v))
+                                         for k, v in inputs.items())))
+        if immutable and getattr(self, "_fwd_token", None) == token:
+            return
         values, _ = self._forward_values(state, inputs)
         self._last_values = values
+        self._fwd_token = token if immutable else None
+        # hold the referents so their ids cannot be recycled while cached
+        self._fwd_token_refs = (state, dict(inputs))
 
     def _forward_values(self, state, inputs):
         # cache one jitted all-values forward (first call compiles)

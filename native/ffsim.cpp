@@ -26,6 +26,8 @@ struct Candidate {
   int64_t dims[MAXD];    // partition counts, padded with 1
   int64_t ndim;          // logical dims length (op output ndim)
   int64_t num_parts;
+  int64_t part_prefix;   // sum of num_parts over earlier candidates of
+                         // the op (indexes the per-edge rect block)
   double fwd, bwd;       // per-part times at this partitioning
   std::vector<int64_t> devices;  // part -> device id
 };
@@ -43,6 +45,8 @@ struct Edge {
   int64_t src, dst;
   int64_t ndim;
   int64_t shape[MAXD];
+  int64_t rect_off;  // index into Model::rect_pool (rect units) of this
+                     // edge's [dst candidate][part] input-rect block
 };
 
 struct Task {
@@ -50,6 +54,7 @@ struct Task {
   double ready_time;
   int64_t device;
   int64_t counter;
+  bool is_comm;  // network-rail task (ICI DMA overlaps with compute)
   std::vector<int32_t> next;
 };
 
@@ -58,6 +63,10 @@ struct Model {
   std::vector<OpInfo> ops;
   std::vector<Edge> edges;
   double ici_bw, hbm_bw;
+  bool overlap;  // overlap weight-sync with backward vs bulk-sync barrier
+  // per-edge, per-dst-candidate, per-part TRUE input rectangles computed
+  // by the Python layer via Op.input_rect (reference simulator.cc:200-233)
+  std::vector<int64_t> rect_pool;  // rect = 2*MAXD int64 (lo, hi)
   // scratch reused across simulate() calls
   std::vector<Task> tasks;
 };
@@ -106,8 +115,9 @@ double simulate(Model& m, const int64_t* cand_idx) {
   auto& tasks = m.tasks;
   tasks.clear();
 
-  auto new_task = [&](int64_t device, double rt) -> int32_t {
-    tasks.push_back(Task{rt, 0.0, device, 0, {}});
+  auto new_task = [&](int64_t device, double rt,
+                      bool is_comm = false) -> int32_t {
+    tasks.push_back(Task{rt, 0.0, device, 0, is_comm, {}});
     return static_cast<int32_t>(tasks.size() - 1);
   };
 
@@ -142,7 +152,16 @@ double simulate(Model& m, const int64_t* cand_idx) {
       const Candidate& src_c = m.ops[e.src].cands[cand_idx[e.src]];
       Rect dr, sr;
       for (int64_t di = 0; di < dst_c.num_parts; ++di) {
-        rect_of_part(dst_c, e.shape, e.ndim, di, &dr);
+        // TRUE input rect of this dst part (precomputed host-side via
+        // Op.input_rect — channel-parallel consumers read full inputs,
+        // concat parts read axis-shifted slices, ...)
+        const int64_t* rp =
+            m.rect_pool.data() +
+            (e.rect_off + dst_c.part_prefix + di) * 2 * MAXD;
+        for (int64_t d = 0; d < e.ndim; ++d) {
+          dr.lo[d] = rp[d];
+          dr.hi[d] = rp[MAXD + d];
+        }
         for (int64_t si = 0; si < src_c.num_parts; ++si) {
           rect_of_part(src_c, e.shape, e.ndim, si, &sr);
           int64_t nbytes = overlap_bytes(sr, dr, e.ndim);
@@ -156,10 +175,10 @@ double simulate(Model& m, const int64_t* cand_idx) {
             add_dep(tasks, db, sb);
           } else {
             double ct = static_cast<double>(nbytes) / m.ici_bw;
-            int32_t cf = new_task(ddev, ct);
+            int32_t cf = new_task(ddev, ct, true);
             add_dep(tasks, sf, cf);
             add_dep(tasks, cf, df);
-            int32_t cb = new_task(sdev, ct);
+            int32_t cb = new_task(sdev, ct, true);
             add_dep(tasks, db, cb);
             add_dep(tasks, cb, sb);
           }
@@ -171,7 +190,18 @@ double simulate(Model& m, const int64_t* cand_idx) {
   }
 
   // weight synchronization (reference simulator.cc:327-408): ring
-  // all-reduce over the data-dim replicas + one update task
+  // all-reduce over the data-dim replicas + one update task.  Bulk-sync
+  // (default) places a global barrier after the LAST backward before any
+  // update; overlap mode lets each op's update chase its own backward.
+  int32_t barrier = -1;
+  if (!m.overlap) {
+    barrier = new_task(0, 0.0);
+    for (int64_t oi = 0; oi < static_cast<int64_t>(m.ops.size()); ++oi) {
+      const Candidate& c = m.ops[oi].cands[cand_idx[oi]];
+      for (int64_t i = 0; i < c.num_parts; ++i)
+        add_dep(tasks, bwd_of(oi, i), barrier);
+    }
+  }
   for (int64_t oi = 0; oi < static_cast<int64_t>(m.ops.size()); ++oi) {
     OpInfo& op = m.ops[oi];
     if (!op.has_params) continue;
@@ -187,9 +217,21 @@ double simulate(Model& m, const int64_t* cand_idx) {
       ar = (2.0 * static_cast<double>(replicas - 1) /
             static_cast<double>(replicas) * shard) /
            m.ici_bw;
-    double rt = ar + (2.0 * shard) / m.hbm_bw;
-    int32_t upd = new_task(c.devices[0], rt);
-    for (int64_t i = 0; i < k; ++i) add_dep(tasks, bwd_of(oi, i), upd);
+    // grad all-reduce = comm task on the network rail (overlaps with
+    // compute); update = memory-bound compute task
+    int32_t upd = new_task(c.devices[0], (2.0 * shard) / m.hbm_bw);
+    int32_t head = upd;
+    if (ar > 0.0) {
+      int32_t sync = new_task(c.devices[0], ar, true);
+      add_dep(tasks, sync, upd);
+      head = sync;
+    }
+    if (barrier >= 0) {
+      add_dep(tasks, barrier, head);
+    } else {
+      for (int64_t i = 0; i < k; ++i)
+        add_dep(tasks, bwd_of(oi, i), head);
+    }
   }
 
   // event-driven simulation over per-device timelines (reference
@@ -200,6 +242,7 @@ double simulate(Model& m, const int64_t* cand_idx) {
                       std::greater<>>
       ready;
   std::vector<double> device_free(m.num_devices, 0.0);
+  std::vector<double> net_free(m.num_devices, 0.0);
   int64_t seq = 0;
   for (int32_t t = 0; t < static_cast<int32_t>(tasks.size()); ++t)
     if (tasks[t].counter == 0)
@@ -211,9 +254,10 @@ double simulate(Model& m, const int64_t* cand_idx) {
     ready.pop();
     Task& t = tasks[st.second];
     int64_t dev = t.device >= 0 ? t.device % m.num_devices : 0;
-    double start = std::max(rt, device_free[dev]);
+    auto& rail = t.is_comm ? net_free : device_free;
+    double start = std::max(rt, rail[dev]);
     double end = start + t.run_time;
-    device_free[dev] = end;
+    rail[dev] = end;
     makespan = std::max(makespan, end);
     ++done;
     for (int32_t ni : t.next) {
@@ -240,11 +284,15 @@ void* ffsim_create(int64_t num_ops, int64_t num_devices,
                    const int64_t* cand_dev_pool, int64_t num_edges,
                    const int64_t* edge_src, const int64_t* edge_dst,
                    const int64_t* edge_ndim, const int64_t* edge_shape,
+                   const int64_t* edge_rect_off, const int64_t* rect_pool,
+                   int64_t rect_pool_len, int32_t overlap,
                    double ici_bw, double hbm_bw) {
   Model* m = new Model();
   m->num_devices = num_devices;
   m->ici_bw = ici_bw;
   m->hbm_bw = hbm_bw;
+  m->overlap = overlap != 0;
+  m->rect_pool.assign(rect_pool, rect_pool + rect_pool_len);
   m->ops.resize(num_ops);
   for (int64_t i = 0; i < num_ops; ++i) {
     OpInfo& op = m->ops[i];
@@ -253,6 +301,7 @@ void* ffsim_create(int64_t num_ops, int64_t num_devices,
     op.wbytes = op_wbytes[i];
     op.has_params = op_has_params[i] != 0;
     op.cands.resize(cand_cnt[i]);
+    int64_t prefix = 0;
     for (int64_t j = 0; j < cand_cnt[i]; ++j) {
       int64_t g = cand_off[i] + j;
       Candidate& c = op.cands[j];
@@ -260,6 +309,8 @@ void* ffsim_create(int64_t num_ops, int64_t num_devices,
       c.ndim = op.ndim;
       c.num_parts = 1;
       for (int d = 0; d < MAXD; ++d) c.num_parts *= c.dims[d];
+      c.part_prefix = prefix;
+      prefix += c.num_parts;
       c.fwd = cand_fwd[g];
       c.bwd = cand_bwd[g];
       c.devices.assign(cand_dev_pool + cand_dev_off[g],
@@ -273,6 +324,7 @@ void* ffsim_create(int64_t num_ops, int64_t num_devices,
     m->edges[e].ndim = edge_ndim[e];
     std::memcpy(m->edges[e].shape, edge_shape + e * MAXD,
                 sizeof(m->edges[e].shape));
+    m->edges[e].rect_off = edge_rect_off[e];
   }
   return m;
 }

@@ -1,0 +1,108 @@
+"""Calibrate the execution simulator against the real chip.
+
+Builds a DLRM config with the sparse/cache fast paths DISABLED (the
+simulator models the dense per-op execution the reference simulates:
+dense forward/backward per op + optimizer update), measures the real
+fenced per-step time of the scanned epoch, and compares it with
+``Simulator.simulate`` under a MEASURED cost model (reference
+simulator.cc:235-273 times real kernels the same way).
+
+Prints one JSON line {"real_ms", "sim_ms", "ratio", "probe_us"}; the
+current ratio is recorded in PERF.md.  Run on the TPU:
+
+    python scripts/calibrate_sim.py [rows] [batch]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_config(rows, batch, cost_model, nb=16, reps=3):
+    """(real fenced per-step seconds, simulated seconds) for one DLRM
+    config under the measured cost model."""
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.profiling import device_fence
+    from dlrm_flexflow_tpu.sim import Simulator
+    from dlrm_flexflow_tpu.sim.search import data_parallel_strategy
+
+    cfg = DLRMConfig()
+    cfg.embedding_size = [rows] * 8
+    fc = ff.FFConfig(batch_size=batch,
+                     sparse_embedding_updates="off",
+                     epoch_row_cache="off")
+    model = build_dlrm(cfg, fc)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error", metrics=(),
+                  mesh=False)
+    state = model.init(seed=0)
+
+    rng = np.random.default_rng(0)
+    inputs = {
+        "dense": rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32),
+        "sparse": rng.integers(
+            0, rows, size=(nb, batch, 8, cfg.embedding_bag_size),
+            dtype=np.int64),
+    }
+    labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+    inputs, labels = model.place_dataset(inputs, labels)
+    state, _ = model.train_epoch(state, inputs, labels)  # compile
+    device_fence(state.step)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, _ = model.train_epoch(state, inputs, labels)
+        device_fence(state.step)
+        best = min(best, time.perf_counter() - t0)
+    real_step = best / nb
+
+    sim = Simulator(model, 1, cost_model=cost_model)
+    sim_step = sim.simulate(data_parallel_strategy(model, 1))
+    return real_step, sim_step
+
+
+def calibrate_and_validate(cal=(50_000, 128), val=(100_000, 256)):
+    """Fit the one-scalar calibration on ``cal``, validate transfer on
+    ``val``; returns a dict with both ratios."""
+    from dlrm_flexflow_tpu.sim import CostModel
+
+    cm = CostModel(measure=True)
+    cal_real, cal_sim = measure_config(*cal, cost_model=cm)
+    scale = cal_real / cal_sim
+    val_real, val_sim = measure_config(*val, cost_model=cm)
+    try:
+        from scripts.probe_chip import probe
+        probe_us = probe()
+    except Exception:
+        probe_us = -1.0
+    return {
+        "cal_config": list(cal), "val_config": list(val),
+        "cal_real_ms": round(cal_real * 1e3, 3),
+        "cal_sim_ms": round(cal_sim * 1e3, 3),
+        "scale": round(scale, 4),
+        "val_real_ms": round(val_real * 1e3, 3),
+        "val_sim_raw_ms": round(val_sim * 1e3, 3),
+        "val_sim_cal_ms": round(val_sim * scale * 1e3, 3),
+        "val_ratio_calibrated": round(val_sim * scale / val_real, 3),
+        "probe_us": round(probe_us, 1),
+    }
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2:
+        rows, batch = int(sys.argv[1]), int(sys.argv[2])
+        from dlrm_flexflow_tpu.sim import CostModel
+        real, sim = measure_config(rows, batch,
+                                   cost_model=CostModel(measure=True))
+        print(json.dumps({"real_ms": round(real * 1e3, 3),
+                          "sim_ms": round(sim * 1e3, 3),
+                          "ratio": round(sim / real, 3)}))
+    else:
+        print(json.dumps(calibrate_and_validate()))

@@ -119,3 +119,70 @@ class TestSparseRowUpdatePallas:
         assert not supports_pallas_row_update(1_000_001, 64, 4096)  # pack
         assert not supports_pallas_row_update(1_000_000, 48, 4096)  # 128%48
         assert not supports_pallas_row_update(1_000_000, 64, 100)   # block
+
+
+class TestPackedViewOnCPU:
+    """packed_gather / packed_scatter_add are backend-agnostic XLA ops —
+    exercise them directly on the CPU suite (ADVICE r1: use_packed_view
+    gates them off-TPU, so without these tests an indexing bug would only
+    surface on hardware)."""
+
+    @pytest.mark.parametrize("rows,dim", [(64, 16), (128, 32), (48, 8)])
+    def test_packed_gather_equals_take(self, rows, dim):
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import (packed_gather,
+                                                          pack_factor)
+
+        assert pack_factor(rows, dim) > 1
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.standard_normal((rows, dim)).astype(np.float32))
+        # ids crossing every pack boundary + duplicates + edge rows
+        pack = 128 // dim
+        ids = np.array([0, 1, pack - 1, pack, pack + 1, rows - 1, rows - 1,
+                        rows - pack, 2 * pack - 1, 0], dtype=np.int32)
+        got = packed_gather(table, jnp.asarray(ids))
+        want = jnp.take(table, jnp.asarray(ids), axis=0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # arbitrary-rank ids
+        ids2 = jnp.asarray(ids.reshape(2, 5))
+        np.testing.assert_array_equal(
+            np.asarray(packed_gather(table, ids2)),
+            np.asarray(jnp.take(table, ids2, axis=0)))
+
+    @pytest.mark.parametrize("rows,dim", [(64, 16), (48, 8)])
+    def test_packed_scatter_add_equals_at_add(self, rows, dim):
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import packed_scatter_add
+
+        rng = np.random.default_rng(1)
+        table = jnp.asarray(rng.standard_normal((rows, dim)).astype(np.float32))
+        pack = 128 // dim
+        # duplicates must accumulate; include pack-boundary + last rows
+        ids = np.array([0, 0, 1, pack - 1, pack, rows - 1, rows - 1,
+                        rows - pack], dtype=np.int32)
+        upd = jnp.asarray(rng.standard_normal(
+            (len(ids), dim)).astype(np.float32))
+        got = packed_scatter_add(table, jnp.asarray(ids), upd)
+        want = table.at[jnp.asarray(ids)].add(upd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_gather_scatter_layout_agreement(self):
+        """The invariant the fast path rests on: a gather through the
+        packed view followed by a packed scatter of the SAME rows at
+        scale -1 restores the table exactly."""
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import (packed_gather,
+                                                          packed_scatter_add)
+
+        rng = np.random.default_rng(2)
+        table = jnp.asarray(rng.standard_normal((64, 16)).astype(np.float32))
+        ids = jnp.asarray(np.array([3, 9, 17, 63], dtype=np.int32))
+        rows = packed_gather(table, ids)
+        zeroed = packed_scatter_add(table, ids, -rows)
+        readded = packed_scatter_add(zeroed, ids, rows)
+        np.testing.assert_allclose(np.asarray(readded), np.asarray(table),
+                                   rtol=1e-6, atol=1e-6)

@@ -187,3 +187,142 @@ class TestStandaloneCLI:
                     "candle_uno", "nmt"]:
             m = build_app(app, 16)
             assert m.layers, app
+
+
+class TestInputRects:
+    """True per-op input rectangles (VERDICT r1 item 5): the comm volume
+    between producer and consumer parts must follow what each consumer
+    part actually READS, not a projection of its output partitioning
+    (reference add_task_dependencies_with_xfer, simulator.cc:200-233)."""
+
+    def test_linear_tp_comm_bytes_hand_computed(self):
+        """DP(2) producer -> channel-parallel(2) Linear consumer over an
+        (8, 4) f32 activation: each TP part reads the FULL input, so each
+        of the 2 cross-device (src part, dst part) pairs moves half the
+        tensor = 4*4*4 = 64 bytes, in fwd and in grad direction."""
+        m = ff.FFModel(ff.FFConfig(batch_size=8))
+        x = m.create_tensor((8, 4), name="x")
+        h = m.dense(x, 4, name="dense1")
+        m.dense(h, 6, name="dense2")
+        s = Strategy()
+        s["dense1"] = ParallelConfig(dims=(2, 1))   # DP over 2 devices
+        s["dense2"] = ParallelConfig(dims=(1, 2))   # TP over 2 devices
+
+        sim = Simulator(m, 2)
+        tasks, _ = sim._build_tasks(s)
+        fwd_comm = [t for t in tasks
+                    if t.kind == "comm" and t.name == "dense1->dense2"]
+        bwd_comm = [t for t in tasks
+                    if t.kind == "comm" and t.name == "dense2->dense1:grad"]
+        # dst part0 (dev0) pulls src part1's rows (dev1) and vice versa
+        assert len(fwd_comm) == 2 and len(bwd_comm) == 2
+        want = sim.machine.ici_time(64)
+        for t in fwd_comm + bwd_comm:
+            assert t.run_time == want
+
+    def test_linear_tp_part_reads_full_input(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=8))
+        x = m.create_tensor((8, 4), name="x")
+        m.dense(x, 6, name="dense")
+        op = m.get_op("dense")
+        pc = ParallelConfig(dims=(1, 2))
+        for part in range(2):
+            lo, hi = op.input_rect(pc, 0, part)
+            assert (lo, hi) == ((0, 0), (8, 4))
+
+    def test_concat_rect_hand_computed(self):
+        """concat([(8,4), (8,6)], axis=1) -> (8,10), split 2x on the
+        concat axis: part0 covers cols 0-5 -> reads all of input0 and
+        cols 0-1 of input1; part1 covers cols 5-10 -> reads nothing of
+        input0 and cols 1-6 of input1."""
+        m = ff.FFModel(ff.FFConfig(batch_size=8))
+        a = m.create_tensor((8, 4), name="a")
+        b = m.create_tensor((8, 6), name="b")
+        m.concat([a, b], axis=1, name="cat")
+        op = m.get_op("cat")
+        pc = ParallelConfig(dims=(1, 2))
+        assert op.input_rect(pc, 0, 0) == ((0, 0), (8, 4))
+        assert op.input_rect(pc, 1, 0) == ((0, 0), (8, 1))
+        lo, hi = op.input_rect(pc, 0, 1)
+        assert lo[1] == hi[1]  # empty: part1 reads none of input0
+        assert op.input_rect(pc, 1, 1) == ((0, 1), (8, 6))
+
+    def test_batch_matmul_rects(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=4))
+        a = m.create_tensor((4, 6, 8), name="a")
+        b = m.create_tensor((4, 8, 10), name="b")
+        m.batch_matmul(a, b, name="bmm")
+        op = m.get_op("bmm")
+        pc = ParallelConfig(dims=(2, 1, 1))  # batch split
+        # part1: batch rows 2-4; A reads (2:4, :, :), B reads (2:4, :, :)
+        assert op.input_rect(pc, 0, 1) == ((2, 0, 0), (4, 6, 8))
+        assert op.input_rect(pc, 1, 1) == ((2, 0, 0), (4, 8, 10))
+
+    def test_transpose_rect_permutes(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=4))
+        x = m.create_tensor((4, 6, 8), name="x")
+        m.transpose(x, name="t")  # (4, 8, 6)
+        op = m.get_op("t")
+        pc = ParallelConfig(dims=(2, 1, 1))
+        # output part1 rows 2-4 -> input rows 2-4, full inner dims
+        assert op.input_rect(pc, 0, 1) == ((2, 0, 0), (4, 6, 8))
+
+    def test_elementwise_identity_rect(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=8))
+        x = m.create_tensor((8, 4), name="x")
+        m.relu(x, name="r")
+        op = m.get_op("r")
+        pc = ParallelConfig(dims=(2, 1))
+        assert op.input_rect(pc, 0, 0) == ((0, 0), (4, 4))
+        assert op.input_rect(pc, 0, 1) == ((4, 0), (8, 4))
+
+    def test_conv_halo_rect(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=2))
+        x = m.create_tensor((2, 3, 16, 16), name="x")
+        m.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="conv")  # same-pad 3x3
+        op = m.get_op("conv")
+        pc = ParallelConfig(dims=(1, 1, 2, 1))  # H split in two
+        # part0: out rows 0-8 -> in rows 0..(7*1-1+3)=9 (one-row halo)
+        lo, hi = op.input_rect(pc, 0, 0)
+        assert (lo[2], hi[2]) == (0, 9)
+        assert (lo[1], hi[1]) == (0, 3)  # all input channels
+        # part1: out rows 8-16 -> in rows 7..16
+        lo, hi = op.input_rect(pc, 0, 1)
+        assert (lo[2], hi[2]) == (7, 16)
+
+
+class TestOverlapMode:
+    """Weight-sync modeling (VERDICT r1 item 5, reference
+    simulator.cc:327-408): bulk-sync barriers every update behind the
+    LAST backward; overlap lets each op's grad sync + update chase its
+    own backward — the flag must change the simulated makespan."""
+
+    def _model(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=64))
+        x = m.create_tensor((64, 64), name="x")
+        h = m.dense(x, 256, name="dense1")
+        m.dense(h, 8, name="dense2")
+        s = Strategy()
+        s["dense1"] = ParallelConfig.data_parallel(2, 2)
+        s["dense2"] = ParallelConfig.data_parallel(2, 2)
+        return m, s
+
+    def test_overlap_strictly_faster(self):
+        m, s = self._model()
+        bulk = Simulator(m, 2, overlap_backward_update=False).simulate(s)
+        over = Simulator(m, 2, overlap_backward_update=True).simulate(s)
+        assert over < bulk
+
+    def test_native_parity_both_modes(self):
+        from dlrm_flexflow_tpu.sim.native_sim import (NativeSimulator,
+                                                      native_available)
+        if not native_available():
+            import pytest
+            pytest.skip("native lib unavailable")
+        m, s = self._model()
+        for overlap in (False, True):
+            py = Simulator(m, 2,
+                           overlap_backward_update=overlap).simulate(s)
+            nat = NativeSimulator.for_strategy(
+                m, 2, s, overlap_backward_update=overlap).simulate(s)
+            assert abs(py - nat) < 1e-9, (overlap, py, nat)
