@@ -23,12 +23,15 @@ program chain produces — stalled 120 s until the real work finished.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference repo publishes no numbers (BASELINE.md) — vs_baseline is
 computed against the FIRST *fenced* bench_history.json entry whose shape
-config (batch/num_batches/epochs/rows) matches this run.  Entries recorded
+config (batch/num_batches/epochs/rows/emb_dtype) matches this run; table
+storage dtype changes numerics, so fp32 and bf16 runs anchor separately
+(entries predating the field count as float32).  Entries recorded
 before the device_fence fix (block_until_ready could return early on the
 tunneled platform, so those values are not comparable) are kept for the
-record but never used as the anchor.  The precision default is credited as
-a framework optimization, so dtype is intentionally NOT part of the match
-key.  No matching anchor -> 1.0.
+record but never used as the anchor.  The COMPUTE precision default
+(bf16 MXU, f32 accumulation/master weights) is credited as a framework
+optimization, so "dtype" is intentionally NOT part of the match key.
+No matching anchor -> 1.0.
 """
 
 import json
@@ -185,10 +188,11 @@ def main():
 
     cfg = DLRMConfig()  # run_random.sh architecture
     cfg.embedding_size = [rows] * 8
-    # bf16 table storage halves the full-table sweep that dominates the
-    # step (PERF.md); like compute_dtype, credited as a framework
-    # optimization (BENCH_EMB_DTYPE=float32 for fp32 tables)
-    emb_dtype = os.environ.get("BENCH_EMB_DTYPE", "bfloat16")
+    # fp32 table storage is the default: like-for-like with the
+    # reference's fp32 tables and with the fp32 anchor entry (emb_dtype
+    # is part of the history key — advisor r1).  BENCH_EMB_DTYPE=bfloat16
+    # measures the halved-sweep variant, anchored separately.
+    emb_dtype = os.environ.get("BENCH_EMB_DTYPE", "float32")
     ffconfig = ff.FFConfig(batch_size=batch, compute_dtype=dtype,
                            embedding_dtype=emb_dtype)
     model = build_dlrm(cfg, ffconfig)
