@@ -71,18 +71,21 @@ def spmd_pipeline(stage_fn: Callable, mesh: Mesh, num_microbatches: int,
             return buf, outs
 
         _, outs = jax.lax.fori_loop(0, ticks, tick, (buf, outs))
-        # only the last stage holds real outputs (zeros elsewhere); psum
-        # broadcasts them so the out spec is genuinely replicated
-        return jax.lax.psum(outs, axis)
+        # only the last stage holds real outputs — emit them under a
+        # stage-sharded out spec (leading pipe axis); the caller slices
+        # stage s-1, so the data moves ONCE from the last stage when
+        # consumed instead of riding a full 2(n-1)/n psum all-reduce
+        return outs[None]
 
     def apply(stacked_params, x):
         pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-        return jax.shard_map(
+        staged = jax.shard_map(
             per_device, mesh=mesh,
             in_specs=(pspec, P()),
-            out_specs=P(),
+            out_specs=P(axis),
             check_vma=False,
         )(stacked_params, x)
+        return staged[s - 1]
 
     return apply
 
