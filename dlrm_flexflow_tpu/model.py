@@ -880,9 +880,16 @@ class FFModel:
 
     def shard_batch(self, arr):
         """Place a host batch onto the mesh's data axis (the analogue of the
-        reference dataloader's per-point scatter tasks, dlrm.cc:486-589)."""
+        reference dataloader's per-point scatter tasks, dlrm.cc:486-589).
+
+        Multi-process arrays (assembled per host via
+        ``distributed.make_global_array``) pass through untouched — they
+        are already globally placed and a device_put cannot address the
+        remote shards."""
         if self.mesh is None:
             return jnp.asarray(arr)
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            return arr
         from jax.sharding import PartitionSpec
         ndim = getattr(arr, "ndim", None)
         if ndim is None:
@@ -922,6 +929,10 @@ class FFModel:
         the dataset once and keep re-timed epochs transfer-free."""
         if self.mesh is None:
             return jnp.asarray(arr)
+        # multi-process arrays are already globally placed; a device_put
+        # cannot address the remote shards (same contract as shard_batch)
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            return arr
         from jax.sharding import PartitionSpec
         dsize = self.mesh.shape.get(DATA_AXIS, 1)
         if dsize > 1 and arr.shape[1] % dsize == 0:
