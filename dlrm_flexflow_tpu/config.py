@@ -77,6 +77,13 @@ class FFConfig:
     # per-step sweep scales with the block, not the chunk (measured
     # optimum 8 with chunk 256, PERF.md).  0 disables.
     epoch_cache_inner: int = 8
+    # Manual table-parallel exchange for StackedEmbedding under a mesh
+    # ("off"|"allgather"|"all_to_all"): route the table-sharded lookup
+    # through an explicit shard_map + ICI collective
+    # (parallel/table_exchange.py) instead of letting XLA SPMD pick the
+    # collectives.  Dense-path only (the row-sparse fast path is
+    # disabled for exchanged ops).  "off" (default) = SPMD-automatic.
+    table_exchange: str = "off"
     # fit()'s scanned-epoch fast path stages the whole dataset on device;
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)

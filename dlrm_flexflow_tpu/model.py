@@ -436,6 +436,30 @@ class FFModel:
             self.mesh = make_mesh(self.config.mesh_shape)
         for op in self.layers:
             op._mesh = self.mesh  # ops with manual collectives (ring attn)
+        xmode = getattr(self.config, "table_exchange", "off")
+        if xmode not in ("off", "allgather", "all_to_all"):
+            raise ValueError(
+                f"table_exchange must be 'off'|'allgather'|'all_to_all', "
+                f"got {xmode!r}")
+        for op in self.layers:
+            if not isinstance(op, StackedEmbedding):
+                continue
+            engage = xmode != "off"
+            if engage:
+                # only engage when the exchange can actually run — else
+                # the op would lose the sparse fast path AND fall back to
+                # the plain dense lookup (worst of both)
+                mp = (self.mesh.shape.get("model", 1)
+                      if self.mesh is not None else 1)
+                if mp <= 1 or op.num_tables % mp != 0:
+                    import warnings
+                    warnings.warn(
+                        f"table_exchange={xmode!r} requested but "
+                        f"{op.name} cannot engage it (model axis {mp}, "
+                        f"{op.num_tables} tables); using the automatic "
+                        "SPMD path instead", RuntimeWarning)
+                    engage = False
+            op.exchange_mode = xmode if engage else None
 
         # label tensor (reference model.cc:1046-1060: dims copied from final
         # output; 1 class-dim entry for sparse CCE)
@@ -510,6 +534,7 @@ class FFModel:
                                     RaggedStackedEmbedding))
                         and getattr(op, "placement", "tpu") != "cpu"
                         and not getattr(op, "use_pallas", False)
+                        and not getattr(op, "exchange_mode", None)
                         and op.inputs[0].uid in input_name_of
                         and not (sparse_mode == "auto" and backend == "tpu"
                                  and self.mesh is None

@@ -2,6 +2,7 @@ from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, constrain, make_mesh,
                    param_pspec, pspec_for_config, sharding)
 from .parallel_config import ParallelConfig, Strategy
 from .ring_attention import ring_attention, ring_attention_sharded
+from .table_exchange import table_parallel_lookup
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
@@ -9,5 +10,6 @@ __all__ = [
     "make_mesh", "pspec_for_config", "param_pspec", "sharding", "constrain",
     "ParallelConfig", "Strategy",
     "ring_attention", "ring_attention_sharded",
+    "table_parallel_lookup",
     "ulysses_attention", "ulysses_attention_sharded",
 ]

@@ -510,3 +510,88 @@ class TestSpatialConvSharding:
         mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
         spec = pspec_for_config(ParallelConfig(dims=(2, 1, 2, 2)), 4, mesh)
         assert tuple(spec) == ("data", None, "seq", "model"), spec
+
+
+class TestManualTableExchange:
+    """Explicit shard_map table-parallel exchange
+    (parallel/table_exchange.py): per-table pinning + a hand-placed ICI
+    collective at the interaction point (dlrm_strategy.cc:242-296), in
+    both exchange shapes — exactness vs the dense lookup, gradients
+    through the collectives, and end-to-end training parity."""
+
+    def _ref(self, tables, ids):
+        t, r, d = tables.shape
+        flat = tables.reshape(t * r, d)
+        gids = ids + (jnp.arange(t, dtype=ids.dtype)[:, None] * r)
+        return jnp.take(flat, gids, axis=0).sum(axis=2)
+
+    @pytest.mark.parametrize("mode", ["allgather", "all_to_all"])
+    def test_lookup_exact_and_grads(self, mode):
+        import numpy as np
+        from jax.sharding import NamedSharding
+        from dlrm_flexflow_tpu.parallel import table_parallel_lookup
+
+        mesh = make_mesh({"data": 4, "model": 2})
+        rng = np.random.default_rng(0)
+        T, R, d, B, bag = 8, 64, 16, 32, 3
+        tables = jnp.asarray(
+            rng.standard_normal((T, R, d)).astype(np.float32))
+        ids = jnp.asarray(
+            rng.integers(0, R, size=(B, T, bag)).astype(np.int32))
+        tg = jax.device_put(tables,
+                            NamedSharding(mesh, P("model", None, None)))
+        ig = jax.device_put(ids, NamedSharding(mesh, P("data", None, None)))
+
+        got = table_parallel_lookup(tg, ig, mesh, "sum", mode)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(self._ref(tables, ids)))
+
+        g_ref = jax.grad(
+            lambda tb: jnp.sum(self._ref(tb, ids) ** 2))(tables)
+        g = jax.grad(lambda tb: jnp.sum(
+            table_parallel_lookup(tb, ig, mesh, "sum", mode) ** 2))(tg)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_dlrm_trains_with_manual_exchange(self):
+        """FFConfig.table_exchange routes the stacked lookup through the
+        manual exchange; training matches the SPMD-automatic mesh run."""
+        import numpy as np
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+        def build(xmode):
+            cfg = DLRMConfig(sparse_feature_size=8,
+                             embedding_size=[64] * 4,
+                             embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                             mlp_top=[8 * 4 + 8, 16, 1])
+            fc = ff.FFConfig(batch_size=16, table_exchange=xmode)
+            m = build_dlrm(cfg, fc, table_parallel=True)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=make_mesh({"data": 4, "model": 2}))
+            return m
+
+        m_manual = build("allgather")
+        m_auto = build("off")
+        assert m_manual.get_op("emb").exchange_mode == "allgather"
+        # manual exchange runs the dense path (sparse fast path excluded)
+        assert "emb" not in m_manual._sparse_emb_ops
+        assert "emb" in m_auto._sparse_emb_ops
+
+        rng = np.random.default_rng(0)
+        inputs = {"dense": rng.standard_normal((16, 4)).astype(np.float32),
+                  "sparse": rng.integers(0, 64, size=(16, 4, 2)).astype(
+                      np.int32)}
+        labels = rng.integers(0, 2, size=(16, 1)).astype(np.float32)
+        st_m, st_a = m_manual.init(seed=0), m_auto.init(seed=0)
+        for _ in range(3):
+            st_m, mm = m_manual.train_step(st_m, inputs, labels)
+            st_a, ma = m_auto.train_step(st_a, inputs, labels)
+        assert float(mm["loss"]) == pytest.approx(float(ma["loss"]),
+                                                  rel=1e-5)
+        for opn in st_a.params:
+            for k in st_a.params[opn]:
+                np.testing.assert_allclose(
+                    np.asarray(st_m.params[opn][k]),
+                    np.asarray(st_a.params[opn][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"{opn}/{k}")
