@@ -14,7 +14,6 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-import numpy as np
 
 from ..config import FFConfig
 from ..model import FFModel

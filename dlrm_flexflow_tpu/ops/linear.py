@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import jax.numpy as jnp
 
 from ..initializers import DEFAULT_BIAS_INIT, DEFAULT_KERNEL_INIT
 from ..tensor import ParameterSpec

@@ -32,7 +32,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 _BLOCK_B = 8  # samples per grid step (min f32 sublane tile)
