@@ -88,10 +88,24 @@ class CostModel:
     """
 
     def __init__(self, machine: Optional[TPUMachineModel] = None,
-                 measure: bool = False, measure_iters: int = 24):
+                 measure: bool = False, measure_iters: int = 24,
+                 measure_budget_s: float = 300.0):
         self.machine = machine or TPUMachineModel()
         self.measure = measure
         self.measure_iters = measure_iters
+        # wall-clock budget for ALL measurement (each distinct op shape
+        # costs a compile, ~2-10 s; a big graph could otherwise stall a
+        # compile-time search for tens of minutes) — once spent, later
+        # ops fall back to the analytic estimate with a warning
+        self.measure_budget_s = measure_budget_s
+        self._measure_spent = 0.0
+        self._budget_warned = False
+        # measured-vs-analytic totals over the keys that WERE measured:
+        # post-budget analytic estimates are scaled by their ratio so one
+        # search never compares raw roofline numbers (v5e peak constants)
+        # against real measured times on a slower shared slice
+        self._measured_total = 0.0
+        self._analytic_total = 0.0
         self._cache: Dict[Tuple, Tuple[float, float]] = {}
         self._null_dispatch: Optional[float] = None  # measured lazily
 
@@ -112,9 +126,27 @@ class CostModel:
         key = self._op_key(op, num_parts)
         if key in self._cache:
             return self._cache[key]
-        if self.measure:
+        if self.measure and self._measure_spent >= self.measure_budget_s:
+            if not self._budget_warned:
+                import warnings
+                warnings.warn(
+                    f"cost-model measurement budget "
+                    f"({self.measure_budget_s:.0f}s) spent; remaining ops "
+                    "use calibrated analytic estimates", RuntimeWarning)
+                self._budget_warned = True
+            # scale by the measured/analytic ratio seen so far, so
+            # pre- and post-budget keys stay comparable in one search
+            scale = (self._measured_total / self._analytic_total
+                     if self._analytic_total > 0 else 1.0)
+            fwd, bwd = self._analytic_op(op, num_parts)
+            fwd, bwd = fwd * scale, bwd * scale
+        elif self.measure:
+            t0 = time.perf_counter()
             try:
                 fwd, bwd = self._measure_op(op, num_parts)
+                af, ab = self._analytic_op(op, num_parts)
+                self._measured_total += fwd + bwd
+                self._analytic_total += af + ab
             except Exception as e:
                 # fall back, but LOUDLY — a silent fallback would bias the
                 # search with analytic numbers while claiming measured ones
@@ -124,6 +156,8 @@ class CostModel:
                     f"failed ({type(e).__name__}: {e}); using analytic "
                     "estimate", RuntimeWarning)
                 fwd, bwd = self._analytic_op(op, num_parts)
+            finally:
+                self._measure_spent += time.perf_counter() - t0
         else:
             fwd, bwd = self._analytic_op(op, num_parts)
         self._cache[key] = (fwd, bwd)

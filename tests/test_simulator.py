@@ -326,3 +326,42 @@ class TestOverlapMode:
             nat = NativeSimulator.for_strategy(
                 m, 2, s, overlap_backward_update=overlap).simulate(s)
             assert abs(py - nat) < 1e-9, (overlap, py, nat)
+
+
+class TestMeasureBudget:
+    def test_budget_exhaustion_falls_back_to_analytic(self):
+        """The measured cost model stops compiling new op measurements
+        once its wall-clock budget is spent (each distinct shape costs a
+        compile; a big graph must not stall a compile-time search)."""
+        import warnings
+
+        m = mlp_model()
+        cm = CostModel(measure=True, measure_budget_s=0.0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            f, b = cm.op_times(m.layers[0], 1)
+        assert f > 0 and b > 0
+        assert any("budget" in str(x.message) for x in w)
+        # and the analytic result is cached like any other
+        assert cm.op_times(m.layers[0], 1) == (f, b)
+
+    def test_post_budget_analytic_is_ratio_calibrated(self):
+        """Post-budget estimates are scaled by the measured/analytic
+        ratio of the already-measured keys, so one search never compares
+        raw roofline numbers against measured times."""
+        m = mlp_model()
+        cm = CostModel(measure=True, measure_budget_s=1e9)
+        # seed the ratio with a fake "measured" history: 10x analytic
+        af, ab = cm._analytic_op(m.layers[0], 1)
+        cm._measured_total = 10.0 * (af + ab)
+        cm._analytic_total = af + ab
+        cm.measure_budget_s = 0.0  # exhaust
+        import warnings
+
+        import pytest
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f, b = cm.op_times(m.layers[1], 1)
+        a2f, a2b = cm._analytic_op(m.layers[1], 1)
+        assert f == pytest.approx(10.0 * a2f, rel=1e-9)
+        assert b == pytest.approx(10.0 * a2b, rel=1e-9)
