@@ -633,3 +633,66 @@ class TestMultiEpochFusion:
         assert m._last_fit_used_scan
         assert thpt > 0
         assert int(st.step) == 1 + 3 * loader.num_batches  # warmup + 3 ep
+
+
+class TestRandomizedEquivalence:
+    """Property sweep: for RANDOM shapes (odd table sizes, non-lane-
+    compatible dims, ragged bags, epoch lengths that don't divide the
+    inner block), the four execution modes — dense autodiff, sparse
+    updates, epoch cache on/off — must agree on the training result.
+    Hits build_cache's no-win branch, sentinel padding, pack rounding,
+    and chunk-boundary logic at configurations the targeted tests don't
+    enumerate."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_modes_agree(self, seed):
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+        prng = np.random.default_rng(100 + seed)
+        tables = int(prng.integers(2, 5))
+        rows = int(prng.integers(17, 300))
+        d = int(prng.choice([4, 8, 12, 16, 24]))  # 12/24: not 128-compat
+        bag = int(prng.integers(1, 4))
+        batch = int(prng.choice([8, 16]))
+        nb = int(prng.integers(3, 9))
+        inner = int(prng.choice([0, 2, 3]))
+        # small chunk so the chunked-epoch dispatch (equalized chunks +
+        # remainder folding) actually triggers at these nb values
+        chunk = int(prng.choice([0, 2, 4]))
+
+        cfg = DLRMConfig(sparse_feature_size=d,
+                         embedding_size=[rows] * tables,
+                         embedding_bag_size=bag,
+                         mlp_bot=[4, 8, d],
+                         mlp_top=[d * tables + d, 8, 1])
+        inputs = {"dense": prng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            "sparse": prng.integers(0, rows, size=(nb, batch, tables, bag),
+                                    dtype=np.int64)}
+        labels = prng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+
+        results = {}
+        for mode, cache in (("on", "on"), ("on", "off"), ("off", "off")):
+            fc = ff.FFConfig(batch_size=batch,
+                             sparse_embedding_updates=mode,
+                             epoch_row_cache=cache,
+                             epoch_cache_inner=inner,
+                             epoch_cache_chunk=chunk)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
+            st = m.init(seed=0)
+            st, mets = m.train_epoch(st, inputs, labels)
+            results[(mode, cache)] = (st, float(mets["loss"]))
+
+        ref_st, ref_loss = results[("off", "off")]
+        for key, (st, loss) in results.items():
+            assert loss == pytest.approx(ref_loss, rel=1e-5), (key, seed)
+            for opn in ref_st.params:
+                for k in ref_st.params[opn]:
+                    np.testing.assert_allclose(
+                        np.asarray(st.params[opn][k]),
+                        np.asarray(ref_st.params[opn][k]),
+                        rtol=1e-5, atol=1e-6,
+                        err_msg=f"{key} {opn}/{k} seed={seed}")
