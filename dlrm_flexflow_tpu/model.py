@@ -360,7 +360,11 @@ class FFModel:
             if getattr(op, "has_state", False):
                 new_bn[op.name] = op._last_state
             # per-op placement constraint — the strategy's imprint on XLA
-            if self.mesh is not None and op.parallel_config is not None:
+            # (skipped for manual-exchange ops: their shard_map out_specs
+            # already fix the output layout, and re-constraining forces a
+            # pointless reshard)
+            if (self.mesh is not None and op.parallel_config is not None
+                    and not getattr(op, "exchange_mode", None)):
                 if hasattr(op, "output_pspec"):
                     spec = op.output_pspec(op.parallel_config, self.mesh)
                 else:
