@@ -5,6 +5,11 @@ MLPs data-parallel).
 Runs on any device count: set XLA_FLAGS=--xla_force_host_platform_device_count=8
 with JAX_PLATFORMS=cpu to try it without TPUs.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import jax
 

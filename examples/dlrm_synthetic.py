@@ -2,7 +2,11 @@
 
 Usage: python examples/dlrm_synthetic.py [-b 256] [-e 2] [--data-size 4096]
 """
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 from dlrm_flexflow_tpu.apps.dlrm import run
 

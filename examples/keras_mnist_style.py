@@ -1,5 +1,10 @@
 """Keras-frontend MLP (the reference's keras example shape, synthetic data
 standing in for MNIST — this environment has no dataset egress)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from dlrm_flexflow_tpu.frontends import keras as K

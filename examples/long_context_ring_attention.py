@@ -5,6 +5,11 @@ first-class here).
 Try without TPUs: XLA_FLAGS=--xla_force_host_platform_device_count=8
 JAX_PLATFORMS=cpu python examples/long_context_ring_attention.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import jax
 

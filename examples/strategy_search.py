@@ -1,6 +1,11 @@
 """SOAP strategy search on the DLRM graph: simulate, anneal, export
 (reference: --budget N --export file path through FFModel::optimize).
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import dlrm_flexflow_tpu as ff
 from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
 from dlrm_flexflow_tpu.parallel.parallel_config import ParallelConfig, Strategy

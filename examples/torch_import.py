@@ -1,5 +1,10 @@
 """Import a PyTorch module via torch.fx and keep training it on TPU
 (reference: flexflow/torch/fx.py path)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import torch
 import torch.nn as nn

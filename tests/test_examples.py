@@ -1,0 +1,27 @@
+"""Top-level example scripts run as `python examples/<name>.py` — the
+reference's examples are runnable binaries; these must be runnable
+scripts (each carries a sys.path shim so no install step is needed)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(REPO, "examples"))
+    if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    if name == "torch_import.py":
+        pytest.importorskip("torch")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout[-1500:]}\n{r.stderr[-1500:]}"
