@@ -240,6 +240,49 @@ KAGGLE_TABLES = [1396, 550, 1761917, 507795, 290, 21, 11948, 608, 3, 58176,
                  1312273, 17, 15, 110946, 91, 72655]  # run_criteo_kaggle.sh
 
 
+def kaggle_model(batch: int, dtype: str = "bfloat16"):
+    """The anchored dlrm_kaggle bench model, shared with
+    scripts/bench_kaggle_windows.py so the window-scaling evidence always
+    measures the exact benched configuration.
+
+    run_criteo_kaggle.sh says mlp_top 224-512-256-1, but with its own cat
+    interaction the width is 16 + 26*16 = 432 (the reference snapshot is
+    mid-merge and inconsistent; SURVEY.md "Repo state warning") — use the
+    consistent width."""
+    import jax
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+    cfg = DLRMConfig(sparse_feature_size=16,
+                     embedding_size=list(KAGGLE_TABLES),
+                     embedding_bag_size=1,
+                     mlp_bot=[13, 512, 256, 64, 16],
+                     mlp_top=[432, 512, 256, 1])
+    model = build_dlrm(cfg, ff.FFConfig(batch_size=batch,
+                                        compute_dtype=dtype))
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy", "mean_squared_error"),
+                  mesh=False if jax.device_count() == 1 else None)
+    return cfg, model
+
+
+def kaggle_inputs(cfg, batch: int, nb: int, seed: int = 0):
+    """Stacked synthetic batches for the kaggle model (per-column id
+    ranges)."""
+    rng = np.random.default_rng(seed)
+    inputs = {"dense": rng.standard_normal(
+        (nb, batch, cfg.mlp_bot[0])).astype(np.float32),
+        "sparse": np.stack([rng.integers(0, r,
+                                         size=(nb, batch,
+                                               cfg.embedding_bag_size),
+                                         dtype=np.int64)
+                            for r in cfg.embedding_size], axis=2)}
+    labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+    return inputs, labels
+
+
 def bench_app(app: str):
     import jax
     import dlrm_flexflow_tpu as ff
@@ -306,16 +349,7 @@ def bench_app(app: str):
         from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
         if app == "dlrm_kaggle":
             # "DLRM small (Criteo-Kaggle), data-parallel embeddings + MLP"
-            # run_criteo_kaggle.sh says mlp_top 224-512-256-1, but with
-            # its own cat interaction the width is 16 + 26*16 = 432 (the
-            # reference snapshot is mid-merge and inconsistent; SURVEY.md
-            # "Repo state warning") — use the consistent width
-            cfg = DLRMConfig(sparse_feature_size=16,
-                             embedding_size=list(KAGGLE_TABLES),
-                             embedding_bag_size=1,
-                             mlp_bot=[13, 512, 256, 64, 16],
-                             mlp_top=[432, 512, 256, 1])
-            model = build_dlrm(cfg, fc)
+            cfg, model = kaggle_model(batch, dtype)  # compiles internally
         else:
             # "DLRM Criteo-Terabyte, SOAP hybrid (table-parallel
             # embeddings, DP MLP)" — TB-scale tables, hybrid strategy
@@ -323,9 +357,10 @@ def bench_app(app: str):
             cfg.embedding_size = [int(os.environ.get("BENCH_ROWS",
                                                      1_000_000))] * 8
             model = build_dlrm(cfg, fc, table_parallel=True)
-        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
-                      loss_type="mean_squared_error",
-                      metrics=("accuracy", "mean_squared_error"), mesh=mesh)
+            model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                          loss_type="mean_squared_error",
+                          metrics=("accuracy", "mean_squared_error"),
+                          mesh=mesh)
         dense = rng.standard_normal(
             (nb, batch, cfg.mlp_bot[0])).astype(np.float32)
         if model._dlrm_stacked:
