@@ -387,10 +387,22 @@ def bench_app(app: str):
     thpt, probe_us = _windows(model, state, inputs, labels, batch, nb,
                               epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
+    extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
     if app in ("dlrm_kaggle", "dlrm_hybrid"):
         key["rows"] = max(cfg.embedding_size)
-    _emit(f"{app}_samples_per_sec", thpt, key,
-          extra={"dtype": dtype, "probe_us": round(probe_us, 1)})
+        # table-storage dtype is numerics-relevant, so it is part of the
+        # anchor key here exactly as in main() (advisor r2); entries
+        # predating the field count as float32 in matches()
+        key["emb_dtype"] = str(
+            np.dtype(model.config.embedding_dtype
+                     if hasattr(model.config, "embedding_dtype")
+                     else "float32"))
+        # provenance: since round 2 the kaggle config runs the 26
+        # non-uniform tables as ONE fused RaggedStackedEmbedding row
+        # space (ops/embedding.py), not 26 separate Embedding ops
+        extra["arch"] = ("ragged_fused" if app == "dlrm_kaggle"
+                         else "stacked_hybrid")
+    _emit(f"{app}_samples_per_sec", thpt, key, extra=extra)
 
 
 if __name__ == "__main__":

@@ -102,7 +102,31 @@ def _extract_archive(file_path, path=".", archive_format="auto"):
                             else (zipfile.ZipFile, zipfile.is_zipfile))
         if is_match(file_path):
             with opener(file_path) as archive:
-                archive.extractall(path)
+                if fmt == "tar":
+                    # refuse tar-slip members (absolute paths, "..",
+                    # links outside the target)
+                    try:
+                        archive.extractall(path, filter="data")
+                    except TypeError:  # Python without the filter backport
+                        target = os.path.realpath(path)
+                        for m in archive.getmembers():
+                            dest = os.path.realpath(
+                                os.path.join(path, m.name))
+                            if not (dest == target
+                                    or dest.startswith(target + os.sep)):
+                                raise ValueError(
+                                    f"tar member {m.name!r} escapes "
+                                    f"{path!r}")
+                        archive.extractall(path)
+                else:
+                    target = os.path.realpath(path)
+                    for name in archive.namelist():
+                        dest = os.path.realpath(os.path.join(path, name))
+                        if not (dest == target
+                                or dest.startswith(target + os.sep)):
+                            raise ValueError(
+                                f"zip member {name!r} escapes {path!r}")
+                    archive.extractall(path)
             return True
     return False
 

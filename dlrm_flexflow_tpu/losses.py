@@ -84,6 +84,17 @@ def mean_squared_error(preds, labels):
     return jnp.mean(se)
 
 
+@_register("mean_squared_error_sum_reduce")
+def mean_squared_error_sum_reduce(preds, labels):
+    """Sum over batch (scale factor 1, not 1/batch) — the reference's
+    LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE variant: mse_backward is launched
+    with scale_factor = 1 instead of 1/batch
+    (loss_functions.cu:141-180), so the gradient is 2*(y-t) per element
+    and the effective learning rate scales with the batch size."""
+    se = jnp.sum(jnp.square(preds - labels), axis=tuple(range(1, preds.ndim)))
+    return jnp.sum(se)
+
+
 # aliases matching reference LossType enum spellings
 LOSS_FUNCTIONS["sparse_crossentropy"] = sparse_categorical_crossentropy
 LOSS_FUNCTIONS["crossentropy"] = categorical_crossentropy

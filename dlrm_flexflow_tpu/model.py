@@ -47,8 +47,8 @@ from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
                   MultiHeadAttention, Op, Pool2D, RaggedStackedEmbedding,
                   Reshape, Reverse, Softmax, Split, StackedEmbedding,
                   Transpose)
-from .parallel.mesh import (DATA_AXIS, constrain, make_mesh, param_pspec,
-                            pspec_for_config, sharding)
+from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
+                            param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
 from .tensor import Tensor, as_dtype
 
@@ -669,28 +669,30 @@ class FFModel:
         # ---- epoch row-cache pieces (shared by the single-epoch and the
         # multi-epoch scanned programs) -----------------------------------
         def build_cache(flat, ids, pack):
-            """Unique-slot cache of the rows ``ids`` touches in the
-            (R, d) source ``flat``: (cache, slots, uniq) or None when
-            the cache would not be smaller than the source.  Works on
-            concrete arrays (epoch prologue) and on traced values
-            (the in-scan inner level) alike — shapes are static."""
-            n_tot = int(np.prod(ids.shape))
-            # distinct rows can never exceed the source or the ids
-            size = min(n_tot, flat.shape[0])
+            """Shared-slot cache of the rows ``ids`` touches in the
+            (R, d) source ``flat``: (cache, slots, rowof) or None when
+            the cache would not be smaller than the source.  Slot
+            assignment is sort-position based (ops/slotting.py — no
+            dense-rank inverse, whose scalar scatters dominated the
+            prologue); ``rowof`` maps slot -> row with sentinel holes,
+            which the fill (mode="clip") and the writeback
+            (mode="drop") both tolerate.  Works on traced values; all
+            shapes are static (the cache is sized by the occurrence
+            count, as before — the distinct count is data-dependent)."""
+            size = int(np.prod(ids.shape))
             sentinel = flat.shape[0]  # OOB -> dropped at writeback
             # pad to the lane-pack multiple so the packed view
             # applies to the cache too
             m = -(-size // pack) * pack
             if m >= flat.shape[0]:
                 return None
-            uniq, inv = jnp.unique(ids.reshape(-1), size=size,
-                                   fill_value=sentinel,
-                                   return_inverse=True)
+            from .ops.slotting import slot_rows
+            rowof, slots = slot_rows(ids, sentinel)
             if m > size:
-                uniq = jnp.concatenate(
-                    [uniq, jnp.full((m - size,), sentinel, uniq.dtype)])
-            cache = jnp.take(flat, uniq, axis=0, mode="clip")
-            return cache, inv.reshape(ids.shape), uniq
+                rowof = jnp.concatenate(
+                    [rowof, jnp.full((m - size,), sentinel, rowof.dtype)])
+            cache = jnp.take(flat, rowof, axis=0, mode="clip")
+            return cache, slots, rowof
 
         from .ops.pallas_scatter import lane_pack
         op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
@@ -875,6 +877,19 @@ class FFModel:
                 continue
             pc = op.parallel_config
             tp = pc is not None and any(d > 1 for d in pc.dims[1:])
+            if tp:
+                msize = self.mesh.shape.get(MODEL_AXIS, 1)
+                for s in specs:
+                    if s.sharded_dim is not None and msize > 1 \
+                            and s.shape[s.sharded_dim] % msize != 0:
+                        # e.g. a ragged fused row space padded to an
+                        # 8-way alignment under a wider model axis
+                        # (advisor r2) — fail with the op named instead
+                        # of a device_put shape error
+                        raise ValueError(
+                            f"{op.name}: parameter dim {s.sharded_dim} "
+                            f"({s.shape[s.sharded_dim]}) does not divide "
+                            f"the {msize}-way '{MODEL_AXIS}' mesh axis")
             shardings[op.name] = {
                 s.param_name: sharding(self.mesh,
                                        param_pspec(s.sharded_dim,

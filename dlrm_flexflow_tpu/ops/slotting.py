@@ -1,0 +1,64 @@
+"""Sort-position slot assignment for the epoch row-cache.
+
+The row-cache prologue must map every id occurrence of an epoch/chunk/
+block to a cache slot such that all occurrences of the same table row
+share ONE slot (coherence of cross-step updates), and produce the slot ->
+row map for the cache fill and writeback.  ``jnp.unique(...,
+return_inverse=True)`` does this but measures ~15 ms per prologue at the
+bench shape (524k ids) on the TPU slice: the sort itself is ~1 ms — the
+cost is the dense-rank inverse construction, which lowers to scalar
+scatters (~3-6 ms each on this platform, PERF.md round 3).
+
+Ranks don't have to be dense: the cache is statically sized by the
+OCCURRENCE count n (the distinct count is data-dependent), so slots may
+be any per-run representative.  Using each run's FIRST POSITION in the
+sorted order needs only sorts (cheap), one cummax, and elementwise ops:
+
+  s, perm = sort((ids, iota))          # one sort pass carries both
+  flag[k]  = s[k] != s[k-1]            # run starts
+  firstpos = cummax(flag ? k : 0)      # slot of sorted position k
+  slots    = sort((perm, firstpos))[1] # back to original order: a sort
+                                       # by a permutation replaces the
+                                       # scalar scatter a rank-inverse
+                                       # would need
+  rowof    = where(flag, s, sentinel)  # slot -> row, holes = sentinel
+
+``rowof`` is ascending-with-holes instead of jnp.unique's compacted
+form; the cache fill (gather rows at ``rowof``) and the writeback
+(scatter-set at ``rowof`` with mode="drop") are hole-tolerant, and the
+cached training path stays bit-exact with the uncached one — the same
+adds hit the same values in the same order, only the slot numbering
+changes.  (A presence-bitmap + cumsum "unique by scatter" variant was
+also built and measured: its scalar scatter/gather passes cost more
+than the sort it avoids on this platform — see PERF.md round 3.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def slot_rows(ids, num_rows: int):
+    """(rowof, slots) for ``ids`` over the bounded row space
+    [0, num_rows).
+
+    ``rowof``: (n,) int32 where n = ids.size — ``rowof[p]`` is the table
+    row cached in slot p when p is a run-first sorted position, else the
+    sentinel ``num_rows``.  ``slots``: ids.shape int32 — the slot of each
+    occurrence; all occurrences of one row share one slot, and
+    ``rowof[slots] == ids`` everywhere.  Requires 0 <= ids < num_rows.
+    """
+    flat = ids.reshape(-1).astype(jnp.int32)
+    n = flat.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # one sort pass carries the positions along with the keys
+    s, perm = jax.lax.sort((flat, pos), num_keys=1, is_stable=False)
+    flag = jnp.concatenate(
+        [jnp.ones((1,), bool), s[1:] != s[:-1]])
+    firstpos = jax.lax.cummax(jnp.where(flag, pos, 0))
+    # slots back in original order: sorting by the permutation is the
+    # scatter ``out[perm] = firstpos`` expressed as a (cheap) sort
+    _, slots = jax.lax.sort((perm, firstpos), num_keys=1, is_stable=False)
+    rowof = jnp.where(flag, s, jnp.int32(num_rows))
+    return rowof, slots.reshape(ids.shape)

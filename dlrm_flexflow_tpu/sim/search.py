@@ -12,6 +12,7 @@ legal factorization of the device count over the op's output dims).
 from __future__ import annotations
 
 import math
+import os
 import random
 from typing import Callable, List, Optional
 
@@ -80,7 +81,9 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
                 seed: int = 0,
                 verbose: bool = False,
                 on_iteration: Optional[Callable] = None,
-                backend: str = "auto") -> Strategy:
+                backend: str = "auto",
+                measure: Optional[bool] = None,
+                measure_budget_s: float = 300.0) -> Strategy:
     """Simulated-annealing search (reference model.cc:1093-1144).
 
     Returns the best Strategy found; ``model.strategy`` is not mutated.
@@ -99,13 +102,23 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
     """
     rng = random.Random(seed)
 
+    # ``measure``: None = auto (measure on a real TPU; previously this
+    # auto-measurement could silently spend up to measure_budget_s
+    # compiling kernels on-chip — advisor r2); False forces the instant
+    # analytic model; True forces measurement.  FF_SEARCH_MEASURE=0
+    # opts out environment-wide.
+    if measure is None:
+        env = os.environ.get("FF_SEARCH_MEASURE")
+        if env is not None:
+            measure = env.strip().lower() not in ("0", "off", "false", "no")
     cost_model = None
-    if simulator is None:
+    if simulator is None and measure is not False:
         import jax
 
         from .cost_model import CostModel
-        if jax.default_backend() == "tpu":
-            cost_model = CostModel(measure=True)
+        if measure or jax.default_backend() == "tpu":
+            cost_model = CostModel(measure=True,
+                                   measure_budget_s=measure_budget_s)
 
     # start from data-parallel (reference model.cc:1102)
     current = data_parallel_strategy(model, num_devices)

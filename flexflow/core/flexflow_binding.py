@@ -73,7 +73,7 @@ _LOSS = {
     # the reference's avg- vs sum-reduce differ by the 1/batch scale the
     # backward applies (loss_functions.cu:146); the core loss is avg-reduce
     LossType.LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE: "mean_squared_error",
-    LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: "mean_squared_error",
+    LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE: "mean_squared_error_sum_reduce",
 }
 _METRIC = {
     MetricsType.METRICS_ACCURACY: "accuracy",

@@ -109,6 +109,25 @@ class TestLosses:
                                                     jnp.asarray(y)))
         np.testing.assert_allclose(g, 2 * (p - y) / 5, atol=1e-6)
 
+    def test_mse_sum_reduce_grad_scale(self, rng):
+        """SUM_REDUCE grad = 2*(pred-label) per element — scale factor 1,
+        not 1/batch (loss_functions.cu:141-180); the compat binding maps
+        LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE to this variant."""
+        from dlrm_flexflow_tpu.losses import (get_loss,
+                                              mean_squared_error_sum_reduce)
+        p = rng.standard_normal((5, 3), dtype=np.float32)
+        y = rng.standard_normal((5, 3), dtype=np.float32)
+        g = np.asarray(jax.grad(mean_squared_error_sum_reduce)(
+            jnp.asarray(p), jnp.asarray(y)))
+        g_avg = np.asarray(jax.grad(mean_squared_error)(
+            jnp.asarray(p), jnp.asarray(y)))
+        np.testing.assert_allclose(g, 2 * (p - y), atol=1e-6)
+        np.testing.assert_allclose(g, g_avg * 5, atol=1e-5)
+        from flexflow.core.flexflow_binding import _LOSS, LossType
+        assert get_loss(
+            _LOSS[LossType.LOSS_MEAN_SQUARED_ERROR_SUM_REDUCE]
+        ) is mean_squared_error_sum_reduce
+
     def test_cce_vs_torch(self, rng):
         logits = rng.standard_normal((6, 4), dtype=np.float32)
         labels = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=(6,))]

@@ -1,0 +1,57 @@
+"""slot_rows invariants — the epoch row-cache's exactness proof needs
+every occurrence of a row to share one slot, and the slot -> row map to
+round-trip (model.py build_cache)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrm_flexflow_tpu.ops.slotting import slot_rows
+
+
+def check(ids, num_rows):
+    rowof, slots = slot_rows(ids, num_rows)
+    rowof, slots = np.asarray(rowof), np.asarray(slots)
+    flat = np.asarray(ids).reshape(-1)
+    assert slots.shape == np.asarray(ids).shape
+    assert rowof.shape == (flat.size,)
+    sf = slots.reshape(-1)
+    # every occurrence resolves to its own row through the slot map
+    np.testing.assert_array_equal(rowof[sf], flat)
+    # occurrences of one row share ONE slot (cross-step coherence)
+    for r in np.unique(flat):
+        assert len(np.unique(sf[flat == r])) == 1
+    # distinct rows get distinct slots (no aliasing)
+    assert len(np.unique(sf)) == len(np.unique(flat))
+    # non-slot positions hold the sentinel, slot positions are live rows
+    live = np.zeros(flat.size, bool)
+    live[np.unique(sf)] = True
+    assert (rowof[~live] == num_rows).all()
+    assert (rowof[live] < num_rows).all()
+
+
+@pytest.mark.parametrize("n,num_rows,seed", [
+    (64, 100, 0),          # duplicates likely
+    (256, 50, 1),          # n > R: every row hit multiple times
+    (100, 10_000, 2),      # sparse touch
+    (1, 7, 3),             # single id
+    (128, 128, 4),
+])
+def test_invariants(n, num_rows, seed):
+    rng = np.random.default_rng(seed)
+    check(jnp.asarray(rng.integers(0, num_rows, size=n, dtype=np.int32)),
+          num_rows)
+
+
+def test_shaped_ids_and_all_duplicates():
+    check(jnp.asarray([[3, 3], [3, 3]], jnp.int32), 10)
+
+
+def test_jittable_and_deterministic():
+    import jax
+    rng = np.random.default_rng(9)
+    ids = jnp.asarray(rng.integers(0, 64, size=(4, 8), dtype=np.int32))
+    a = jax.jit(lambda i: slot_rows(i, 64))(ids)
+    b = slot_rows(ids, 64)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
