@@ -235,30 +235,17 @@ def main():
 # one; the default "dlrm" is the synthetic run_random.sh workload above.
 # Each prints the same one-line JSON protocol.
 
-KAGGLE_TABLES = [1396, 550, 1761917, 507795, 290, 21, 11948, 608, 3, 58176,
-                 5237, 1497287, 3127, 26, 12153, 1068715, 10, 4836, 2085, 4,
-                 1312273, 17, 15, 110946, 91, 72655]  # run_criteo_kaggle.sh
-
-
 def kaggle_model(batch: int, dtype: str = "bfloat16"):
-    """The anchored dlrm_kaggle bench model, shared with
-    scripts/bench_kaggle_windows.py so the window-scaling evidence always
-    measures the exact benched configuration.
-
-    run_criteo_kaggle.sh says mlp_top 224-512-256-1, but with its own cat
-    interaction the width is 16 + 26*16 = 432 (the reference snapshot is
-    mid-merge and inconsistent; SURVEY.md "Repo state warning") — use the
-    consistent width."""
+    """The anchored dlrm_kaggle bench model — the one shared
+    criteo_kaggle_config() shape (apps/dlrm.py), so this benchmark,
+    scripts/bench_kaggle_windows.py, and examples/dlrm_criteo.py always
+    measure the identical architecture."""
     import jax
 
     import dlrm_flexflow_tpu as ff
-    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.apps.dlrm import build_dlrm, criteo_kaggle_config
 
-    cfg = DLRMConfig(sparse_feature_size=16,
-                     embedding_size=list(KAGGLE_TABLES),
-                     embedding_bag_size=1,
-                     mlp_bot=[13, 512, 256, 64, 16],
-                     mlp_top=[432, 512, 256, 1])
+    cfg = criteo_kaggle_config()
     model = build_dlrm(cfg, ff.FFConfig(batch_size=batch,
                                         compute_dtype=dtype))
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
@@ -345,10 +332,14 @@ def bench_app(app: str):
         labels = rng.integers(0, cfg.vocab_size,
                               size=(nb, batch, cfg.tgt_len, 1)).astype(
                                   np.int32)
-    elif app in ("dlrm_kaggle", "dlrm_hybrid"):
+    elif app in ("dlrm_kaggle", "dlrm_hybrid", "dlrm_criteo"):
         from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
-        if app == "dlrm_kaggle":
-            # "DLRM small (Criteo-Kaggle), data-parallel embeddings + MLP"
+        if app in ("dlrm_kaggle", "dlrm_criteo"):
+            # "DLRM small (Criteo-Kaggle), data-parallel embeddings + MLP";
+            # dlrm_criteo is the same model on Zipf(1.05)-skewed ids — the
+            # realistic stand-in for real Criteo columns (the reference's
+            # flagship real-data path, dlrm.cc:266-382): far fewer
+            # distinct rows than lookups, the epoch row-cache's regime
             cfg, model = kaggle_model(batch, dtype)  # compiles internally
         else:
             # "DLRM Criteo-Terabyte, SOAP hybrid (table-parallel
@@ -363,7 +354,14 @@ def bench_app(app: str):
                           mesh=mesh)
         dense = rng.standard_normal(
             (nb, batch, cfg.mlp_bot[0])).astype(np.float32)
-        if model._dlrm_stacked:
+        if app == "dlrm_criteo":
+            from dlrm_flexflow_tpu.data.loader import zipf_ids
+            inputs = {"dense": dense,
+                      "sparse": np.stack(
+                          [zipf_ids(rng, rows_i,
+                                    (nb, batch, cfg.embedding_bag_size))
+                           for rows_i in cfg.embedding_size], axis=2)}
+        elif model._dlrm_stacked:
             # per-column ranges (column t < rows_t) — serves both the
             # uniform stacked and the ragged (Kaggle) table sets
             inputs = {"dense": dense,
@@ -388,7 +386,7 @@ def bench_app(app: str):
                               epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
-    if app in ("dlrm_kaggle", "dlrm_hybrid"):
+    if app in ("dlrm_kaggle", "dlrm_hybrid", "dlrm_criteo"):
         key["rows"] = max(cfg.embedding_size)
         # table-storage dtype is numerics-relevant, so it is part of the
         # anchor key here exactly as in main() (advisor r2); entries
@@ -400,8 +398,8 @@ def bench_app(app: str):
         # provenance: since round 2 the kaggle config runs the 26
         # non-uniform tables as ONE fused RaggedStackedEmbedding row
         # space (ops/embedding.py), not 26 separate Embedding ops
-        extra["arch"] = ("ragged_fused" if app == "dlrm_kaggle"
-                         else "stacked_hybrid")
+        extra["arch"] = ("stacked_hybrid" if app == "dlrm_hybrid"
+                         else "ragged_fused")
     _emit(f"{app}_samples_per_sec", thpt, key, extra=extra)
 
 

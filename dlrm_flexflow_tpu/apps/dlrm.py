@@ -77,6 +77,28 @@ class DLRMConfig:
         return c
 
 
+KAGGLE_TABLES = [1396, 550, 1761917, 507795, 290, 21, 11948, 608, 3, 58176,
+                 5237, 1497287, 3127, 26, 12153, 1068715, 10, 4836, 2085, 4,
+                 1312273, 17, 15, 110946, 91, 72655]
+# ^ the 26 Criteo-Kaggle categorical cardinalities
+#   (reference examples/cpp/DLRM/run_criteo_kaggle.sh)
+
+
+def criteo_kaggle_config() -> "DLRMConfig":
+    """THE Criteo-Kaggle model shape, shared by the benchmark, the
+    criteo example, and the window-scaling script so they always train
+    the identical architecture.  run_criteo_kaggle.sh says mlp_top
+    224-512-256-1, but with its own cat interaction the width is
+    16 + 26*16 = 432 (the reference snapshot is mid-merge and
+    inconsistent; SURVEY.md "Repo state warning") — use the consistent
+    width."""
+    return DLRMConfig(sparse_feature_size=16,
+                      embedding_size=list(KAGGLE_TABLES),
+                      embedding_bag_size=1,
+                      mlp_bot=[13, 512, 256, 64, 16],
+                      mlp_top=[16 + 26 * 16, 512, 256, 1])
+
+
 def _create_mlp(model: FFModel, x, layer_sizes, sigmoid_layer: int,
                 prefix: str):
     """reference create_mlp (dlrm.cc:103-112): relu everywhere, sigmoid at

@@ -77,6 +77,15 @@ class FFConfig:
     # per-step sweep scales with the block, not the chunk (measured
     # optimum 8 with chunk 256, PERF.md).  0 disables.
     epoch_cache_inner: int = 8
+    # In-graph cache-ladder shape ("auto" | "off" | explicit sizes like
+    # "256,32,8").  "auto" runs the chunk as an in-graph scan level (so
+    # a multi-epoch run fuses into one dispatch with one prologue),
+    # inserts a geometric mid level between chunk and inner when
+    # chunk/inner > 8, and ends at epoch_cache_inner — each level pulls
+    # its block's rows from the parent cache so no rebuild sweeps more
+    # than ~8 blocks' rows (PERF.md round 3).  "off" restores flat
+    # host-side chunking with no in-graph levels.
+    epoch_cache_levels: str = "auto"
     # Manual table-parallel exchange for StackedEmbedding under a mesh
     # ("off"|"allgather"|"all_to_all"): route the table-sharded lookup
     # through an explicit shard_map + ICI collective

@@ -96,6 +96,63 @@ class SyntheticDLRMLoader(ArrayDataLoader):
         super().__init__(inputs, labels, batch_size)
 
 
+def zipf_ids(rng, num_rows: int, size, a: float = 1.05,
+             dtype=np.int64) -> np.ndarray:
+    """Zipf-distributed ids over [0, num_rows) — the skew shape of real
+    Criteo categorical columns (a handful of hot values takes most of
+    the mass; the reference trains on exactly such data,
+    examples/cpp/DLRM/run_criteo_kaggle.sh).  Bounded rejection sampling
+    keeps the exact Zipf(a) law truncated to the table; the id space is
+    then permuted so hot rows are scattered across the table instead of
+    clustered at 0 (as after Criteo's frequency-agnostic hashing)."""
+    a = float(a)
+    if a <= 1.0:
+        raise ValueError("zipf exponent must be > 1")
+    flat = int(np.prod(size))
+    out = np.empty(flat, dtype=np.int64)
+    have = 0
+    while have < flat:
+        draw = rng.zipf(a, size=max(flat - have, 1024))
+        draw = draw[draw <= num_rows]
+        take = min(draw.size, flat - have)
+        out[have:have + take] = draw[:take] - 1
+        have += take
+    # mix the hot head over the row space (deterministic given rng)
+    mult = 0x9E3779B1 % num_rows
+    while np.gcd(mult, num_rows) != 1:
+        mult = (mult + 1) % num_rows
+    out = (out * mult + 12345) % num_rows
+    return out.reshape(size).astype(dtype)
+
+
+class ZipfDLRMLoader(ArrayDataLoader):
+    """Synthetic DLRM loader with Zipf-skewed sparse ids — the fallback
+    the Criteo example trains on when no real dataset file is present.
+    Same layout contract as SyntheticDLRMLoader; labels correlate with a
+    hidden weighting of the hot ids so the training signal is learnable
+    (loss decreases), unlike pure-noise labels."""
+
+    def __init__(self, num_samples: int, num_dense: int, table_sizes,
+                 bag_size: int, batch_size: int, stacked: bool = True,
+                 a: float = 1.05, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        dense = rng.standard_normal((num_samples, num_dense),
+                                    dtype=np.float32)
+        cols = [zipf_ids(rng, int(rows), (num_samples, bag_size), a)
+                for rows in table_sizes]
+        inputs = {"dense": dense}
+        if stacked:
+            inputs["sparse"] = np.stack(cols, axis=1)
+        else:
+            for i, c in enumerate(cols):
+                inputs[f"sparse_{i}"] = c
+        # learnable labels: a sparse signal carried by the hot ids
+        signal = sum(np.sin(c[:, 0] * 0.7 + i) for i, c in enumerate(cols))
+        signal = signal + dense[:, 0]
+        labels = (signal > np.median(signal)).astype(np.float32)[:, None]
+        super().__init__(inputs, labels, batch_size)
+
+
 def load_criteo_h5(path: str, stacked: bool = False):
     """Read a Criteo-format HDF5 file (reference dlrm.cc:266-382:
     datasets ``X_int`` float dense, ``X_cat`` int64 sparse, ``y`` labels).

@@ -723,67 +723,177 @@ class FFModel:
                                state.rng, state.step)
             return state, slots_ep, writebacks, orig_tables
 
-        def epoch_scan(state, inputs, labels, slots_ep):
+        def ladder_sizes(nb):
+            """Static block sizes of the in-graph cache ladder for an
+            nb-step scan, outermost first.  The top level is the former
+            host-side chunk — running it as an in-graph scan level lets
+            a multi-epoch run fuse into ONE dispatch with one prologue —
+            the innermost is ``epoch_cache_inner``, and "auto" inserts a
+            geometric mid level when top/inner > 8 so no level's rebuild
+            sweeps more than ~8 blocks' worth of parent-cache rows
+            (PERF.md round 3).  ``epoch_cache_levels`` overrides: "off"
+            disables the ladder, a comma list (or tuple) names explicit
+            sizes."""
+            cfg_levels = getattr(self.config, "epoch_cache_levels", "auto")
+            if cfg_levels in ("off", "", None):
+                return []
+            if cfg_levels != "auto":
+                if isinstance(cfg_levels, str):
+                    return [int(s) for s in cfg_levels.split(",")
+                            if s.strip()]
+                return [int(s) for s in cfg_levels]
+            chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
+            inner = int(getattr(self.config, "epoch_cache_inner", 8))
+            sizes, cur = [], nb
+            if 0 < chunk < cur and cur % chunk == 0:
+                sizes.append(chunk)
+                cur = chunk
+            if 0 < inner < cur and cur % inner == 0:
+                if cur // inner > 8:
+                    import math
+                    target = math.isqrt(cur * inner)
+                    cands = [s for s in range(inner + 1, cur)
+                             if cur % s == 0 and s % inner == 0]
+                    if cands:
+                        sizes.append(min(cands,
+                                         key=lambda s: abs(s - target)))
+                sizes.append(inner)
+            return sizes
+
+        def ladder_meta(nb, slots_ep, rows0):
+            """Static ladder plan [(size, {op: cache rows}), ...]: at
+            each level every op whose padded block cache would be
+            smaller than its current parent cache participates; a level
+            nobody joins is dropped.  Pure shape math — the traced twin
+            is ladder_arrays."""
+            meta, rows, cur = [], dict(rows0), nb
+            for size in ladder_sizes(nb):
+                if not (0 < size < cur and cur % size == 0):
+                    continue
+                part = {}
+                for name, sl in slots_ep.items():
+                    per_step = int(np.prod(sl.shape[1:]))
+                    pack = op_pack[name]
+                    m = -(-(size * per_step) // pack) * pack
+                    if m < rows[name]:
+                        part[name] = m
+                if part:
+                    meta.append((size, part))
+                    rows.update(part)
+                    cur = size
+            return meta
+
+        def ladder_arrays(slots, meta, rows):
+            """The ladder's slot plans, precomputed OUTSIDE the scans
+            (the slot math — ops/slotting.py sorts — depends only on the
+            epoch's ids, so under ``train_epochs`` it runs once for ALL
+            fused epochs).  Returns a nested pytree consumed as scan xs:
+            each level {"rowof": {op: (nblk, m)}, "next": ...}; the leaf
+            carries the per-step slots into each op's innermost cache."""
+            if not meta:
+                return {"slots": slots}
+            from .ops.slotting import slot_rows
+            (size, part), rest = meta[0], meta[1:]
+            nb = next(iter(slots.values())).shape[0]
+            nblk = nb // size
+            blks = {n: s.reshape((nblk, size) + s.shape[1:])
+                    for n, s in slots.items()}
+
+            def per_block(blk):
+                rowof_d, slots_d = {}, {}
+                for name, b in blk.items():
+                    if name in part:
+                        rowof, s = slot_rows(b, rows[name])
+                        m, n = part[name], int(np.prod(b.shape))
+                        if m > n:
+                            rowof = jnp.concatenate(
+                                [rowof, jnp.full((m - n,), rows[name],
+                                                 rowof.dtype)])
+                        rowof_d[name], slots_d[name] = rowof, s
+                    else:
+                        slots_d[name] = b
+                return {"rowof": rowof_d,
+                        "next": ladder_arrays(slots_d, rest,
+                                              {**rows, **part})}
+
+            return jax.vmap(per_block)(blks)
+
+        def ladder_scan(state, inputs, labels, meta, arrs):
+            """Nested scans down the ladder: each level pulls its
+            block's rows from the parent cache (one gather at the
+            precomputed rowof), recurses against the block cache, and
+            writes the final rows back — so the per-step table cost
+            scales with the innermost block's rows while each level's
+            rebuild sweep amortizes over its block length.  Exactness:
+            every distinct parent row has exactly ONE slot in the block
+            cache, so the same adds hit the same values in the same
+            order at every level (the single-level proof composes)."""
+            if not meta:
+                def body(st, batch):
+                    binputs, blabels, bslots = batch
+                    return train_step(st, binputs, blabels,
+                                      slot_override=bslots)
+                return jax.lax.scan(body, state,
+                                    (inputs, labels, arrs["slots"]))
+            (size, part), rest = meta[0], meta[1:]
+            nb = labels.shape[0]
+
+            def blk(x):
+                return x.reshape((nb // size, size) + x.shape[1:])
+
+            def outer(st, xs_k):
+                in_k, lab_k, a_k = xs_k
+                params2 = dict(st.params)
+                wb = []
+                for name in part:
+                    parent = st.params[name]["embedding"]
+                    rowof = a_k["rowof"][name]
+                    params2[name] = {"embedding": jnp.take(
+                        parent, rowof, axis=0, mode="clip")}
+                    wb.append((name, rowof, parent))
+                st2 = TrainState(params2, st.opt_state, st.bn_state,
+                                 st.rng, st.step)
+                st2, mets_k = ladder_scan(st2, in_k, lab_k, rest,
+                                          a_k["next"])
+                new_p = dict(st2.params)
+                for name, rowof, parent in wb:
+                    new_p[name] = {"embedding": parent.at[rowof].set(
+                        st2.params[name]["embedding"], mode="drop")}
+                st3 = TrainState(new_p, st2.opt_state, st2.bn_state,
+                                 st2.rng, st2.step)
+                return st3, mets_k
+
+            return jax.lax.scan(outer, state,
+                                (jax.tree.map(blk, inputs), blk(labels),
+                                 arrs))
+
+        def epoch_scan(state, inputs, labels, slots_ep, meta, arrs):
             """Scan one epoch's steps against the (cached) tables; returns
             (state, per-epoch folded metrics)."""
-            def body(st, batch):
-                binputs, blabels, bslots = batch
-                new_st, mets = train_step(st, binputs, blabels,
-                                          slot_override=bslots)
-                return new_st, mets
-
-            nb = labels.shape[0]
-            inner = int(getattr(self.config, "epoch_cache_inner", 8))
-            if slots_ep and 0 < inner < nb and nb % inner == 0:
-                # Second cache level, in-graph: the chunk cache's own
-                # per-step sweep still scales with the CHUNK's rows, so
-                # each ``inner``-step block pulls its rows into an L0
-                # cache from the chunk cache (exact, same construction),
-                # scans against L0, and writes back — per-step cache
-                # bytes now scale with the BLOCK's rows (PERF.md).
-                def blk(x):
-                    return x.reshape((nb // inner, inner) + x.shape[1:])
-
-                cached = [op.name for op in sparse_emb
-                          if op.name in slots_ep]
-
-                def outer_body(st, xs_k):
-                    in_k, lab_k, sl_k = xs_k
-                    params2 = dict(st.params)
-                    sl0_k = dict(sl_k)
-                    l0_meta = []
-                    for name in cached:
-                        l1 = st.params[name]["embedding"]
-                        built = build_cache(l1, sl_k[name], op_pack[name])
-                        if built is None:  # static: tiny L1, skip L0
-                            continue
-                        l0, sl0, u0 = built
-                        params2[name] = {"embedding": l0}
-                        sl0_k[name] = sl0
-                        l0_meta.append((name, u0, l1))
-                    st2 = TrainState(params2, st.opt_state, st.bn_state,
-                                     st.rng, st.step)
-                    st2, mets_k = jax.lax.scan(body, st2,
-                                               (in_k, lab_k, sl0_k))
-                    new_p = dict(st2.params)
-                    for name, u0, l1 in l0_meta:
-                        l0_final = st2.params[name]["embedding"]
-                        new_p[name] = {"embedding": l1.at[u0].set(
-                            l0_final, mode="drop")}
-                    st3 = TrainState(new_p, st2.opt_state, st2.bn_state,
-                                     st2.rng, st2.step)
-                    return st3, mets_k
-
-                state, mets = jax.lax.scan(
-                    outer_body, state,
-                    (jax.tree.map(blk, inputs), blk(labels),
-                     jax.tree.map(blk, slots_ep)))
+            if meta:
+                state, mets = ladder_scan(state, inputs, labels, meta,
+                                          arrs)
             else:
+                def body(st, batch):
+                    binputs, blabels, bslots = batch
+                    return train_step(st, binputs, blabels,
+                                      slot_override=bslots)
                 state, mets = jax.lax.scan(body, state,
                                            (inputs, labels, slots_ep))
             folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
                       for k, v in mets.items()}
             return state, folded
+
+        def ladder_plan(state, slots_ep, nb):
+            """(meta, arrays) of the in-graph ladder, or ({}, None)."""
+            if not slots_ep:
+                return [], None
+            rows0 = {name: state.params[name]["embedding"].shape[0]
+                     for name in slots_ep}
+            meta = ladder_meta(nb, slots_ep, rows0)
+            if not meta:
+                return [], None
+            return meta, ladder_arrays(slots_ep, meta, rows0)
 
         def cache_epilogue(state, writebacks, orig_tables):
             """Write the final rows back, each unique slot exactly once
@@ -811,7 +921,9 @@ class FFModel:
             batches resident on device; ``labels``: (nb, batch, ...).
             """
             state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
-            state, folded = epoch_scan(state, inputs, labels, slots_ep)
+            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0])
+            state, folded = epoch_scan(state, inputs, labels, slots_ep,
+                                       meta, arrs)
             return cache_epilogue(state, writebacks, orig), folded
 
         def train_epochs(state: TrainState, inputs, labels, n_epochs: int):
@@ -825,9 +937,10 @@ class FFModel:
             Returns per-epoch folded metrics stacked on a leading
             (n_epochs,) axis."""
             state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
+            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0])
 
             def ep_body(st, _):
-                return epoch_scan(st, inputs, labels, slots_ep)
+                return epoch_scan(st, inputs, labels, slots_ep, meta, arrs)
 
             state, stacked = jax.lax.scan(ep_body, state, None,
                                           length=n_epochs)
@@ -1038,6 +1151,13 @@ class FFModel:
         L0 level stays engaged for non-divisible epoch lengths."""
         chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
         if not (self._epoch_cache_active and chunk > 0 and nb > chunk):
+            return None
+        levels = getattr(self.config, "epoch_cache_levels", "auto")
+        if levels == "auto" and nb % chunk == 0:
+            # the in-graph ladder scans chunk-sized blocks INSIDE the
+            # jitted epoch, so the whole (multi-epoch) run is one
+            # dispatch with one prologue; host-side chunking remains
+            # only for epochs the chunk does not divide
             return None
         inner = int(getattr(self.config, "epoch_cache_inner", 8))
         if inner > 1 and chunk > inner:

@@ -22,9 +22,7 @@ import dlrm_flexflow_tpu as ff
 from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
 from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
 
-KAGGLE_TABLES = [1396, 550, 1761917, 507795, 290, 21, 11948, 608, 3,
-                 58176, 5237, 1497287, 3127, 26, 12153, 1068715, 10,
-                 4836, 2085, 4, 1312273, 17, 15, 110946, 91, 72655]
+from dlrm_flexflow_tpu.apps.dlrm import KAGGLE_TABLES  # noqa: E402
 
 n_dev = jax.device_count()
 model_ax = 2 if n_dev % 2 == 0 and n_dev >= 2 else 1

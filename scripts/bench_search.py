@@ -1,0 +1,133 @@
+"""SOAP strategy-search benchmark — the BASELINE.json north star's
+second axis (search wall-clock) as a recorded artifact.
+
+The reference materializes its search as a Legion task that runs the
+MCMC chain against the measured simulator and exports the best strategy
+to a .pb (reference src/runtime/simulator.cu:78-109 for the measured
+costs, model.cc:1093-1144 for the chain, dlrm_strategy*.cc for the
+exported artifacts).  This script does the same on the TPU slice:
+
+  python scripts/bench_search.py               # both graphs, native+python
+  BENCH_GRAPH=dlrm|inception BENCH_BUDGET=N BENCH_DEVICES=M ...
+
+For each graph it records: search wall-clock, iterations/s for the
+native (C++) and python chains, the best simulated step time vs the
+data-parallel starting point, and writes the searched strategy to
+``artifacts/strategy_<graph>_<devices>dev.pb`` (proto2 wire-compatible
+with the reference's strategy files, parallel/strategy_pb.py).  Each
+run appends a bench_history.json entry under app="search_<graph>"
+with value = iterations/s (native chain) so rounds accumulate against
+the first fenced anchor like every other config.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_graph(name: str):
+    import dlrm_flexflow_tpu as ff
+
+    if name == "dlrm":
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig()
+        cfg.embedding_size = [1_000_000] * 8
+        model = build_dlrm(cfg, ff.FFConfig(batch_size=256))
+    elif name == "inception":
+        from dlrm_flexflow_tpu.apps.inception import build_inception
+        model = build_inception(ff.FFConfig(batch_size=64))
+    else:
+        raise SystemExit(f"unknown BENCH_GRAPH {name!r}")
+    # compile resolves the optimizer/loss graph state the simulator reads
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type=("mean_squared_error" if name == "dlrm"
+                             else "sparse_categorical_crossentropy"),
+                  metrics=(), mesh=False)
+    return model
+
+
+def run_one(graph: str, devices: int, budget: int):
+    import jax
+
+    from dlrm_flexflow_tpu.sim.cost_model import CostModel
+    from dlrm_flexflow_tpu.sim.search import (data_parallel_strategy,
+                                              mcmc_search)
+    from dlrm_flexflow_tpu.sim.simulator import Simulator
+    from dlrm_flexflow_tpu.parallel.strategy_pb import save_strategy_pb
+
+    model = build_graph(graph)
+    on_tpu = jax.default_backend() == "tpu"
+
+    # measured per-op costs (one shared CostModel so both chains and the
+    # final comparison price ops identically; measurement happens once)
+    t0 = time.perf_counter()
+    cm = CostModel(measure=on_tpu)
+    sim = Simulator(model, devices, cost_model=cm)
+    dp_time = sim.simulate(data_parallel_strategy(model, devices))
+    measure_s = time.perf_counter() - t0
+
+    results = {"graph": graph, "devices": devices, "budget": budget,
+               "measured_costs": bool(on_tpu),
+               "measure_s": round(measure_s, 2),
+               "dp_simulated_ms": round(dp_time * 1e3, 4)}
+
+    best = None
+    for backend in ("native", "python"):
+        t0 = time.perf_counter()
+        try:
+            strategy = mcmc_search(model, devices, budget=budget,
+                                   simulator=sim, backend=backend)
+        except Exception as e:  # native lib may be unbuilt on this host
+            results[backend] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        dt = time.perf_counter() - t0
+        stime = sim.simulate(strategy)
+        results[backend] = {
+            "wall_s": round(dt, 3),
+            "iters_per_s": round(budget / dt, 1),
+            "best_simulated_ms": round(stime * 1e3, 4),
+            "vs_dp": round(dp_time / stime, 3),
+        }
+        if best is None or stime < best[1]:
+            best = (strategy, stime)
+
+    if best is not None:
+        art_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts")
+        os.makedirs(art_dir, exist_ok=True)
+        path = os.path.join(art_dir,
+                            f"strategy_{graph}_{devices}dev.pb")
+        save_strategy_pb(path, best[0])
+        results["artifact"] = os.path.relpath(
+            path, os.path.dirname(art_dir))
+    return results
+
+
+def main():
+    budget = int(os.environ.get("BENCH_BUDGET", 1000))
+    devices = int(os.environ.get("BENCH_DEVICES", 8))
+    graphs = os.environ.get("BENCH_GRAPH", "dlrm,inception").split(",")
+    from bench import _emit
+
+    for graph in graphs:
+        res = run_one(graph.strip(), devices, budget)
+        print(json.dumps(res))
+        nat = res.get("native")
+        if nat and "iters_per_s" in nat:
+            _emit(f"search_{graph}_iters_per_sec", nat["iters_per_s"],
+                  {"app": f"search_{graph}", "devices": devices,
+                   "budget": budget},
+                  extra={"wall_s": nat["wall_s"],
+                         "vs_dp": nat["vs_dp"],
+                         "python_iters_per_s":
+                             res.get("python", {}).get("iters_per_s"),
+                         "dp_simulated_ms": res["dp_simulated_ms"],
+                         "best_simulated_ms": nat["best_simulated_ms"],
+                         "measured_costs": res["measured_costs"]})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Training on Criteo-format and Zipf-skewed data (VERDICT r2 item 3):
+the HDF5 loader round-trips the reference's preprocess format, and the
+epoch row-cache stays engaged and beneficial under realistic id skew
+(reference examples/cpp/DLRM/dlrm.cc:266-382, preprocess_hdf.py)."""
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.data.loader import (ArrayDataLoader, ZipfDLRMLoader,
+                                           load_criteo_h5,
+                                           preprocess_criteo_npz, zipf_ids)
+
+TABLES = [512, 64, 2048, 16, 256]
+
+
+def test_zipf_ids_are_skewed_and_bounded():
+    rng = np.random.default_rng(0)
+    ids = zipf_ids(rng, 1000, (20_000,), a=1.05)
+    assert ids.min() >= 0 and ids.max() < 1000
+    # heavy head: the top-10 rows carry far more than 10/1000 of the mass
+    _, counts = np.unique(ids, return_counts=True)
+    top10 = np.sort(counts)[-10:].sum()
+    assert top10 > 0.25 * ids.size
+    # far fewer distinct rows than lookups — the row-cache premise
+    assert len(counts) < 0.5 * ids.size
+
+
+def test_npz_preprocess_h5_roundtrip(tmp_path):
+    # reference preprocess_hdf.py semantics: X_int -> log1p float32,
+    # X_cat -> int64, y -> float32
+    rng = np.random.default_rng(1)
+    n = 64
+    np.savez(tmp_path / "raw.npz",
+             X_int=rng.integers(0, 100, size=(n, 13)),
+             X_cat=np.stack([rng.integers(0, t, size=n) for t in TABLES],
+                            axis=1),
+             y=rng.integers(0, 2, size=n))
+    out = preprocess_criteo_npz(str(tmp_path / "raw.npz"),
+                                str(tmp_path / "train.h5"))
+    inputs, labels = load_criteo_h5(out, stacked=True)
+    raw = np.load(tmp_path / "raw.npz")
+    assert inputs["dense"].shape == (n, 13)
+    np.testing.assert_allclose(
+        inputs["dense"], np.log(raw["X_int"].astype(np.float32) + 1),
+        rtol=1e-6)
+    assert inputs["sparse"].shape == (n, 5, 1)
+    np.testing.assert_array_equal(inputs["sparse"][:, :, 0], raw["X_cat"])
+    assert labels.shape == (n, 1)
+
+
+def _build(batch, cache="on", lr=0.05):
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=list(TABLES),
+                     embedding_bag_size=1, mlp_bot=[13, 16, 8],
+                     mlp_top=[8 + 5 * 8, 16, 1])
+    fc = ff.FFConfig(batch_size=batch, epoch_row_cache=cache)
+    m = build_dlrm(cfg, fc)
+    m.compile(optimizer=ff.SGDOptimizer(lr=lr),
+              loss_type="mean_squared_error",
+              metrics=("accuracy", "mean_squared_error"))
+    return cfg, m
+
+
+def test_criteo_h5_end_to_end(tmp_path):
+    # the reference flagship path: preprocess -> HDF5 -> train
+    rng = np.random.default_rng(2)
+    n = 8 * 16
+    np.savez(tmp_path / "raw.npz",
+             X_int=rng.integers(0, 50, size=(n, 13)),
+             X_cat=np.stack([zipf_ids(rng, t, (n,)) for t in TABLES],
+                            axis=1),
+             y=rng.integers(0, 2, size=n))
+    h5 = preprocess_criteo_npz(str(tmp_path / "raw.npz"),
+                               str(tmp_path / "train.h5"))
+    inputs, labels = load_criteo_h5(h5, stacked=True)
+    cfg, m = _build(16)
+    loader = ArrayDataLoader(inputs, labels, 16)
+    st = m.init(seed=0)
+    st, thpt = m.fit(st, loader, epochs=2, verbose=False)
+    assert int(st.step) > 0 and thpt > 0
+
+
+def test_skewed_training_learns_and_cache_is_beneficial():
+    batch, nb, epochs = 16, 8, 25
+    loader = ZipfDLRMLoader(num_samples=batch * nb, num_dense=13,
+                            table_sizes=TABLES, bag_size=1,
+                            batch_size=batch, a=1.05)
+    cfg, m = _build(batch, lr=0.2)
+    assert m._epoch_cache_active  # "on" engages off-TPU too
+    stacked = {k: v.reshape((nb, batch) + v.shape[1:])
+               for k, v in loader.inputs.items()}
+    labels = loader.labels.reshape(nb, batch, 1)
+    # cache premise holds under skew: distinct rows well under lookups
+    gids = stacked["sparse"] + np.cumsum(
+        [0] + TABLES[:-1], dtype=np.int64)[None, None, :, None]
+    assert len(np.unique(gids)) < 0.5 * gids.size
+    st = m.init(seed=0)
+    losses, accs = [], []
+    for _ in range(epochs):
+        st, mets = m.train_epoch(st, stacked, labels)
+        losses.append(float(mets["loss"]))
+        accs.append(float(mets["train_correct"]) / (nb * batch))
+    # learnable skewed signal: loss decreases, accuracy beats chance
+    assert losses[-1] < losses[0]
+    assert accs[-1] > 0.9
+    # and the cached path is exactly the uncached one (bit-exact)
+    _, m_off = _build(batch, cache="off", lr=0.2)
+    st2 = m_off.init(seed=0)
+    for _ in range(epochs):
+        st2, _ = m_off.train_epoch(st2, stacked, labels)
+    for opn in st.params:
+        for k in st.params[opn]:
+            np.testing.assert_array_equal(np.asarray(st.params[opn][k]),
+                                          np.asarray(st2.params[opn][k]))

@@ -443,6 +443,77 @@ class TestEpochRowCache:
                 np.asarray(states["on"][1][k]),
                 np.asarray(states["off"][1][k]), rtol=1e-6)
 
+    def test_three_level_ladder_equals_stepwise(self):
+        # explicit epoch_cache_levels forces a 3-deep in-graph ladder
+        # (16 -> 8 -> 4 -> 2-step blocks); every level's fetch/writeback
+        # pair must compose bit-exactly with the uncached path
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[8192] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        rng = np.random.default_rng(5)
+        nb, batch = 16, 16
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            # narrow range: rows recur across blocks at every level
+            "sparse": rng.integers(0, 40, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        states = {}
+        for mode, levels in (("on", "8,4,2"), ("off", "off")):
+            fc = ff.FFConfig(batch_size=batch, epoch_row_cache=mode,
+                             epoch_cache_levels=levels)
+            m = build_dlrm(cfg, fc)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
+            st = m.init(seed=0)
+            st, mets = m.train_epoch(st, inputs, labels)
+            states[mode] = (st, mets)
+        a, b = states["on"][0].params, states["off"][0].params
+        for opn in a:
+            for k in a[opn]:
+                np.testing.assert_array_equal(np.asarray(a[opn][k]),
+                                              np.asarray(b[opn][k]))
+        for k in states["on"][1]:
+            np.testing.assert_allclose(
+                np.asarray(states["on"][1][k]),
+                np.asarray(states["off"][1][k]), rtol=1e-6)
+
+    def test_ladder_fuses_chunked_multi_epoch(self):
+        # nb > chunk with chunk | nb: the auto ladder absorbs chunking
+        # into the jitted program (no host-side chunk dispatches), and
+        # the fused multi-epoch run matches repeated train_epoch calls
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[4096] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        rng = np.random.default_rng(6)
+        nb, batch = 8, 16
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            "sparse": rng.integers(0, 32, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        fc = ff.FFConfig(batch_size=batch, epoch_row_cache="on",
+                         epoch_cache_chunk=4, epoch_cache_inner=2)
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy",), mesh=False)
+        # chunk divides nb -> one fused dispatch, no host chunking
+        assert m._epoch_chunk_bounds(nb) is None
+        st_f = m.init(seed=0)
+        st_f, _ = m.train_epochs(st_f, inputs, labels, 2)
+        st_r = m.init(seed=0)
+        for _ in range(2):
+            st_r, _ = m.train_epoch(st_r, inputs, labels)
+        for opn in st_f.params:
+            for k in st_f.params[opn]:
+                np.testing.assert_array_equal(
+                    np.asarray(st_f.params[opn][k]),
+                    np.asarray(st_r.params[opn][k]))
+
     def test_chunk_bounds_round_to_inner(self):
         import dlrm_flexflow_tpu as ffm
         m = ffm.FFModel(ff.FFConfig(epoch_cache_chunk=256,
