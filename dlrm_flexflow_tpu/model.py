@@ -225,7 +225,8 @@ class FFModel:
         from .ops.rnn import LSTM
         op = LSTM(self._name("lstm", name), input_tensor, hidden_dim,
                   return_sequences, reverse, initial_state=initial_state,
-                  return_state=return_state)
+                  return_state=return_state,
+                  compute_dtype=self._op_compute_dtype())
         self.layers.append(op)
         if return_state:
             return op.outputs
@@ -529,10 +530,20 @@ class FFModel:
         # layout war, PERF.md; under a mesh both run on the logical shape
         # and XLA SPMD owns layouts and collectives).
         sparse_ok = sparse_mode != "off"
-        if (sparse_ok
-                and isinstance(self.optimizer, SGDOptimizer)
-                and self.optimizer.momentum == 0.0
-                and self.optimizer.weight_decay == 0.0):
+        plain_sgd = (isinstance(self.optimizer, SGDOptimizer)
+                     and self.optimizer.momentum == 0.0
+                     and self.optimizer.weight_decay == 0.0)
+        # lazy mode: momentum/Adam configs keep the row-sparse fast path
+        # by updating optimizer statistics ON TOUCH only (the documented
+        # numerics delta lives on the optimizers' lazy_embeddings flag;
+        # reference counterpart: optimizer_kernel.cu:134-235 rewrites
+        # every row every step)
+        lazy_mode = (not plain_sgd
+                     and getattr(self.optimizer, "lazy_embeddings", False)
+                     and hasattr(self.optimizer, "lazy_row_update"))
+        lazy_slots = (tuple(self.optimizer.slot_names())
+                      if lazy_mode else ())
+        if sparse_ok and (plain_sgd or lazy_mode):
             for op in self.layers:
                 if (isinstance(op, (Embedding, StackedEmbedding,
                                     RaggedStackedEmbedding))
@@ -568,6 +579,71 @@ class FFModel:
                 return packed_gather(cache, slots)
             return jnp.take(cache, slots, axis=0)
 
+        def _slot_space(st, sn, name):
+            """The optimizer-slot table row-addressed like the param
+            (cache mode swaps it for a slot cache, exactly as the
+            param's table — see cache_prologue)."""
+            return st.opt_state[sn][name]["embedding"]
+
+        def lazy_update(state, op, tb, slots, inputs, w_rows, g_rows):
+            """Row-lazy optimizer step (momentum/Adam on touch): sum
+            duplicate ids' grads per row, run the optimizer's row math
+            once per distinct row (duplicates compute identical
+            values), write back as a first-occurrence-masked DELTA
+            through the same packed scatter-add the plain-SGD path uses
+            — so gather and scatter keep agreeing on the table layout
+            (ops/pallas_scatter.use_packed_view), and the cached and
+            uncached lazy paths share one formulation bit-for-bit.
+            Returns (new_table, {slot name: new slot table})."""
+            from .ops.pallas_scatter import sparse_row_update
+            from .ops.slotting import slot_rows as _slot_positions
+            d = tb.shape[-1]
+            space = tb.reshape(-1, d)
+            if slots is None:
+                sl = op.flat_ids(
+                    inputs[id_name[op.name]].astype(jnp.int32)).reshape(-1)
+            else:
+                sl = slots.reshape(-1)
+            n = sl.shape[0]
+            g_flat = g_rows.reshape(-1, d).astype(jnp.float32)
+            # duplicate ids: the dense backward sums their grads before
+            # one nonlinear update — dedup with occurrence-sized buffers
+            # (first-position segment sum, ops/slotting.py), never a
+            # table-sized temp
+            _, occ = _slot_positions(sl, space.shape[0])
+            occ = occ.reshape(-1)  # shared run id per occurrence
+            seg = jnp.zeros((n, d), jnp.float32).at[occ].add(g_flat)
+            g_row = jnp.take(seg, occ, axis=0)
+            # one representative occurrence per run (occ values are
+            # sorted-order positions, NOT original positions — pick the
+            # minimum original position of each run via a scatter-min)
+            pos = jnp.arange(n, dtype=jnp.int32)
+            repmin = jnp.full((n,), n, jnp.int32).at[occ].min(pos)
+            first = (pos == jnp.take(repmin, occ, axis=0))[:, None]
+            slot_rows_cur = {
+                sn: _cache_gather(
+                    _slot_space(state, sn, op.name).reshape(-1, d), sl)
+                for sn in lazy_slots}
+            w_flat = w_rows.reshape(-1, d).astype(jnp.float32)
+            new_w, new_slot_rows = self.optimizer.lazy_row_update(
+                w_flat, g_row, slot_rows_cur, state.opt_state)
+            # first-occurrence-masked delta: duplicates add exact 0.0,
+            # so one add lands per touched row, via the packed view
+            dw = jnp.where(first, new_w.astype(jnp.float32) - w_flat, 0.0)
+            new_tb = sparse_row_update(space, sl, dw, 1.0,
+                                       allow_kernel=mesh_ is None
+                                       ).reshape(tb.shape)
+            new_slot_tabs = {}
+            for sn in lazy_slots:
+                ssp = _slot_space(state, sn, op.name)
+                dslot = jnp.where(first,
+                                  new_slot_rows[sn] - slot_rows_cur[sn],
+                                  0.0)
+                new_slot_tabs[sn] = sparse_row_update(
+                    ssp.reshape(-1, d), sl, dslot, 1.0,
+                    allow_kernel=mesh_ is None).reshape(ssp.shape)
+            return new_tb, new_slot_tabs
+
         def train_step(state: TrainState, inputs, labels, slot_override=None):
             """One SGD step.  ``slot_override`` (epoch row-cache mode) maps
             op name -> cache-slot ids for this batch; the op's "embedding"
@@ -598,13 +674,32 @@ class FFModel:
                 (loss, (preds, new_bn)), (dgrads, rgrads) = grad_fn(
                     dense_params, rows_dict, tables, inputs, labels, rng,
                     state.bn_state)
+                opt_in = state.opt_state
+                if lazy_slots:
+                    # the dense update's tree_map must see dense-only
+                    # slot trees; the emb entries are updated lazily
+                    opt_in = dict(opt_in)
+                    for sn in lazy_slots:
+                        opt_in[sn] = {k: v for k, v in opt_in[sn].items()
+                                      if k not in emb_names}
                 new_params, new_opt = self.optimizer.update(
-                    dense_params, dgrads, state.opt_state)
+                    dense_params, dgrads, opt_in)
                 lr = state.opt_state.get("lr", self.optimizer.lr)
                 new_params = dict(new_params)
+                if lazy_slots:
+                    new_opt = dict(new_opt)
+                    for sn in lazy_slots:
+                        new_opt[sn] = dict(new_opt[sn])
                 for op in sparse_emb:
                     slots = slot_override.get(op.name)
-                    if slots is None:
+                    if lazy_mode:
+                        upd, slot_upd = lazy_update(
+                            state, op, tables[op.name], slots,
+                            inputs, rows_dict[op.name], rgrads[op.name])
+                        for sn in lazy_slots:
+                            new_opt[sn][op.name] = {
+                                "embedding": slot_upd[sn]}
+                    elif slots is None:
                         upd = op.scatter_apply(
                             tables[op.name], inputs[id_name[op.name]],
                             rgrads[op.name], -lr)
@@ -698,12 +793,36 @@ class FFModel:
         op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
                    for op in sparse_emb}
 
+        def _cache_writeback(parent, rowof, cache_final):
+            """THE cache writeback all levels share: live rows set once,
+            sentinel holes dropped — param and optimizer-slot tables
+            must stay bit-identical in this formulation for the
+            hierarchy's exactness claim."""
+            fl = parent.reshape(-1, parent.shape[-1])
+            return fl.at[rowof].set(cache_final,
+                                    mode="drop").reshape(parent.shape)
+
+        def _swap_slot_caches(opt_state, name, fn):
+            """Rebuild opt_state with each lazy slot table of ``name``
+            replaced by fn(flat_slot_table)."""
+            opt_state = dict(opt_state)
+            for sn in lazy_slots:
+                tree = dict(opt_state[sn])
+                old = tree[name]["embedding"]
+                tree[name] = {"embedding": fn(
+                    old.reshape(-1, old.shape[-1]))}
+                opt_state[sn] = tree
+            return opt_state
+
         def cache_prologue(state, inputs):
             """Per eligible op, map the epoch's ids to unique cache slots
-            and pull the touched rows in with one table sweep.  Returns
-            (state-with-caches, slots, writebacks, orig_tables)."""
+            and pull the touched rows in with one table sweep (plus, in
+            lazy mode, the optimizer slot tables — same rowof, same
+            slots).  Returns (state-with-caches, slots, writebacks,
+            originals)."""
             params = dict(state.params)
-            slots_ep, writebacks, orig_tables = {}, [], {}
+            opt_state = state.opt_state
+            slots_ep, writebacks, originals = {}, [], {}
             for op in (sparse_emb if epoch_cache else ()):
                 ids = inputs[id_name[op.name]].astype(jnp.int32)
                 tb = params[op.name]["embedding"]
@@ -714,14 +833,22 @@ class FFModel:
                     # cache would be as big as the table — no win; keep
                     # this op on the direct per-step path
                     continue
-                cache, slots, uniq = built
-                orig_tables[op.name] = tb
+                cache, slots, rowof = built
+                originals[op.name] = tb
                 params[op.name] = {"embedding": cache}
                 slots_ep[op.name] = slots
-                writebacks.append((op.name, tb.shape, uniq))
-            state = TrainState(params, state.opt_state, state.bn_state,
+                writebacks.append((op.name, tb.shape, rowof))
+                if lazy_slots:
+                    for sn in lazy_slots:
+                        originals[(sn, op.name)] = (
+                            opt_state[sn][op.name]["embedding"])
+                    opt_state = _swap_slot_caches(
+                        opt_state, op.name,
+                        lambda fl: jnp.take(fl, rowof, axis=0,
+                                            mode="clip"))
+            state = TrainState(params, opt_state, state.bn_state,
                                state.rng, state.step)
-            return state, slots_ep, writebacks, orig_tables
+            return state, slots_ep, writebacks, originals
 
         def ladder_sizes(nb):
             """Static block sizes of the in-graph cache ladder for an
@@ -844,22 +971,40 @@ class FFModel:
             def outer(st, xs_k):
                 in_k, lab_k, a_k = xs_k
                 params2 = dict(st.params)
-                wb = []
+                opt2 = st.opt_state
+                wb, slot_wb = [], []
                 for name in part:
                     parent = st.params[name]["embedding"]
                     rowof = a_k["rowof"][name]
                     params2[name] = {"embedding": jnp.take(
                         parent, rowof, axis=0, mode="clip")}
                     wb.append((name, rowof, parent))
-                st2 = TrainState(params2, st.opt_state, st.bn_state,
+                    if lazy_slots:
+                        for sn in lazy_slots:
+                            slot_wb.append(
+                                (sn, name, rowof,
+                                 opt2[sn][name]["embedding"]))
+                        opt2 = _swap_slot_caches(
+                            opt2, name,
+                            lambda fl, r=rowof: jnp.take(
+                                fl, r, axis=0, mode="clip"))
+                st2 = TrainState(params2, opt2, st.bn_state,
                                  st.rng, st.step)
                 st2, mets_k = ladder_scan(st2, in_k, lab_k, rest,
                                           a_k["next"])
                 new_p = dict(st2.params)
+                opt3 = st2.opt_state
                 for name, rowof, parent in wb:
-                    new_p[name] = {"embedding": parent.at[rowof].set(
-                        st2.params[name]["embedding"], mode="drop")}
-                st3 = TrainState(new_p, st2.opt_state, st2.bn_state,
+                    new_p[name] = {"embedding": _cache_writeback(
+                        parent, rowof, st2.params[name]["embedding"])}
+                for sn, name, rowof, parent in slot_wb:
+                    opt3 = dict(opt3)
+                    tree = dict(opt3[sn])
+                    tree[name] = {"embedding": _cache_writeback(
+                        parent, rowof,
+                        st2.opt_state[sn][name]["embedding"])}
+                    opt3[sn] = tree
+                st3 = TrainState(new_p, opt3, st2.bn_state,
                                  st2.rng, st2.step)
                 return st3, mets_k
 
@@ -895,20 +1040,28 @@ class FFModel:
                 return [], None
             return meta, ladder_arrays(slots_ep, meta, rows0)
 
-        def cache_epilogue(state, writebacks, orig_tables):
-            """Write the final rows back, each unique slot exactly once
+        def cache_epilogue(state, writebacks, originals):
+            """Write the final rows back, each live slot exactly once
             (set, not add — bit-exact with the per-step path); sentinel
-            indices (padding/duplicate fill) are dropped."""
+            indices (padding holes) are dropped.  Lazy mode writes the
+            optimizer slot caches back the same way."""
             if not writebacks:
                 return state
             new_params = dict(state.params)
-            for name, tb_shape, uniq in writebacks:
-                d = tb_shape[-1]
-                cache_final = state.params[name]["embedding"]
-                flat = orig_tables[name].reshape(-1, d)
-                flat = flat.at[uniq].set(cache_final, mode="drop")
-                new_params[name] = {"embedding": flat.reshape(tb_shape)}
-            return TrainState(new_params, state.opt_state,
+            opt_state = state.opt_state
+            for name, tb_shape, rowof in writebacks:
+                new_params[name] = {"embedding": _cache_writeback(
+                    originals[name], rowof,
+                    state.params[name]["embedding"])}
+                if lazy_slots:
+                    opt_state = dict(opt_state)
+                    for sn in lazy_slots:
+                        tree = dict(opt_state[sn])
+                        tree[name] = {"embedding": _cache_writeback(
+                            originals[(sn, name)], rowof,
+                            state.opt_state[sn][name]["embedding"])}
+                        opt_state[sn] = tree
+            return TrainState(new_params, opt_state,
                               state.bn_state, state.rng, state.step)
 
         def train_epoch(state: TrainState, inputs, labels):

@@ -34,12 +34,15 @@ import numpy as np
 _BLOCK = int(__import__("os").environ.get("FF_SCATTER_BLOCK", 16))
 # ^ update slots per grid step (unrolled in-kernel); env-overridable for
 #   block-size sweeps on real hardware (scripts/ab_scatter.py)
-_PIPELINE = __import__("os").environ.get("FF_SCATTER_PIPELINE", "0") == "1"
-# ^ opt-in software-pipelined kernel (_row_update_kernel_v2).  STAYS
-#   opt-in until an on-hardware stress test (adversarial duplicate runs
-#   straddling block boundaries) confirms the cross-step DMA no-race
-#   argument — interpret mode does not model real async DMA timing
-#   (see _row_update_kernel_v2's docstring for the argument itself).
+_PIPELINE = __import__("os").environ.get("FF_SCATTER_PIPELINE", "1") != "0"
+# ^ software-pipelined kernel (_row_update_kernel_v2), DEFAULT since
+#   round 3: the on-hardware stress suite (scripts/stress_scatter.py —
+#   adversarial duplicate runs straddling every block boundary,
+#   whole-stream runs, all-unique writeback load, and a 20x determinism
+#   hammer) passed bit-exactly on the real chip on 2026-07-31,
+#   confirming the cross-step DMA no-race argument that interpret mode
+#   cannot model (see _row_update_kernel_v2's docstring for the
+#   argument itself).  FF_SCATTER_PIPELINE=0 restores the serial v1.
 _IMPL = __import__("os").environ.get("FF_SCATTER_IMPL", "auto")
 # ^ TPU sparse-update implementation (A/B on real hardware):
 #   "auto"   — lane-packed XLA scatter-add on the (R/pack, 128) view

@@ -35,12 +35,17 @@ class LSTM(Op):
     def __init__(self, name, input_tensor, hidden_dim: int,
                  return_sequences: bool = True, reverse: bool = False,
                  kernel_initializer=None, initial_state=None,
-                 return_state: bool = False):
+                 return_state: bool = False, compute_dtype=None):
         inputs = [input_tensor]
         if initial_state is not None:
             h0, c0 = initial_state
             inputs += [h0, c0]
         super().__init__(name, inputs)
+        # bf16 MXU gates with f32 accumulation (FFConfig.compute_dtype):
+        # both the hoisted input projection and the in-scan recurrent
+        # matmul ride the MXU at bf16 rate; gate nonlinearities and the
+        # cell state stay f32 (same policy as ops/linear.py matmul)
+        self.compute_dtype = compute_dtype
         b, t, i = input_tensor.shape
         self.hidden_dim = int(hidden_dim)
         self.input_dim = i
@@ -80,14 +85,18 @@ class LSTM(Op):
         if self.reverse:
             x = jnp.flip(x, axis=1)
 
+        from .base import matmul
+
         # hoist the input projection out of the scan: one big (B*T, I)x(I,4H)
         # MXU matmul instead of T small ones
-        x_proj = jnp.einsum("bti,ij->btj", x, wx,
-                            preferred_element_type=jnp.float32) + bias
+        x_proj = matmul(x, wx, self.compute_dtype) + bias
+
+        if self.compute_dtype in ("bfloat16", jnp.bfloat16):
+            wh = wh.astype(jnp.bfloat16)  # cast once, outside the scan
 
         def step(carry, xp):
             h, c = carry
-            gates = xp + h @ wh
+            gates = xp + matmul(h, wh, self.compute_dtype)
             i_g, f_g, g_g, o_g = jnp.split(gates, 4, axis=-1)
             i_g = jax.nn.sigmoid(i_g)
             f_g = jax.nn.sigmoid(f_g)

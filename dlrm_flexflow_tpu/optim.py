@@ -37,14 +37,45 @@ class Optimizer:
 
 
 class SGDOptimizer(Optimizer):
-    """reference optimizer.h:26-47 / optimizer_kernel.cu:23-43."""
+    """reference optimizer.h:26-47 / optimizer_kernel.cu:23-43.
+
+    ``lazy_embeddings``: keep the row-sparse embedding fast path even
+    with momentum/weight-decay by applying them ON TOUCH — a touched
+    row's velocity decays and updates that step, an untouched row's
+    does not (torch.optim-style lazy/sparse semantics).  NUMERICS
+    DELTA vs the dense reference kernel (optimizer_kernel.cu:23-43,
+    which rewrites every row every step): untouched rows keep a stale
+    velocity and receive no weight-decay shrinkage until next touched.
+    Off (default) = momentum/wd embedding configs take the exact dense
+    fallback."""
 
     def __init__(self, lr: float = 0.01, momentum: float = 0.0,
-                 nesterov: bool = False, weight_decay: float = 0.0):
+                 nesterov: bool = False, weight_decay: float = 0.0,
+                 lazy_embeddings: bool = False):
         self.lr = lr
         self.momentum = momentum
         self.nesterov = nesterov
         self.weight_decay = weight_decay
+        self.lazy_embeddings = lazy_embeddings
+
+    def slot_names(self):
+        """Optimizer-state tables that must row-address like the param
+        (the epoch row-cache caches them with the same slots)."""
+        return ("v",) if self.momentum != 0.0 else ()
+
+    def lazy_row_update(self, w, g, slots, opt_state):
+        """Row-wise lazy step: ``w``/``g`` (..., d) touched rows (g
+        pre-summed over duplicates), ``slots`` maps slot name -> rows
+        of that optimizer table.  Returns (new_w, new_slots)."""
+        mu, wd = self.momentum, self.weight_decay
+        lr = opt_state.get("lr", self.lr)
+        gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
+        if mu == 0.0:
+            return ((w.astype(jnp.float32) - lr * gt).astype(w.dtype), {})
+        v = mu * slots["v"] + gt
+        nxt = gt + mu * v if self.nesterov else v
+        return ((w.astype(jnp.float32) - lr * nxt).astype(w.dtype),
+                {"v": v})
 
     def init(self, params):
         # lr lives in the state so schedules can change it between steps
@@ -98,12 +129,39 @@ class AdamOptimizer(Optimizer):
 
     def __init__(self, lr: float = 0.001, beta1: float = 0.9,
                  beta2: float = 0.999, weight_decay: float = 0.0,
-                 epsilon: float = 1e-8):
+                 epsilon: float = 1e-8, lazy_embeddings: bool = False):
         self.lr = lr
         self.beta1 = beta1
         self.beta2 = beta2
         self.weight_decay = weight_decay
         self.epsilon = epsilon
+        # keep the row-sparse embedding fast path: moments update ON
+        # TOUCH only (torch.optim.SparseAdam semantics).  NUMERICS DELTA
+        # vs the dense reference kernel (optimizer_kernel.cu:134-235):
+        # untouched rows' m/v do not decay between touches and those
+        # rows receive no step, where dense Adam moves every row every
+        # step off its stale momentum.  Off (default) = exact dense
+        # fallback.
+        self.lazy_embeddings = lazy_embeddings
+
+    def slot_names(self):
+        return ("m", "v")
+
+    def lazy_row_update(self, w, g, slots, opt_state):
+        """SparseAdam row step (g pre-summed over duplicate ids; bias
+        correction uses the GLOBAL step count, like torch SparseAdam)."""
+        b1, b2, wd, eps = (self.beta1, self.beta2,
+                           self.weight_decay, self.epsilon)
+        lr = opt_state.get("lr", self.lr)
+        t = opt_state["step"] + 1
+        tf = t.astype(jnp.float32)
+        alpha_t = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+        gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
+        m = b1 * slots["m"] + (1 - b1) * gt
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(gt)
+        new_w = (w.astype(jnp.float32)
+                 - alpha_t * m / (jnp.sqrt(v) + eps)).astype(w.dtype)
+        return new_w, {"m": m, "v": v}
 
     def init(self, params):
         # moments always f32 (bf16-stored params keep f32 optimizer

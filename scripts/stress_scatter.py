@@ -1,0 +1,116 @@
+"""On-hardware stress of the pipelined scatter kernel (VERDICT r2 item
+7): adversarial duplicate-run patterns straddling block boundaries,
+executed on the real chip against the XLA scatter-add ground truth,
+plus a repeated-run determinism hammer (races are nondeterministic).
+
+  python scripts/stress_scatter.py        # prints per-pattern PASS/FAIL
+
+tests/test_scatter_stress.py wraps the same checks as slow-marked tests
+(skipped on the CPU suite — conftest pins the cpu platform; this script
+is how the checks actually run on hardware)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlrm_flexflow_tpu.ops.pallas_scatter import _BLOCK as BLOCK  # noqa: E402
+# ^ the kernel's ACTIVE block size (honors FF_SCATTER_BLOCK) so the
+#   straddling patterns align with the real DMA block boundaries
+
+
+def patterns(n, rows, rng):
+    """Adversarial sorted id streams of length n over [0, rows)."""
+    pats = {}
+    # runs that END exactly at block boundaries
+    pats["run-per-block"] = np.repeat(
+        np.arange(n // BLOCK) * 7 % rows, BLOCK)[:n]
+    # runs straddling every boundary: BLOCK-long runs offset by half
+    ids = np.repeat(np.arange(n // BLOCK + 1) * 13 % rows, BLOCK)
+    pats["straddle-half"] = ids[BLOCK // 2:BLOCK // 2 + n]
+    # one run spanning the WHOLE stream (carry through every block)
+    pats["single-run"] = np.full(n, 5)
+    # run lengths 1..k cycling (boundary positions drift every block)
+    lens = (np.arange(64) % (BLOCK + 3)) + 1
+    ids = np.repeat(np.arange(lens.size), lens)[:n]
+    pats["drifting-runs"] = ids % rows
+    # all-unique ascending (every slot writes back, max writeback load)
+    pats["all-unique"] = np.arange(n) % rows
+    # random duplicates, sorted (the realistic case)
+    pats["random-sorted"] = np.sort(rng.integers(0, rows, size=n))
+    return {k: np.sort(v).astype(np.int32) for k, v in pats.items()}
+
+
+def check_pattern(table0, ids, upd, pipeline=True):
+    """Kernel result vs XLA scatter-add; returns max |diff|."""
+    import jax.numpy as jnp
+
+    from dlrm_flexflow_tpu.ops.pallas_scatter import (_lane_pack,
+                                                      _row_update_pallas)
+
+    rows, d = table0.shape
+    want = jnp.asarray(table0).at[jnp.asarray(ids)].add(jnp.asarray(upd))
+    if d < 128:
+        pack = 128 // d
+        view, q, packed = _lane_pack(jnp.asarray(table0),
+                                     jnp.asarray(ids), jnp.asarray(upd),
+                                     pack)
+        order = jnp.argsort(q)
+        got = _row_update_pallas(view, q[order], packed[order],
+                                 pipeline=pipeline).reshape(rows, d)
+    else:
+        got = _row_update_pallas(jnp.asarray(table0), jnp.asarray(ids),
+                                 jnp.asarray(upd), pipeline=pipeline)
+    return float(np.abs(np.asarray(got) - np.asarray(want)).max())
+
+
+def run_all(shapes=((4096, 128), (4096, 64)), n=8 * BLOCK, repeats=20,
+            verbose=True):
+    """Returns (n_failures, report list)."""
+    rng = np.random.default_rng(0)
+    report, failures = [], 0
+    for rows, d in shapes:
+        table0 = rng.standard_normal((rows, d)).astype(np.float32)
+        for name, ids in patterns(n, rows, rng).items():
+            upd = rng.standard_normal((n, d)).astype(np.float32)
+            err = check_pattern(table0, ids, upd)
+            ok = err <= 1e-4
+            failures += not ok
+            report.append((f"{rows}x{d}/{name}", err, ok))
+            if verbose:
+                print(f"{rows}x{d:4d} {name:15s} max|diff|={err:.2e} "
+                      f"{'PASS' if ok else 'FAIL'}", flush=True)
+    # determinism hammer: races are nondeterministic — require
+    # bit-identical results across repeats of a straddling pattern
+    rows, d = shapes[0]
+    table0 = rng.standard_normal((rows, d)).astype(np.float32)
+    ids = np.repeat(np.arange(n // BLOCK + 1) * 3, BLOCK)
+    ids = np.sort(ids[BLOCK // 2:BLOCK // 2 + n]).astype(np.int32)
+    upd = rng.standard_normal((n, d)).astype(np.float32)
+    import jax.numpy as jnp
+    from dlrm_flexflow_tpu.ops.pallas_scatter import _row_update_pallas
+    ref = None
+    stable = True
+    for _ in range(repeats):
+        got = np.asarray(_row_update_pallas(
+            jnp.asarray(table0), jnp.asarray(ids), jnp.asarray(upd),
+            pipeline=True))
+        if ref is None:
+            ref = got
+        elif not np.array_equal(got, ref):
+            stable = False
+    failures += not stable
+    report.append(("determinism-hammer", 0.0 if stable else float("nan"),
+                   stable))
+    if verbose:
+        print(f"determinism x{repeats}: "
+              f"{'PASS' if stable else 'FAIL'}", flush=True)
+    return failures, report
+
+
+if __name__ == "__main__":
+    fails, _ = run_all()
+    print(f"{'ALL PASS' if fails == 0 else f'{fails} FAILURES'}")
+    sys.exit(1 if fails else 0)
