@@ -609,7 +609,11 @@ class FFModel:
             # duplicate ids: the dense backward sums their grads before
             # one nonlinear update — dedup with occurrence-sized buffers
             # (first-position segment sum, ops/slotting.py), never a
-            # table-sized temp
+            # table-sized temp.  occ/first depend only on the step's
+            # ids, so they COULD be precomputed in the prologue and ride
+            # the ladder xs like the slot plans do (removing two in-scan
+            # sorts per lazy step); left in-step until lazy mode is a
+            # benched configuration.
             _, occ = _slot_positions(sl, space.shape[0])
             occ = occ.reshape(-1)  # shared run id per occurrence
             seg = jnp.zeros((n, d), jnp.float32).at[occ].add(g_flat)
@@ -802,16 +806,24 @@ class FFModel:
             return fl.at[rowof].set(cache_final,
                                     mode="drop").reshape(parent.shape)
 
+        def _swap_opt_entry(opt_state, sn, name, arr):
+            """Rebuild opt_state with slot tree ``sn``'s entry for
+            ``name`` replaced by ``arr`` — the one dict-rebuild shared
+            by every slot-cache swap and writeback site."""
+            opt_state = dict(opt_state)
+            tree = dict(opt_state[sn])
+            tree[name] = {"embedding": arr}
+            opt_state[sn] = tree
+            return opt_state
+
         def _swap_slot_caches(opt_state, name, fn):
             """Rebuild opt_state with each lazy slot table of ``name``
             replaced by fn(flat_slot_table)."""
-            opt_state = dict(opt_state)
             for sn in lazy_slots:
-                tree = dict(opt_state[sn])
-                old = tree[name]["embedding"]
-                tree[name] = {"embedding": fn(
-                    old.reshape(-1, old.shape[-1]))}
-                opt_state[sn] = tree
+                old = opt_state[sn][name]["embedding"]
+                opt_state = _swap_opt_entry(
+                    opt_state, sn, name,
+                    fn(old.reshape(-1, old.shape[-1])))
             return opt_state
 
         def cache_prologue(state, inputs):
@@ -998,12 +1010,10 @@ class FFModel:
                     new_p[name] = {"embedding": _cache_writeback(
                         parent, rowof, st2.params[name]["embedding"])}
                 for sn, name, rowof, parent in slot_wb:
-                    opt3 = dict(opt3)
-                    tree = dict(opt3[sn])
-                    tree[name] = {"embedding": _cache_writeback(
-                        parent, rowof,
-                        st2.opt_state[sn][name]["embedding"])}
-                    opt3[sn] = tree
+                    final = st2.opt_state[sn][name]["embedding"]
+                    opt3 = _swap_opt_entry(
+                        opt3, sn, name,
+                        _cache_writeback(parent, rowof, final))
                 st3 = TrainState(new_p, opt3, st2.bn_state,
                                  st2.rng, st2.step)
                 return st3, mets_k
@@ -1053,14 +1063,12 @@ class FFModel:
                 new_params[name] = {"embedding": _cache_writeback(
                     originals[name], rowof,
                     state.params[name]["embedding"])}
-                if lazy_slots:
-                    opt_state = dict(opt_state)
-                    for sn in lazy_slots:
-                        tree = dict(opt_state[sn])
-                        tree[name] = {"embedding": _cache_writeback(
+                for sn in lazy_slots:
+                    opt_state = _swap_opt_entry(
+                        opt_state, sn, name,
+                        _cache_writeback(
                             originals[(sn, name)], rowof,
-                            state.opt_state[sn][name]["embedding"])}
-                        opt_state[sn] = tree
+                            state.opt_state[sn][name]["embedding"]))
             return TrainState(new_params, opt_state,
                               state.bn_state, state.rng, state.step)
 

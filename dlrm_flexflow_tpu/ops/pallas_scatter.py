@@ -34,7 +34,9 @@ import numpy as np
 _BLOCK = int(__import__("os").environ.get("FF_SCATTER_BLOCK", 16))
 # ^ update slots per grid step (unrolled in-kernel); env-overridable for
 #   block-size sweeps on real hardware (scripts/ab_scatter.py)
-_PIPELINE = __import__("os").environ.get("FF_SCATTER_PIPELINE", "1") != "0"
+_PIPELINE = __import__("os").environ.get(
+    "FF_SCATTER_PIPELINE", "1").strip().lower() not in ("0", "off",
+                                                        "false", "no")
 # ^ software-pipelined kernel (_row_update_kernel_v2), DEFAULT since
 #   round 3: the on-hardware stress suite (scripts/stress_scatter.py —
 #   adversarial duplicate runs straddling every block boundary,

@@ -45,19 +45,29 @@ def test_lazy_adam_keeps_sparse_path_and_caches():
     assert m2._sparse_emb_ops == []  # default stays the dense fallback
 
 
-@pytest.mark.parametrize("opt_kind", ["adam", "momentum"])
-def test_lazy_cached_equals_uncached(opt_kind):
+@pytest.mark.parametrize("opt_kind,ladder", [
+    ("adam", False), ("momentum", False),
+    ("adam", True), ("momentum", True),
+])
+def test_lazy_cached_equals_uncached(opt_kind, ladder):
     # the cache hierarchy must swap the optimizer slot tables with the
-    # same rowof as the param — bit-exact with the uncached lazy path
+    # same rowof as the param — bit-exact with the uncached lazy path;
+    # the ladder variant forces in-graph levels so ladder_scan's slot
+    # fetch/writeback is exercised too (review r3 coverage gap)
     def make():
         if opt_kind == "adam":
             return ff.AdamOptimizer(lr=0.05, lazy_embeddings=True)
         return ff.SGDOptimizer(lr=0.05, momentum=0.9,
                                lazy_embeddings=True)
-    nb, batch = 8, 8
+    nb, batch = (32, 8) if ladder else (8, 8)
     states = {}
     for cache in ("on", "off"):
         cfg, m = _build(make(), cache=cache, batch=batch)
+        if ladder:
+            m.config.epoch_cache_levels = "16,8"
+            m.compile(optimizer=make(),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
         inputs, labels = _data(cfg, nb, batch)
         assert m._sparse_emb_ops == ["emb"]
         st = m.init(seed=0)

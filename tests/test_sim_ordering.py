@@ -1,0 +1,85 @@
+"""Simulator relative-ordering sanity on the virtual CPU mesh (VERDICT
+r2 weak item 5): the ICI terms can't be validated on one chip, but the
+simulator's RANKING of clearly-separated strategies must agree with
+real wall-clock on the 8-device CPU mesh — data-parallel over all 8
+devices beats a fully-replicated (single-device-equivalent) strategy in
+both worlds."""
+
+import time
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.parallel.parallel_config import (ParallelConfig,
+                                                        Strategy)
+from dlrm_flexflow_tpu.sim.search import data_parallel_strategy
+from dlrm_flexflow_tpu.sim.simulator import Simulator
+
+pytestmark = pytest.mark.slow
+
+
+BATCH = 2048  # compute-heavy enough that DP wins in BOTH cost models
+# (at small batch the simulator legitimately ranks DP *slower* — the
+# grad all-reduce dominates the 1/8 compute — and the CPU mesh's
+# regime differs; the ordering check needs a shape where the regimes
+# agree)
+
+
+def _build(strategy, mesh):
+    model = ff.FFModel(ff.FFConfig(batch_size=BATCH))
+    x = model.create_tensor((BATCH, 512), "float32", name="x")
+    h = model.dense(x, 2048, activation="relu", name="d0")
+    h = model.dense(h, 2048, activation="relu", name="d1")
+    model.dense(h, 8, name="d2")
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error", metrics=(),
+                  mesh=mesh, strategy=strategy)
+    return model
+
+
+def _replicated(model) -> Strategy:
+    s = Strategy()
+    for op in model.layers:
+        s[op.name] = ParallelConfig(dims=(1,) * op.outputs[0].ndim,
+                                    device_ids=[0])
+    return s
+
+
+def _wall(model, steps=12):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((BATCH, 512)).astype(np.float32)
+    y = rng.standard_normal((BATCH, 8)).astype(np.float32)
+    st = model.init(seed=0)
+    st, _ = model.train_step(st, {"x": x}, y)  # compile
+    import jax
+    jax.block_until_ready(st.params["d0"]["kernel"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            # keep rebinding: train_step donates its input state
+            st, _ = model.train_step(st, {"x": x}, y)
+        jax.block_until_ready(st.params["d0"]["kernel"])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def test_dp_beats_replicated_in_sim_and_on_mesh():
+    import jax
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = ff.make_mesh({"data": 8})
+
+    probe = _build(None, mesh=False)
+    dp = data_parallel_strategy(probe, 8)
+    rep = _replicated(probe)
+    sim = Simulator(probe, 8)  # analytic costs (no TPU on this host)
+    t_dp, t_rep = sim.simulate(dp), sim.simulate(rep)
+    assert t_dp < t_rep, (t_dp, t_rep)
+
+    w_dp = _wall(_build(dp, mesh))
+    w_rep = _wall(_build(rep, mesh))
+    # same ordering on real hardware-mesh wall-clock, with margin: the
+    # replicated strategy leaves 7 devices redundant
+    assert w_dp < w_rep, (w_dp, w_rep)
