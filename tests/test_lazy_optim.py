@@ -113,6 +113,28 @@ def test_lazy_adam_stacked_3d_tables(cache):
         np.asarray(st.params["emb"]["embedding"]), w0)
 
 
+def test_hybrid_strategy_degrades_gracefully_on_one_device():
+    # VERDICT r2 item 5: table_parallel=True with no mesh must keep the
+    # plain path's fast machinery — sparse updates AND the row cache
+    # (measured on chip: 1.15x of the identical plain model, PERF.md)
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[256] * 4,
+                     embedding_bag_size=2, mlp_bot=[4, 8],
+                     mlp_top=[8 * 4 + 8, 8, 1])
+    fc = ff.FFConfig(batch_size=8, epoch_row_cache="on")
+    m = build_dlrm(cfg, fc, table_parallel=True)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+              loss_type="mean_squared_error", metrics=("accuracy",),
+              mesh=False)
+    assert m.mesh is None
+    assert m._sparse_emb_ops == ["emb"]
+    assert m._epoch_cache_active
+    # and the meshless execution path actually runs
+    inputs, labels = _data(cfg, 4, 8, seed=9)
+    st = m.init(seed=0)
+    st, mets = m.train_epoch(st, inputs, labels)
+    assert np.isfinite(float(mets["loss"]))
+
+
 def test_lazy_adam_matches_torch_sparse_adam():
     torch = pytest.importorskip("torch")
     # isolate the embedding: ids -> bag-sum -> sum -> MSE against 0,
