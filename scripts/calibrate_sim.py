@@ -68,12 +68,21 @@ def measure_config(rows, batch, cost_model, nb=16, reps=3):
     return real_step, sim_step
 
 
-def calibrate_and_validate(cal=(50_000, 128), val=(100_000, 256)):
+def calibrate_and_validate(cal=(50_000, 128), val=(100_000, 256),
+                           measure_budget_s=900.0):
     """Fit the one-scalar calibration on ``cal``, validate transfer on
-    ``val``; returns a dict with both ratios."""
+    ``val``; returns a dict with both ratios.
+
+    ``measure_budget_s`` must cover BOTH configs' op measurements: when
+    the default 300 s budget expired mid-run (round 3), the val config
+    was priced on a different measured/analytic mix than the cal config
+    and the transfer ratio was meaningless (sim time DECREASED with
+    bigger tables).  FF_SIM_CAL_BUDGET overrides."""
     from dlrm_flexflow_tpu.sim import CostModel
 
-    cm = CostModel(measure=True)
+    measure_budget_s = float(os.environ.get("FF_SIM_CAL_BUDGET",
+                                            measure_budget_s))
+    cm = CostModel(measure=True, measure_budget_s=measure_budget_s)
     cal_real, cal_sim = measure_config(*cal, cost_model=cm)
     scale = cal_real / cal_sim
     val_real, val_sim = measure_config(*val, cost_model=cm)
@@ -99,8 +108,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 2:
         rows, batch = int(sys.argv[1]), int(sys.argv[2])
         from dlrm_flexflow_tpu.sim import CostModel
-        real, sim = measure_config(rows, batch,
-                                   cost_model=CostModel(measure=True))
+        budget = float(os.environ.get("FF_SIM_CAL_BUDGET", 900.0))
+        real, sim = measure_config(
+            rows, batch,
+            cost_model=CostModel(measure=True, measure_budget_s=budget))
         print(json.dumps({"real_ms": round(real * 1e3, 3),
                           "sim_ms": round(sim * 1e3, 3),
                           "ratio": round(sim / real, 3)}))
