@@ -767,6 +767,15 @@ class FFModel:
 
         # ---- epoch row-cache pieces (shared by the single-epoch and the
         # multi-epoch scanned programs) -----------------------------------
+        def _cache_fetch(parent, rowof):
+            """THE cache fill all levels share: rows of the flattened
+            parent at ``rowof``; sentinel holes clip to a garbage row
+            that nothing addresses.  Accepts raw (T, R, d) tables and
+            already-flat (R, d) caches alike (the reshape is a no-op
+            for the latter)."""
+            return jnp.take(parent.reshape(-1, parent.shape[-1]), rowof,
+                            axis=0, mode="clip")
+
         def build_cache(flat, ids, pack):
             """Shared-slot cache of the rows ``ids`` touches in the
             (R, d) source ``flat``: (cache, slots, rowof) or None when
@@ -790,8 +799,7 @@ class FFModel:
             if m > size:
                 rowof = jnp.concatenate(
                     [rowof, jnp.full((m - size,), sentinel, rowof.dtype)])
-            cache = jnp.take(flat, rowof, axis=0, mode="clip")
-            return cache, slots, rowof
+            return _cache_fetch(flat, rowof), slots, rowof
 
         from .ops.pallas_scatter import lane_pack
         op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
@@ -856,8 +864,7 @@ class FFModel:
                             opt_state[sn][op.name]["embedding"])
                     opt_state = _swap_slot_caches(
                         opt_state, op.name,
-                        lambda fl: jnp.take(fl, rowof, axis=0,
-                                            mode="clip"))
+                        lambda fl, r=rowof: _cache_fetch(fl, r))
             state = TrainState(params, opt_state, state.bn_state,
                                state.rng, state.step)
             return state, slots_ep, writebacks, originals
@@ -957,6 +964,12 @@ class FFModel:
 
             return jax.vmap(per_block)(blks)
 
+        def step_body(st, batch):
+            """The innermost scan body, shared by the flat epoch scan
+            and the ladder's leaf level."""
+            binputs, blabels, bslots = batch
+            return train_step(st, binputs, blabels, slot_override=bslots)
+
         def ladder_scan(state, inputs, labels, meta, arrs):
             """Nested scans down the ladder: each level pulls its
             block's rows from the parent cache (one gather at the
@@ -968,11 +981,7 @@ class FFModel:
             cache, so the same adds hit the same values in the same
             order at every level (the single-level proof composes)."""
             if not meta:
-                def body(st, batch):
-                    binputs, blabels, bslots = batch
-                    return train_step(st, binputs, blabels,
-                                      slot_override=bslots)
-                return jax.lax.scan(body, state,
+                return jax.lax.scan(step_body, state,
                                     (inputs, labels, arrs["slots"]))
             (size, part), rest = meta[0], meta[1:]
             nb = labels.shape[0]
@@ -988,8 +997,8 @@ class FFModel:
                 for name in part:
                     parent = st.params[name]["embedding"]
                     rowof = a_k["rowof"][name]
-                    params2[name] = {"embedding": jnp.take(
-                        parent, rowof, axis=0, mode="clip")}
+                    params2[name] = {"embedding": _cache_fetch(parent,
+                                                               rowof)}
                     wb.append((name, rowof, parent))
                     if lazy_slots:
                         for sn in lazy_slots:
@@ -998,8 +1007,7 @@ class FFModel:
                                  opt2[sn][name]["embedding"]))
                         opt2 = _swap_slot_caches(
                             opt2, name,
-                            lambda fl, r=rowof: jnp.take(
-                                fl, r, axis=0, mode="clip"))
+                            lambda fl, r=rowof: _cache_fetch(fl, r))
                 st2 = TrainState(params2, opt2, st.bn_state,
                                  st.rng, st.step)
                 st2, mets_k = ladder_scan(st2, in_k, lab_k, rest,
@@ -1029,11 +1037,7 @@ class FFModel:
                 state, mets = ladder_scan(state, inputs, labels, meta,
                                           arrs)
             else:
-                def body(st, batch):
-                    binputs, blabels, bslots = batch
-                    return train_step(st, binputs, blabels,
-                                      slot_override=bslots)
-                state, mets = jax.lax.scan(body, state,
+                state, mets = jax.lax.scan(step_body, state,
                                            (inputs, labels, slots_ep))
             folded = {k: (jnp.mean(v) if k == "loss" else jnp.sum(v))
                       for k, v in mets.items()}
