@@ -86,6 +86,32 @@ class FFConfig:
     # than ~8 blocks' rows (PERF.md round 3).  "off" restores flat
     # host-side chunking with no in-graph levels.
     epoch_cache_levels: str = "auto"
+    # Top-level cache transport unit ("auto"|"on"|"off").  "on"/"auto"
+    # fetch and write back the epoch cache in 128-lane VIEW rows
+    # (pack = 128/d logical rows each) instead of logical rows: the
+    # big-table gather/scatter then runs in the layout every other
+    # table op prefers, killing XLA's transposed-table layout choice
+    # and its full-table copies + loop transposes around the
+    # prologue/epilogue (~180 ms per fused run at the bench shape,
+    # scripts/profile_headline.py).  Exact — untouched halves of a
+    # touched view row round-trip their original bytes.  "auto" = on
+    # for single-device TPU (where the packed per-step view is also
+    # active); "on" forces it on any backend (tests); "off" restores
+    # logical-row transport.
+    epoch_cache_view: str = "auto"
+    # Physical embedding-table storage ("auto"|"on"|"off").  "auto"/"on"
+    # store d<128 tables lane-PACKED as (R/pack, 128) arrays end-to-end
+    # (pack = 128/d): the logical (R, d) form's T(8,128) tiling pads
+    # half its lanes, so XLA lays big logical tables out transposed and
+    # pays full-table shuffles at every gather/scatter/reshape boundary
+    # (~180 ms per fused headline run, scripts/profile_headline.py).
+    # With packed storage no (R, d<128) array ever exists on device;
+    # the epoch row-cache and its ladder then transport whole view rows
+    # at every level.  Logical weights appear only at the host boundary
+    # (get_weights/set_weights reshape — bit-exact, row-major).  "auto"
+    # = single-device TPU; "on" forces it anywhere (tests); "off"
+    # restores logical storage.
+    packed_tables: str = "auto"
     # Manual table-parallel exchange for StackedEmbedding under a mesh
     # ("off"|"allgather"|"all_to_all"): route the table-sharded lookup
     # through an explicit shard_map + ICI collective

@@ -530,6 +530,30 @@ class FFModel:
         # layout war, PERF.md; under a mesh both run on the logical shape
         # and XLA SPMD owns layouts and collectives).
         sparse_ok = sparse_mode != "off"
+        # ---- packed table storage (FFConfig.packed_tables) ---------------
+        # d<128 tables live physically as (R/pack, 128) arrays: the
+        # logical form's T(8,128) tiling pads half its lanes, so XLA lays
+        # big logical tables out transposed and pays full-table shuffles
+        # at every boundary (measured ~180 ms per fused headline run,
+        # scripts/profile_headline.py).  Single-device only: under a mesh
+        # XLA SPMD owns layouts and the sharded dim is the logical row.
+        packed_mode = getattr(self.config, "packed_tables", "auto")
+        if packed_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"packed_tables must be 'auto'|'on'|'off', "
+                f"got {packed_mode!r}")
+        storage_on = mesh_ is None and (
+            packed_mode == "on"
+            or (packed_mode == "auto" and backend == "tpu"))
+        for op in self.layers:
+            if isinstance(op, (Embedding, StackedEmbedding,
+                               RaggedStackedEmbedding)):
+                eligible = (storage_on
+                            and getattr(op, "placement", "tpu") != "cpu"
+                            and not getattr(op, "use_pallas", False)
+                            and not getattr(op, "exchange_mode", None))
+                op.storage_pack = (op.storage_eligible_pack()
+                                   if eligible else 1)
         plain_sgd = (isinstance(self.optimizer, SGDOptimizer)
                      and self.optimizer.momentum == 0.0
                      and self.optimizer.weight_decay == 0.0)
@@ -573,8 +597,15 @@ class FFModel:
             preds = values[final_uid]
             return self._loss_fn(preds, labels), (preds, new_bn)
 
-        def _cache_gather(cache, slots):
-            from .ops.pallas_scatter import packed_gather, use_packed_view
+        def _cache_gather(op, cache, slots):
+            """Logical rows ``slots`` of an epoch/ladder cache, through
+            the op's storage form (packed caches for packed-storage ops;
+            the lane-packed view of logical caches on single-chip TPU;
+            plain take elsewhere)."""
+            from .ops.pallas_scatter import (packed_gather,
+                                            use_packed_view, view_gather)
+            if op.storage_pack > 1:
+                return view_gather(cache, slots, op.out_dim)
             if use_packed_view(self.mesh):
                 return packed_gather(cache, slots)
             return jnp.take(cache, slots, axis=0)
@@ -595,10 +626,15 @@ class FFModel:
             (ops/pallas_scatter.use_packed_view), and the cached and
             uncached lazy paths share one formulation bit-for-bit.
             Returns (new_table, {slot name: new slot table})."""
-            from .ops.pallas_scatter import sparse_row_update
+            from .ops.pallas_scatter import (sparse_row_update,
+                                             sparse_view_update)
             from .ops.slotting import slot_rows as _slot_positions
-            d = tb.shape[-1]
-            space = tb.reshape(-1, d)
+            d = op.out_dim
+            sp = op.storage_pack
+            # packed storage: tb already is the (rows/sp, d*sp) view —
+            # never reshape it to logical (that materializes on TPU)
+            space = tb if sp > 1 else tb.reshape(-1, d)
+            logical_rows = space.shape[0] * sp
             if slots is None:
                 sl = op.flat_ids(
                     inputs[id_name[op.name]].astype(jnp.int32)).reshape(-1)
@@ -614,7 +650,7 @@ class FFModel:
             # the ladder xs like the slot plans do (removing two in-scan
             # sorts per lazy step); left in-step until lazy mode is a
             # benched configuration.
-            _, occ = _slot_positions(sl, space.shape[0])
+            _, occ = _slot_positions(sl, logical_rows)
             occ = occ.reshape(-1)  # shared run id per occurrence
             seg = jnp.zeros((n, d), jnp.float32).at[occ].add(g_flat)
             g_row = jnp.take(seg, occ, axis=0)
@@ -624,9 +660,18 @@ class FFModel:
             pos = jnp.arange(n, dtype=jnp.int32)
             repmin = jnp.full((n,), n, jnp.int32).at[occ].min(pos)
             first = (pos == jnp.take(repmin, occ, axis=0))[:, None]
+            def _upd(arr, delta):
+                if sp > 1:
+                    return sparse_view_update(arr, sl, delta, 1.0, d=d,
+                                              allow_kernel=mesh_ is None)
+                return sparse_row_update(arr, sl, delta, 1.0,
+                                         allow_kernel=mesh_ is None)
+
             slot_rows_cur = {
-                sn: _cache_gather(
-                    _slot_space(state, sn, op.name).reshape(-1, d), sl)
+                sn: _cache_gather(op, _slot_space(state, sn, op.name)
+                                  if sp > 1 else
+                                  _slot_space(state, sn,
+                                              op.name).reshape(-1, d), sl)
                 for sn in lazy_slots}
             w_flat = w_rows.reshape(-1, d).astype(jnp.float32)
             new_w, new_slot_rows = self.optimizer.lazy_row_update(
@@ -634,18 +679,16 @@ class FFModel:
             # first-occurrence-masked delta: duplicates add exact 0.0,
             # so one add lands per touched row, via the packed view
             dw = jnp.where(first, new_w.astype(jnp.float32) - w_flat, 0.0)
-            new_tb = sparse_row_update(space, sl, dw, 1.0,
-                                       allow_kernel=mesh_ is None
-                                       ).reshape(tb.shape)
+            new_tb = _upd(space, dw).reshape(tb.shape)
             new_slot_tabs = {}
             for sn in lazy_slots:
                 ssp = _slot_space(state, sn, op.name)
                 dslot = jnp.where(first,
                                   new_slot_rows[sn] - slot_rows_cur[sn],
                                   0.0)
-                new_slot_tabs[sn] = sparse_row_update(
-                    ssp.reshape(-1, d), sl, dslot, 1.0,
-                    allow_kernel=mesh_ is None).reshape(ssp.shape)
+                new_slot_tabs[sn] = _upd(
+                    ssp if sp > 1 else ssp.reshape(-1, d),
+                    dslot).reshape(ssp.shape)
             return new_tb, new_slot_tabs
 
         def train_step(state: TrainState, inputs, labels, slot_override=None):
@@ -672,7 +715,7 @@ class FFModel:
                             tables[op.name], inputs[id_name[op.name]])
                     else:
                         rows_dict[op.name] = _cache_gather(
-                            tables[op.name], slots)
+                            op, tables[op.name], slots)
                 grad_fn = jax.value_and_grad(loss_rows, argnums=(0, 1),
                                              has_aux=True)
                 (loss, (preds, new_bn)), (dgrads, rgrads) = grad_fn(
@@ -707,6 +750,11 @@ class FFModel:
                         upd = op.scatter_apply(
                             tables[op.name], inputs[id_name[op.name]],
                             rgrads[op.name], -lr)
+                    elif op.storage_pack > 1:
+                        from .ops.pallas_scatter import sparse_view_update
+                        upd = sparse_view_update(
+                            tables[op.name], slots, rgrads[op.name], -lr,
+                            d=op.out_dim, allow_kernel=mesh_ is None)
                     else:
                         # allow_kernel doubles as the mesh-is-None bit:
                         # under a mesh the packed view / pallas kernel
@@ -767,50 +815,102 @@ class FFModel:
 
         # ---- epoch row-cache pieces (shared by the single-epoch and the
         # multi-epoch scanned programs) -----------------------------------
-        def _cache_fetch(parent, rowof):
+        def _cache_fetch(parent, rowof, pack=1):
             """THE cache fill all levels share: rows of the flattened
             parent at ``rowof``; sentinel holes clip to a garbage row
             that nothing addresses.  Accepts raw (T, R, d) tables and
             already-flat (R, d) caches alike (the reshape is a no-op
-            for the latter)."""
-            return jnp.take(parent.reshape(-1, parent.shape[-1]), rowof,
-                            axis=0, mode="clip")
+            for the latter).  ``pack > 1``: rowof addresses 128-lane
+            VIEW rows of the (R/pack, d*pack) view — the top-level form
+            that keeps the big-table gather in the same layout as every
+            other table op (the logical-(R, d<128) form made XLA pick a
+            transposed table layout and pay full-table layout copies +
+            loop transposes around the prologue/epilogue, ~180 ms per
+            fused run at the bench shape — measured via
+            scripts/profile_headline.py, round 3)."""
+            fl = parent.reshape(-1, parent.shape[-1])
+            if pack > 1:
+                view = fl.reshape(fl.shape[0] // pack,
+                                  fl.shape[1] * pack)
+                return jnp.take(view, rowof, axis=0,
+                                mode="clip").reshape(-1, fl.shape[1])
+            return jnp.take(fl, rowof, axis=0, mode="clip")
 
-        def build_cache(flat, ids, pack):
+        def build_cache(flat, ids, pack, view_ok, storage=1):
             """Shared-slot cache of the rows ``ids`` touches in the
-            (R, d) source ``flat``: (cache, slots, rowof) or None when
-            the cache would not be smaller than the source.  Slot
-            assignment is sort-position based (ops/slotting.py — no
+            (R, d) source ``flat``: (cache, slots, rowof, pack_used) or
+            None when the cache would not be smaller than the source.
+            Slot assignment is sort-position based (ops/slotting.py — no
             dense-rank inverse, whose scalar scatters dominated the
             prologue); ``rowof`` maps slot -> row with sentinel holes,
             which the fill (mode="clip") and the writeback
             (mode="drop") both tolerate.  Works on traced values; all
             shapes are static (the cache is sized by the occurrence
-            count, as before — the distinct count is data-dependent)."""
+            count, as before — the distinct count is data-dependent).
+
+            ``view_ok`` + pack > 1 selects the VIEW-ROW form: slots are
+            assigned per 128-lane view row (pack logical rows each), so
+            the table-side fetch and writeback move whole view rows —
+            the layout every other table op prefers.  Exact: a touched
+            view row's untouched halves are fetched with it, never
+            addressed by any slot (slots only point at run-first view
+            slots, offset by each id's half), and written back with
+            their original bytes.  Costs up to pack x the cache bytes
+            (view rows rarely coalesce under random ids) in exchange
+            for killing the transposed-layout pathology above."""
             size = int(np.prod(ids.shape))
             sentinel = flat.shape[0]  # OOB -> dropped at writeback
+            from .ops.slotting import slot_rows
+            if storage > 1:
+                # packed STORAGE: flat already is the (Rv, 128) view and
+                # rowof addresses its view rows directly — the epoch
+                # cache is packed too, so every later fetch/writeback is
+                # a plain whole-row take/set (wpack=1)
+                if size >= flat.shape[0]:
+                    return None
+                rowof_v, vslots = slot_rows(ids // storage, sentinel)
+                slots = vslots * storage + (ids % storage).astype(
+                    jnp.int32)
+                return _cache_fetch(flat, rowof_v), slots, rowof_v, 1
+            if (view_ok and pack > 1 and flat.shape[0] % pack == 0
+                    and size < flat.shape[0] // pack):
+                vrows = flat.shape[0] // pack
+                rowof_v, vslots = slot_rows(ids // pack, vrows)
+                slots = vslots * pack + (ids % pack).astype(jnp.int32)
+                return _cache_fetch(flat, rowof_v, pack), slots, \
+                    rowof_v, pack
             # pad to the lane-pack multiple so the packed view
             # applies to the cache too
             m = -(-size // pack) * pack
             if m >= flat.shape[0]:
                 return None
-            from .ops.slotting import slot_rows
             rowof, slots = slot_rows(ids, sentinel)
             if m > size:
                 rowof = jnp.concatenate(
                     [rowof, jnp.full((m - size,), sentinel, rowof.dtype)])
-            return _cache_fetch(flat, rowof), slots, rowof
+            return _cache_fetch(flat, rowof), slots, rowof, 1
 
         from .ops.pallas_scatter import lane_pack
         op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
                    for op in sparse_emb}
+        # storage form per op: packed-storage ops size and address their
+        # caches in VIEW-row units at every ladder level (see build_cache)
+        op_storage = {op.name: op.storage_pack for op in sparse_emb}
 
-        def _cache_writeback(parent, rowof, cache_final):
+        def _cache_writeback(parent, rowof, cache_final, pack=1):
             """THE cache writeback all levels share: live rows set once,
             sentinel holes dropped — param and optimizer-slot tables
             must stay bit-identical in this formulation for the
-            hierarchy's exactness claim."""
+            hierarchy's exactness claim.  ``pack > 1``: rowof addresses
+            view rows (see _cache_fetch)."""
             fl = parent.reshape(-1, parent.shape[-1])
+            if pack > 1:
+                view = fl.reshape(fl.shape[0] // pack,
+                                  fl.shape[1] * pack)
+                out = view.at[rowof].set(
+                    cache_final.reshape(-1, fl.shape[1] * pack),
+                    mode="drop")
+                return out.reshape(parent.shape)
             return fl.at[rowof].set(cache_final,
                                     mode="drop").reshape(parent.shape)
 
@@ -840,6 +940,20 @@ class FFModel:
             lazy mode, the optimizer slot tables — same rowof, same
             slots).  Returns (state-with-caches, slots, writebacks,
             originals)."""
+            from .ops.pallas_scatter import use_packed_view
+            view_mode = getattr(self.config, "epoch_cache_view", "auto")
+            if view_mode not in ("auto", "on", "off"):
+                raise ValueError(
+                    f"epoch_cache_view must be 'auto'|'on'|'off', "
+                    f"got {view_mode!r}")
+            # "on" still requires no mesh (under SPMD the view fights
+            # the sharded layout, like every packed-view path)
+            if view_mode == "on":
+                view_ok = mesh_ is None
+            elif view_mode == "auto":
+                view_ok = use_packed_view(mesh_)
+            else:
+                view_ok = False
             params = dict(state.params)
             opt_state = state.opt_state
             slots_ep, writebacks, originals = {}, [], {}
@@ -848,23 +962,25 @@ class FFModel:
                 tb = params[op.name]["embedding"]
                 flat = tb.reshape(-1, tb.shape[-1])
                 built = build_cache(flat, op.flat_ids(ids),
-                                    op_pack[op.name])
+                                    op_pack[op.name], view_ok,
+                                    storage=op.storage_pack)
                 if built is None:
                     # cache would be as big as the table — no win; keep
                     # this op on the direct per-step path
                     continue
-                cache, slots, rowof = built
+                cache, slots, rowof, wpack = built
                 originals[op.name] = tb
                 params[op.name] = {"embedding": cache}
                 slots_ep[op.name] = slots
-                writebacks.append((op.name, tb.shape, rowof))
+                writebacks.append((op.name, tb.shape, rowof, wpack))
                 if lazy_slots:
                     for sn in lazy_slots:
                         originals[(sn, op.name)] = (
                             opt_state[sn][op.name]["embedding"])
                     opt_state = _swap_slot_caches(
                         opt_state, op.name,
-                        lambda fl, r=rowof: _cache_fetch(fl, r))
+                        lambda fl, r=rowof, p=wpack: _cache_fetch(
+                            fl, r, p))
             state = TrainState(params, opt_state, state.bn_state,
                                state.rng, state.step)
             return state, slots_ep, writebacks, originals
@@ -911,7 +1027,10 @@ class FFModel:
             each level every op whose padded block cache would be
             smaller than its current parent cache participates; a level
             nobody joins is dropped.  Pure shape math — the traced twin
-            is ladder_arrays."""
+            is ladder_arrays.  Row units follow the op's storage form:
+            STORAGE rows (view rows, one per id occurrence) for
+            packed-storage ops, logical rows otherwise — matching the
+            actual cache arrays' shape[0] at every level."""
             meta, rows, cur = [], dict(rows0), nb
             for size in ladder_sizes(nb):
                 if not (0 < size < cur and cur % size == 0):
@@ -919,8 +1038,11 @@ class FFModel:
                 part = {}
                 for name, sl in slots_ep.items():
                     per_step = int(np.prod(sl.shape[1:]))
-                    pack = op_pack[name]
-                    m = -(-(size * per_step) // pack) * pack
+                    if op_storage[name] > 1:
+                        m = size * per_step  # view slots: 1/occurrence
+                    else:
+                        pack = op_pack[name]
+                        m = -(-(size * per_step) // pack) * pack
                     if m < rows[name]:
                         part[name] = m
                 if part:
@@ -949,7 +1071,15 @@ class FFModel:
                 rowof_d, slots_d = {}, {}
                 for name, b in blk.items():
                     if name in part:
-                        rowof, s = slot_rows(b, rows[name])
+                        sp = op_storage[name]
+                        if sp > 1:
+                            # view-unit slotting: parent rows are view
+                            # rows; each occurrence gets a view slot,
+                            # its logical slot offset by the id's half
+                            rowof, s = slot_rows(b // sp, rows[name])
+                            s = s * sp + (b % sp).astype(jnp.int32)
+                        else:
+                            rowof, s = slot_rows(b, rows[name])
                         m, n = part[name], int(np.prod(b.shape))
                         if m > n:
                             rowof = jnp.concatenate(
@@ -1063,16 +1193,17 @@ class FFModel:
                 return state
             new_params = dict(state.params)
             opt_state = state.opt_state
-            for name, tb_shape, rowof in writebacks:
+            for name, tb_shape, rowof, wpack in writebacks:
                 new_params[name] = {"embedding": _cache_writeback(
                     originals[name], rowof,
-                    state.params[name]["embedding"])}
+                    state.params[name]["embedding"], wpack)}
                 for sn in lazy_slots:
                     opt_state = _swap_opt_entry(
                         opt_state, sn, name,
                         _cache_writeback(
                             originals[(sn, name)], rowof,
-                            state.opt_state[sn][name]["embedding"]))
+                            state.opt_state[sn][name]["embedding"],
+                            wpack))
             return TrainState(new_params, opt_state,
                               state.bn_state, state.rng, state.step)
 
@@ -1572,9 +1703,19 @@ class FFModel:
 
     # ---------------------------------------------- weights IO (checkpointing)
     def get_weights(self, state: TrainState, op_name: str, param_name: str):
-        """reference Parameter::get_weights (model.h:219-231)."""
+        """reference Parameter::get_weights (model.h:219-231).  Always
+        returns the LOGICAL shape: packed-storage tables (storage_shape,
+        tensor.py) unpack via a host-side row-major reshape."""
         import numpy as np
-        return np.asarray(state.params[op_name][param_name])
+        arr = np.asarray(state.params[op_name][param_name])
+        for op in self.layers:
+            if op.name == op_name:
+                for spec in op.param_specs():
+                    if (spec.param_name == param_name
+                            and spec.storage_shape is not None
+                            and tuple(arr.shape) == spec.storage_shape):
+                        return arr.reshape(spec.shape)
+        return arr
 
     def set_weights(self, state: TrainState, op_name: str, param_name: str,
                     value) -> TrainState:

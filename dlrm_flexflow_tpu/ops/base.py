@@ -91,7 +91,14 @@ class Op:
         keys = jax.random.split(key, max(1, len(specs)))
         for k, spec in zip(keys, specs):
             init = spec.initializer
-            out[spec.param_name] = init(k, spec.shape, spec.dtype)
+            arr = init(k, spec.shape, spec.dtype)
+            if spec.storage_shape is not None:
+                # physical storage form (e.g. lane-packed embedding
+                # tables): drawn at the logical shape so packed and
+                # logical storage initialize bit-identically, then
+                # reshaped row-major (value-preserving)
+                arr = arr.reshape(spec.storage_shape)
+            out[spec.param_name] = arr
         return out
 
     # ---- execution ----------------------------------------------------------

@@ -333,6 +333,80 @@ def packed_gather(table, ids):
         vrows, h[..., None, None].astype(jnp.int32), axis=-2).squeeze(-2)
 
 
+def view_gather(view, ids, d: int):
+    """Logical (..., d) rows from a PACKED (Rv, pack*d) storage array.
+
+    The packed-STORAGE twin of ``packed_gather``: the table physically
+    lives as 128-lane view rows (pack = view cols / d logical rows per
+    view row), so no (R, d<128) array — whose T(8,128) tiling pads half
+    the lanes and whose reshapes/layout conversions therefore cost
+    full-table shuffles (PERF.md round 3) — ever exists on device."""
+    pack = view.shape[-1] // d
+    if pack <= 1:
+        return jnp.take(view, ids, axis=0)
+    q = ids // pack
+    h = ids % pack
+    vrows = jnp.take(view, q, axis=0)          # ids.shape + (pack*d,)
+    vrows = vrows.reshape(ids.shape + (pack, d))
+    return jnp.take_along_axis(
+        vrows, h[..., None, None].astype(jnp.int32), axis=-2).squeeze(-2)
+
+
+def _expand_lanes(ids_flat, upd_flat, pack, dtype):
+    """THE one-hot lane expansion every packed write path shares:
+    (q, packed) where q = view row per update and ``packed`` is the
+    128-lane row with the (d,) update in its slot and exact 0.0
+    elsewhere.  packed-XLA and kernel paths must stay numerically
+    identical, so they all call this."""
+    n, d = upd_flat.shape
+    q = ids_flat // pack
+    h = ids_flat % pack
+    lanes = jax.nn.one_hot(h, pack, dtype=dtype)           # (n, pack)
+    packed = (lanes[:, :, None] * upd_flat[:, None, :]).reshape(
+        n, d * pack)
+    return q, packed
+
+
+def view_scatter_add(view, ids, upd, d: int):
+    """``view[logical ids] += upd`` on a PACKED (Rv, pack*d) storage
+    array: each (d,) update lands in its slot of the 128-lane view row
+    via a one-hot expansion (other slots add exact 0.0); duplicates
+    accumulate.  The packed-storage twin of ``packed_scatter_add``."""
+    pack = view.shape[-1] // d
+    ids_flat = ids.reshape(-1).astype(jnp.int32)
+    upd_flat = upd.reshape(-1, d).astype(view.dtype)
+    if pack <= 1:
+        return view.at[ids_flat].add(upd_flat)
+    q, packed = _expand_lanes(ids_flat, upd_flat, pack, view.dtype)
+    return view.at[q].add(packed)
+
+
+def sparse_view_update(view, ids, updates, scale, *, d: int,
+                       interpret=False, force=False, allow_kernel=True,
+                       pipeline=None):
+    """``sparse_row_update`` for PACKED (Rv, pack*d) storage: logical
+    ids, (..., d) updates, duplicate accumulation; the in-place pallas
+    kernel applies directly to the 128-lane view rows when selected."""
+    pack = view.shape[-1] // d
+    if pack <= 1:
+        return sparse_row_update(view, ids, updates, scale,
+                                 interpret=interpret, force=force,
+                                 allow_kernel=allow_kernel,
+                                 pipeline=pipeline)
+    ids_flat = ids.reshape(-1).astype(jnp.int32)
+    upd_flat = (scale * updates.reshape(-1, d)).astype(view.dtype)
+    n = ids_flat.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
+    use_kernel = force or interpret or (
+        allow_kernel and _IMPL == "kernel" and on_tpu)
+    if use_kernel and n % _BLOCK == 0:
+        q, packed = _expand_lanes(ids_flat, upd_flat, pack, view.dtype)
+        order = jnp.argsort(q)
+        return _row_update_pallas(view, q[order], packed[order],
+                                  interpret=interpret, pipeline=pipeline)
+    return view_scatter_add(view, ids_flat, upd_flat, d)
+
+
 def use_packed_view(mesh) -> bool:
     """THE predicate for the lane-packed table view: gather_rows and the
     scatter update must answer identically or XLA picks conflicting
@@ -344,17 +418,10 @@ def use_packed_view(mesh) -> bool:
 
 
 def _lane_pack(table, ids_flat, upd_flat, pack):
-    """Shared lane-pack expansion: (view, view_ids, packed_updates) where
-    each (d,) update occupies its slot of the 128-lane view row (other
-    slots exact 0.0).  Used by both the packed-XLA and the kernel path —
-    they must stay numerically identical."""
+    """Lane-pack expansion against a LOGICAL (R, d) table: the
+    (R/pack, 128) view plus ``_expand_lanes``' (q, packed)."""
     r, d = table.shape
-    n = ids_flat.shape[0]
-    q = ids_flat // pack
-    h = ids_flat % pack
-    lanes = jax.nn.one_hot(h, pack, dtype=table.dtype)      # (n, pack)
-    packed = (lanes[:, :, None] * upd_flat[:, None, :]).reshape(
-        n, d * pack)
+    q, packed = _expand_lanes(ids_flat, upd_flat, pack, table.dtype)
     return table.reshape(r // pack, d * pack), q, packed
 
 

@@ -99,7 +99,17 @@ class ParameterSpec:
     dtype: object = jnp.float32
     initializer: Optional[object] = None
     sharded_dim: Optional[int] = None
+    # physical on-device shape when it differs from the logical ``shape``
+    # (e.g. a (R, d<128) embedding table stored lane-packed as
+    # (R/pack, 128): the logical form's T(8,128) tiling pads half the
+    # lanes, so big logical-shaped tables pay full-table shuffles at
+    # every layout boundary — PERF.md round 3).  Initialization draws at
+    # the LOGICAL shape and reshapes (row-major, value-preserving), so
+    # packed and logical storage initialize bit-identically.
+    storage_shape: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         self.shape = tuple(int(d) for d in self.shape)
+        if self.storage_shape is not None:
+            self.storage_shape = tuple(int(d) for d in self.storage_shape)
         self.dtype = as_dtype(self.dtype)

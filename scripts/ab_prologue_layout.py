@@ -1,0 +1,142 @@
+"""A/B: logical-row vs view-row prologue/epilogue on a big (R, 64) table.
+
+The round-3 trace (scripts/profile_headline.py) showed the fused run's
+fixed cost is dominated by XLA's layout choice around the TOP-level
+cache fetch (jnp.take) and writeback (.at[rowof].set) on the 8M x 64
+table: a transposed {0,1} table layout, two full-table layout copies,
+two multi-iteration transpose loops, and a 4.6 GB/s scatter — ~180 ms
+per fused run.  This experiment isolates that fixed cost: a jitted
+program that fetches an occurrence-sized cache, runs a trivial scan that
+touches the cache (so both ops stay live), and writes the final rows
+back — formulated (A) per logical row, as model.py does today, and
+(B) per 128-lane view row (pack=2 halves share a view row).
+
+Usage: python scripts/ab_prologue_layout.py [n_ids] [rows_total]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrm_flexflow_tpu.ops.slotting import slot_rows
+from dlrm_flexflow_tpu.profiling import device_fence
+
+
+def run(fn, table, ids, label, reps=5):
+    out = fn(table, ids)  # compile
+    device_fence(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(table, ids)
+        device_fence(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:28s} {best*1e3:9.2f} ms   checksum={float(out.sum()):.3f}")
+    return best
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+    rows = int(sys.argv[2]) if len(sys.argv) > 2 else 8_000_000
+    d, pack = 64, 2
+    nsteps = 16
+    # the scan body touches only a SMALL slice of the cache per step (the
+    # real model's ladder confines per-step traffic to a tiny L0 cache) —
+    # it keeps the fetch and writeback live and ordered without adding
+    # big-cache scatter sweeps of its own
+    touch = 2048
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, rows, size=(n,), dtype=np.int32))
+    table = jnp.asarray(rng.standard_normal((rows, d)).astype(np.float32))
+
+    @jax.jit
+    def logical(table, ids):
+        rowof, slots = slot_rows(ids, rows)
+        cache = jnp.take(table, rowof, axis=0, mode="clip")
+
+        def body(c, sl):
+            upd = jnp.take(c, sl, axis=0) * 1e-3
+            return c.at[sl].add(upd), ()
+
+        cache, _ = jax.lax.scan(
+            body, cache, slots.reshape(-1)[:nsteps * touch].reshape(
+                nsteps, touch))
+        return table.at[rowof].set(cache, mode="drop")
+
+    @jax.jit
+    def view(table, ids):
+        vids = ids // pack
+        half = ids % pack
+        vrows = rows // pack
+        rowof_v, vslots = slot_rows(vids, vrows)
+        lslots = vslots * pack + half
+        tview = table.reshape(vrows, d * pack)
+        cview = jnp.take(tview, rowof_v, axis=0, mode="clip")
+        cache = cview.reshape(-1, d)
+
+        def body(c, sl):
+            upd = jnp.take(c, sl, axis=0) * 1e-3
+            return c.at[sl].add(upd), ()
+
+        cache, _ = jax.lax.scan(
+            body, cache, lslots.reshape(-1)[:nsteps * touch].reshape(
+                nsteps, touch))
+        out = tview.at[rowof_v].set(cache.reshape(-1, d * pack),
+                                    mode="drop")
+        return out.reshape(rows, d)
+
+    # C: PACKED STORAGE — the table lives as (R/pack, 128) physically, so
+    # no (R, 64) array ever crosses the program: no half-padded {1,0}
+    # tiles, no transposed entry layout, no reshape materialization.
+    vrows = rows // pack
+
+    @jax.jit
+    def packed_storage(ptable, ids):
+        vids = ids // pack
+        half = ids % pack
+        rowof_v, vslots = slot_rows(vids, vrows)
+        lslots = vslots * pack + half
+        cache = jnp.take(ptable, rowof_v, axis=0, mode="clip")  # (m,128)
+
+        def body(c, sl):
+            q, h = sl // pack, sl % pack
+            vr = jnp.take(c, q, axis=0).reshape(-1, pack, d)
+            upd = jnp.take_along_axis(
+                vr, h[:, None, None].astype(jnp.int32), axis=1
+            ).squeeze(1) * 1e-3
+            lanes = jax.nn.one_hot(h, pack, dtype=c.dtype)
+            packed = (lanes[:, :, None] * upd[:, None, :]).reshape(
+                -1, d * pack)
+            return c.at[q].add(packed), ()
+
+        cache, _ = jax.lax.scan(
+            body, cache, lslots.reshape(-1)[:nsteps * touch].reshape(
+                nsteps, touch))
+        return ptable.at[rowof_v].set(cache, mode="drop")
+
+    print(f"# n={n} ids into ({rows},{d}) table, {nsteps}-step scan, "
+          f"backend={jax.default_backend()}")
+    ta = run(logical, table, ids, "A logical-row (today)")
+    tb = run(view, table, ids, "B view-row (128-lane)")
+    ptable = jnp.asarray(
+        np.asarray(table).reshape(vrows, d * pack))
+    tc = run(packed_storage, ptable, ids, "C packed storage")
+    print(f"# speedup B vs A: {ta/tb:.2f}x   C vs A: {ta/tc:.2f}x")
+
+    # exactness: same final table
+    a = logical(table, ids)
+    b = view(table, ids)
+    c = packed_storage(ptable, ids).reshape(rows, d)
+    print(f"# bit-equal B: {bool(jnp.array_equal(a, b))}  "
+          f"C: {bool(jnp.array_equal(a, c))}")
+
+
+if __name__ == "__main__":
+    main()

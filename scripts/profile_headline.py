@@ -1,0 +1,130 @@
+"""Attribute the fused headline window's device time by HLO op.
+
+Captures a jax.profiler trace around ONE fused multi-epoch window of the
+bench headline config (bench.py:175-230) and aggregates the TPU track's
+slice durations by op name, so the per-step embedding tax (PERF.md
+round-3 roofline: ~1.0 of the 1.14 ms step) is measured, not inferred.
+
+Usage: python scripts/profile_headline.py [nb] [epochs]
+Env: PROF_ROWS (default 1e6), PROF_BATCH (256), PROF_LEVELS (ladder
+override, e.g. "256,32,8"), PROF_TOP (default 30 lines).
+"""
+
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build():
+    import numpy as np
+    import jax
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+    batch = int(os.environ.get("PROF_BATCH", 256))
+    nb = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    rows = int(float(os.environ.get("PROF_ROWS", 1_000_000)))
+
+    cfg = DLRMConfig()
+    cfg.embedding_size = [rows] * 8
+    kw = {}
+    if os.environ.get("PROF_LEVELS"):
+        kw["epoch_cache_levels"] = os.environ["PROF_LEVELS"]
+    ffconfig = ff.FFConfig(batch_size=batch, compute_dtype="bfloat16",
+                           embedding_dtype=os.environ.get(
+                               "PROF_EMB_DTYPE", "float32"), **kw)
+    model = build_dlrm(cfg, ffconfig)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error",
+                  metrics=("accuracy", "mean_squared_error"),
+                  mesh=False if jax.device_count() == 1 else None)
+    state = model.init(seed=0)
+    rng = np.random.default_rng(0)
+    inputs = {
+        "dense": rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32),
+        "sparse": rng.integers(
+            0, rows, size=(nb, batch, 8, cfg.embedding_bag_size),
+            dtype=np.int64),
+    }
+    labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+    inputs, labels = model.place_dataset(inputs, labels)
+    return model, state, inputs, labels, nb, epochs, batch
+
+
+def parse_trace(logdir, min_frac=0.001):
+    """Sum slice durations by name across the device (non-CPU) tracks of
+    the newest trace.json.gz under ``logdir``."""
+    paths = []
+    for root, _dirs, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".trace.json.gz"):
+                paths.append(os.path.join(root, f))
+    if not paths:
+        raise SystemExit(f"no trace.json.gz under {logdir}")
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # pid -> process name, to keep only device tracks
+    pnames = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pnames[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pnames.items()
+                if "TPU" in n or "/device" in n.lower()}
+    if not dev_pids:  # fall back: anything that is not explicitly host
+        dev_pids = {p for p, n in pnames.items()
+                    if "host" not in n.lower() and "python" not in n.lower()}
+    tot = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            tot[e["name"]] = tot.get(e["name"], 0.0) + e.get("dur", 0.0)
+    return path, pnames, tot
+
+
+def main():
+    from dlrm_flexflow_tpu.profiling import device_fence
+
+    model, state, inputs, labels, nb, epochs, batch = build()
+
+    def window(st):
+        st, _ = model.train_epochs(st, inputs, labels, epochs)
+        return st
+
+    state = window(state)  # compile
+    device_fence(state.step)
+    t0 = time.perf_counter()
+    state = window(state)
+    device_fence(state.step)
+    dt_plain = time.perf_counter() - t0
+    steps = nb * epochs
+    print(f"# fused window (untraced): {dt_plain*1e3:.1f} ms, "
+          f"{steps} steps -> {dt_plain/steps*1e6:.1f} us/step, "
+          f"{steps*batch/dt_plain:,.0f} samples/s")
+
+    logdir = os.environ.get("PROF_LOGDIR", "/tmp/ff_trace")
+    import jax
+    jax.profiler.start_trace(logdir)
+    state = window(state)
+    device_fence(state.step)
+    jax.profiler.stop_trace()
+
+    path, pnames, tot = parse_trace(logdir)
+    print(f"# trace: {path}")
+    print(f"# tracks: {sorted(set(pnames.values()))}")
+    total = sum(tot.values())
+    print(f"# device total: {total/1e3:.1f} ms over {len(tot)} op names")
+    top = int(os.environ.get("PROF_TOP", 30))
+    for name, dur in sorted(tot.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{dur/1e3:10.2f} ms  {dur/total*100:5.1f}%  "
+              f"{dur/steps:8.1f} us/step  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,71 @@ def test_lazy_cached_equals_uncached(opt_kind, ladder):
                 np.asarray(b.opt_state[sn]["emb"]["embedding"]))
 
 
+@pytest.mark.parametrize("opt_kind", ["adam", "momentum"])
+def test_lazy_packed_storage_equals_logical(opt_kind):
+    """packed_tables="on" with lazy optimizers.  Two claims: (1) the
+    packed CACHED ladder path is bit-identical to the packed UNCACHED
+    path — the hierarchy-exactness invariant; (2) packed equals logical
+    storage to float precision (not bitwise: the different table layout
+    lets XLA reassociate the bag-sum reduction, a 1-ULP effect)."""
+    def make():
+        if opt_kind == "adam":
+            return ff.AdamOptimizer(lr=0.05, lazy_embeddings=True)
+        return ff.SGDOptimizer(lr=0.05, momentum=0.9,
+                               lazy_embeddings=True)
+    nb, batch = 32, 8
+    # tables big enough that the epoch cache ENGAGES under packed
+    # storage (epoch occurrences 1024 < 2048 view rows); ids drawn from
+    # a narrow range for heavy duplicates
+    cfg = DLRMConfig(sparse_feature_size=8,
+                     embedding_size=[16384, 16384],
+                     embedding_bag_size=2, mlp_bot=[4, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    states = {}
+    for packed, cache in (("on", "on"), ("on", "off"), ("off", "off")):
+        fc = ff.FFConfig(batch_size=batch, epoch_row_cache=cache,
+                         packed_tables=packed, epoch_cache_levels="16,8")
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=make(), loss_type="mean_squared_error",
+                  metrics=("accuracy",), mesh=False)
+        assert m._sparse_emb_ops == ["emb"]
+        rng = np.random.default_rng(0)
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, 4)).astype(np.float32),
+            "sparse": rng.integers(0, 64, size=(nb, batch, 2, 2),
+                                   dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        st = m.init(seed=0)
+        for _ in range(2):
+            st, _ = m.train_epoch(st, inputs, labels)
+        states[(packed, cache)] = (st, m)
+    a, ma = states[("on", "on")]
+    emb = [op for op in ma.layers if op.op_type == "StackedEmbedding"][0]
+    assert emb.storage_pack == 16
+    assert ma._epoch_cache_active
+    # (1) packed cached == packed uncached, bitwise (params + slots)
+    b, mb = states[("on", "off")]
+    for opn in a.params:
+        for k in a.params[opn]:
+            np.testing.assert_array_equal(
+                np.asarray(a.params[opn][k]), np.asarray(b.params[opn][k]),
+                err_msg=f"cached-vs-uncached {opn}/{k}")
+    for sn in ("m", "v", "velocity"):
+        if sn in a.opt_state and isinstance(a.opt_state[sn], dict) \
+                and "emb" in a.opt_state[sn]:
+            np.testing.assert_array_equal(
+                np.asarray(a.opt_state[sn]["emb"]["embedding"]),
+                np.asarray(b.opt_state[sn]["emb"]["embedding"]))
+    # (2) packed == logical to float precision
+    c, mc = states[("off", "off")]
+    for opn in a.params:
+        for k in a.params[opn]:
+            np.testing.assert_allclose(
+                ma.get_weights(a, opn, k), mc.get_weights(c, opn, k),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"packed-vs-logical {opn}/{k}")
+
+
 @pytest.mark.parametrize("cache", ["on", "off"])
 def test_lazy_adam_stacked_3d_tables(cache):
     # uniform table sizes -> StackedEmbedding with a (T, R, d) weight

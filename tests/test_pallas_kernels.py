@@ -169,6 +169,52 @@ class TestPackedViewOnCPU:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.parametrize("rows,dim", [(64, 16), (128, 32), (48, 8),
+                                          (64, 128)])
+    def test_view_storage_ops_equal_logical(self, rows, dim):
+        """view_gather / view_scatter_add / sparse_view_update on the
+        PACKED (Rv, pack*d) storage array must equal take / at[].add /
+        sparse_row_update on the logical (R, d) table (the storage array
+        is the logical table's row-major reshape, so results compare via
+        the same reshape)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import (
+            lane_pack, sparse_row_update, sparse_view_update, view_gather,
+            view_scatter_add)
+
+        pack = lane_pack(dim)
+        rng = np.random.default_rng(3)
+        logical = rng.standard_normal((rows, dim)).astype(np.float32)
+        view = jnp.asarray(logical.reshape(rows // pack, dim * pack))
+        table = jnp.asarray(logical)
+        ids = np.array([0, 0, 1, max(pack - 1, 0), pack % rows, rows - 1,
+                        rows - 1, rows - pack], dtype=np.int32)
+        jids = jnp.asarray(ids)
+
+        got = view_gather(view, jids, dim)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(jnp.take(table, jids,
+                                                          axis=0)))
+        # 2-D ids
+        np.testing.assert_array_equal(
+            np.asarray(view_gather(view, jids.reshape(2, 4), dim)),
+            np.asarray(jnp.take(table, jids.reshape(2, 4), axis=0)))
+
+        upd = jnp.asarray(rng.standard_normal(
+            (len(ids), dim)).astype(np.float32))
+        got = view_scatter_add(view, jids, upd, dim)
+        want = table.at[jids].add(upd)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(rows, dim), np.asarray(want),
+            rtol=1e-6, atol=1e-6)
+
+        got = sparse_view_update(view, jids, upd, -0.5, d=dim)
+        want = sparse_row_update(table, jids, upd, -0.5)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(rows, dim), np.asarray(want),
+            rtol=1e-6, atol=1e-6)
+
     def test_gather_scatter_layout_agreement(self):
         """The invariant the fast path rests on: a gather through the
         packed view followed by a packed scatter of the SAME rows at

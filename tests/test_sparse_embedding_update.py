@@ -265,7 +265,7 @@ class TestEpochRowCache:
     slot, one scatter-set back — must equal the stepwise path exactly."""
 
     def _run(self, stacked, emb_dtype, cache_mode, nb=6, batch=16,
-             tables=4, bag=2, big=True):
+             tables=4, bag=2, big=True, view="auto"):
         from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
         # big tables: the cache engages (epoch ids < rows); small tables:
         # the clamp skips caching (cache would be >= the table)
@@ -284,7 +284,8 @@ class TestEpochRowCache:
                          mlp_bot=[4, 16, 8],
                          mlp_top=[8 * tables + 8, 16, 1])
         fc = ff.FFConfig(batch_size=batch, embedding_dtype=emb_dtype,
-                         epoch_row_cache=cache_mode)
+                         epoch_row_cache=cache_mode,
+                         epoch_cache_view=view)
         m = build_dlrm(cfg, fc, stacked_embeddings=stacked)
         m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
                   loss_type="mean_squared_error", metrics=("accuracy",),
@@ -319,6 +320,105 @@ class TestEpochRowCache:
         for k in mets_c:
             np.testing.assert_allclose(np.asarray(mets_c[k]),
                                        np.asarray(mets_u[k]), rtol=1e-6)
+
+    @pytest.mark.parametrize("stacked", [True, False])
+    @pytest.mark.parametrize("emb_dtype", ["float32", "bfloat16"])
+    def test_view_row_transport_bit_exact(self, stacked, emb_dtype):
+        """epoch_cache_view="on" (128-lane view-row fetch/writeback at
+        the top level) must equal the uncached path BIT-exactly: the
+        view row's untouched halves are fetched with it, addressed by
+        no slot, and written back with their original bytes.  The
+        unstacked shape mixes pack-divisible tables (view engages) with
+        non-divisible ones (logical fallback) in one model."""
+        st_v, mets_v = self._run(stacked, emb_dtype, "on", view="on")
+        st_u, mets_u = self._run(stacked, emb_dtype, "off", view="off")
+        for opn in st_v.params:
+            for k in st_v.params[opn]:
+                np.testing.assert_array_equal(
+                    np.asarray(st_v.params[opn][k]),
+                    np.asarray(st_u.params[opn][k]),
+                    err_msg=f"{opn}/{k} (stacked={stacked}, {emb_dtype})")
+        for k in mets_v:
+            np.testing.assert_allclose(np.asarray(mets_v[k]),
+                                       np.asarray(mets_u[k]), rtol=1e-6)
+
+    @pytest.mark.parametrize("stacked", [True, False])
+    @pytest.mark.parametrize("levels", ["auto", "3", "off"])
+    def test_packed_storage_bit_exact(self, stacked, levels):
+        """packed_tables="on" (tables live as (R/pack, 128) arrays,
+        caches in view-row units at every ladder level) must equal the
+        logical-storage uncached path bit-exactly, and get_weights must
+        return the logical shape."""
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        tables, bag, batch, nb = 3, 2, 16, 6
+        rows = [4096, 2048, 1024][:tables] if not stacked else [4096] * 3
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=list(rows),
+                         embedding_bag_size=bag,
+                         mlp_bot=[4, 16, 8],
+                         mlp_top=[8 * tables + 8, 16, 1])
+        rng = np.random.default_rng(7)
+        inputs = {"dense": rng.standard_normal(
+            (nb, batch, cfg.mlp_bot[0])).astype(np.float32)}
+        if stacked:
+            inputs["sparse"] = rng.integers(
+                0, rows[0], size=(nb, batch, tables, bag), dtype=np.int64)
+        else:
+            for i, r in enumerate(rows):
+                inputs[f"sparse_{i}"] = rng.integers(
+                    0, r, size=(nb, batch, bag), dtype=np.int64)
+        labels = rng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
+        runs = {}
+        for packed, cache in (("on", "on"), ("off", "off")):
+            fc = ff.FFConfig(batch_size=batch, epoch_row_cache=cache,
+                             packed_tables=packed,
+                             epoch_cache_levels=levels,
+                             epoch_cache_chunk=3, epoch_cache_inner=3)
+            m = build_dlrm(cfg, fc, stacked_embeddings=stacked)
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                      loss_type="mean_squared_error",
+                      metrics=("accuracy",), mesh=False)
+            st = m.init(seed=0)
+            if packed == "on" and stacked:
+                emb = [op for op in m.layers
+                       if op.op_type == "StackedEmbedding"][0]
+                assert emb.storage_pack == 16  # d=8
+                assert st.params[emb.name]["embedding"].shape[-1] == 128
+            st, mets = m.train_epoch(st, inputs, labels)
+            runs[packed] = (st, mets, m)
+        st_p, mets_p, m_p = runs["on"]
+        st_u, mets_u, m_u = runs["off"]
+        for opn in st_p.params:
+            for k in st_p.params[opn]:
+                np.testing.assert_array_equal(
+                    m_p.get_weights(st_p, opn, k),
+                    m_u.get_weights(st_u, opn, k),
+                    err_msg=f"{opn}/{k} stacked={stacked} {levels}")
+        for k in mets_p:
+            np.testing.assert_allclose(np.asarray(mets_p[k]),
+                                       np.asarray(mets_u[k]), rtol=1e-6)
+
+    def test_packed_storage_set_get_roundtrip(self):
+        """set_weights accepts logical values for packed tables and
+        get_weights returns them unchanged."""
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[512] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                         mlp_top=[8 * 2 + 8, 8, 1])
+        fc = ff.FFConfig(batch_size=8, packed_tables="on")
+        m = build_dlrm(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        st = m.init(seed=0)
+        emb = [op for op in m.layers
+               if op.op_type == "StackedEmbedding"][0]
+        assert emb.storage_pack > 1
+        w = np.random.default_rng(3).standard_normal(
+            (2, 512, 8)).astype(np.float32)
+        st = m.set_weights(st, emb.name, "embedding", w)
+        got = m.get_weights(st, emb.name, "embedding")
+        assert got.shape == (2, 512, 8)
+        np.testing.assert_array_equal(got, w)
 
     def test_heavy_duplicate_ids_across_steps(self):
         # many cross-step collisions: ids drawn from just 8 rows
@@ -743,10 +843,15 @@ class TestRandomizedEquivalence:
         labels = prng.integers(0, 2, size=(nb, batch, 1)).astype(np.float32)
 
         results = {}
-        for mode, cache in (("on", "on"), ("on", "off"), ("off", "off")):
+        for mode, cache, view, packed in (
+                ("on", "on", "off", "off"), ("on", "on", "on", "off"),
+                ("on", "on", "off", "on"), ("on", "off", "off", "on"),
+                ("on", "off", "off", "off"), ("off", "off", "off", "off")):
             fc = ff.FFConfig(batch_size=batch,
                              sparse_embedding_updates=mode,
                              epoch_row_cache=cache,
+                             epoch_cache_view=view,
+                             packed_tables=packed,
                              epoch_cache_inner=inner,
                              epoch_cache_chunk=chunk)
             m = build_dlrm(cfg, fc)
@@ -755,15 +860,16 @@ class TestRandomizedEquivalence:
                       mesh=False)
             st = m.init(seed=0)
             st, mets = m.train_epoch(st, inputs, labels)
-            results[(mode, cache)] = (st, float(mets["loss"]))
+            results[(mode, cache, view, packed)] = (
+                st, float(mets["loss"]), m)
 
-        ref_st, ref_loss = results[("off", "off")]
-        for key, (st, loss) in results.items():
+        ref_st, ref_loss, ref_m = results[("off", "off", "off", "off")]
+        for key, (st, loss, mm) in results.items():
             assert loss == pytest.approx(ref_loss, rel=1e-5), (key, seed)
             for opn in ref_st.params:
                 for k in ref_st.params[opn]:
                     np.testing.assert_allclose(
-                        np.asarray(st.params[opn][k]),
-                        np.asarray(ref_st.params[opn][k]),
+                        mm.get_weights(st, opn, k),
+                        ref_m.get_weights(ref_st, opn, k),
                         rtol=1e-5, atol=1e-6,
                         err_msg=f"{key} {opn}/{k} seed={seed}")
