@@ -52,12 +52,69 @@ def _host_tables_of(model) -> dict:
             if hasattr(op, "host_table")}
 
 
+def _param_specs_of(model) -> dict:
+    """{(op_name, param_name): spec} for every declared parameter."""
+    out = {}
+    for op in getattr(model, "layers", []):
+        for spec in op.param_specs():
+            out[(op.name, spec.param_name)] = spec
+    return out
+
+
+def _reshape_to(state: TrainState, model, target: str) -> TrainState:
+    """Reshape parameters (and matching optimizer slot tables) between
+    their LOGICAL and physical STORAGE forms (tensor.py storage_shape —
+    packed embedding tables).  ``target``: "logical" canonicalizes for a
+    portable checkpoint; "storage" re-forms for the restoring model.
+    Row-major reshapes are value-preserving in both directions; arrays
+    already in the target form (or sharded under a mesh, where
+    storage_shape is never set) pass through unchanged."""
+    specs = _param_specs_of(model)
+
+    def fix(opn, pn, arr):
+        spec = specs.get((opn, pn))
+        if spec is None or not hasattr(arr, "reshape"):
+            return arr
+        # "storage" re-forms to what THIS model trains with — which is
+        # the logical shape when it uses logical storage (so a packed
+        # checkpoint restores cleanly onto a CPU/mesh model too)
+        want = (spec.shape if target == "logical"
+                or spec.storage_shape is None else spec.storage_shape)
+        if tuple(arr.shape) != want and arr.size == int(np.prod(want)):
+            return arr.reshape(want)
+        return arr
+
+    params = {opn: {pn: fix(opn, pn, v) for pn, v in d.items()}
+              for opn, d in state.params.items()}
+    opt_state = dict(state.opt_state)
+    for sn, tree in state.opt_state.items():
+        if not isinstance(tree, dict):
+            continue
+        new_tree = {}
+        for opn, d in tree.items():
+            if isinstance(d, dict):
+                new_tree[opn] = {pn: fix(opn, pn, v)
+                                 for pn, v in d.items()}
+            else:
+                new_tree[opn] = d
+        opt_state[sn] = new_tree
+    return TrainState(params, opt_state, state.bn_state, state.rng,
+                      state.step)
+
+
 def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
                     use_orbax: Optional[bool] = None, model=None) -> str:
     """Write a checkpoint directory; returns the path written.
 
     Pass ``model`` to include its CPU-placed (hetero) embedding tables —
-    they are host-resident and invisible to the TrainState pytree."""
+    they are host-resident and invisible to the TrainState pytree — and
+    to canonicalize packed-storage tables (FFConfig.packed_tables) to
+    their LOGICAL shapes, making the checkpoint portable across
+    backends/meshes/storage modes.  Without ``model``, packed arrays are
+    saved in storage form and restore_checkpoint(model=...) re-forms
+    them."""
+    if model is not None:
+        state = _reshape_to(state, model, "logical")
     os.makedirs(path, exist_ok=True)
     if use_orbax is None:
         use_orbax = _orbax_available()
@@ -127,6 +184,11 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
         host_tables = {k: np.asarray(v)
                        for k, v in groups["host_tables"].items()}
     if model is not None:
+        # re-form parameters for the restoring model's storage mode
+        # (logical checkpoints -> packed tables on single-chip TPU;
+        # packed checkpoints from a model-less save -> logical for a
+        # CPU/mesh model) — shapes, not values, change
+        state = _reshape_to(state, model, "storage")
         # put hetero CPU tables back into the host-RAM side store
         restored = set()
         for op in getattr(model, "_hetero_ops", []):

@@ -324,13 +324,7 @@ def packed_gather(table, ids):
     pack = pack_factor(r, d)
     if pack <= 1:
         return jnp.take(table, ids, axis=0)
-    q = ids // pack
-    h = ids % pack
-    view = table.reshape(r // pack, d * pack)
-    vrows = jnp.take(view, q, axis=0)          # ids.shape + (pack*d,)
-    vrows = vrows.reshape(ids.shape + (pack, d))
-    return jnp.take_along_axis(
-        vrows, h[..., None, None].astype(jnp.int32), axis=-2).squeeze(-2)
+    return view_gather(table.reshape(r // pack, d * pack), ids, d)
 
 
 def view_gather(view, ids, d: int):

@@ -9,28 +9,33 @@ bench shape (524k ids) on the TPU slice: the sort itself is ~1 ms — the
 cost is the dense-rank inverse construction, which lowers to scalar
 scatters (~3-6 ms each on this platform, PERF.md round 3).
 
-Ranks don't have to be dense: the cache is statically sized by the
-OCCURRENCE count n (the distinct count is data-dependent), so slots may
-be any per-run representative.  Using each run's FIRST POSITION in the
-sorted order needs only sorts (cheap), one cummax, and elementwise ops:
+The cache is statically sized by the OCCURRENCE count n (the distinct
+count is data-dependent), so ranks are computed with sorts only:
 
   s, perm = sort((ids, iota))          # one sort pass carries both
   flag[k]  = s[k] != s[k-1]            # run starts
-  firstpos = cummax(flag ? k : 0)      # slot of sorted position k
-  slots    = sort((perm, firstpos))[1] # back to original order: a sort
+  rank     = cumsum(flag) - 1          # dense rank of position k's run
+  slots    = sort((perm, rank))[1]     # back to original order: a sort
                                        # by a permutation replaces the
                                        # scalar scatter a rank-inverse
                                        # would need
-  rowof    = where(flag, s, sentinel)  # slot -> row, holes = sentinel
+  rowof    = sort(where(flag, s, sentinel))
+                                       # distinct rows compacted to the
+                                       # front, sentinel holes at the end
 
-``rowof`` is ascending-with-holes instead of jnp.unique's compacted
-form; the cache fill (gather rows at ``rowof``) and the writeback
-(scatter-set at ``rowof`` with mode="drop") are hole-tolerant, and the
-cached training path stays bit-exact with the uncached one — the same
-adds hit the same values in the same order, only the slot numbering
-changes.  (A presence-bitmap + cumsum "unique by scatter" variant was
-also built and measured: its scalar scatter/gather passes cost more
-than the sort it avoids on this platform — see PERF.md round 3.)
+Unlike jnp.unique's inverse this costs no scalar scatters, and unlike
+the round-3 first-position slotting (rank = cummax of run-first
+positions, holes interleaved) the produced ``rowof`` is NON-DECREASING:
+distinct rows ascending, then all sentinel holes.  That makes the cache
+fill (gather at ``rowof``, mode="clip") read ascending rows, keeps the
+live slots contiguous at the front of every cache, and — the round-3
+continuation's point — lets the writeback scatter
+(``.at[rowof].set(..., mode="drop")``) carry ``indices_are_sorted=True``,
+which switches XLA:TPU's scatter emitter onto a path measured 3.8x
+faster at the ladder's mid-level writeback shape (7.4 -> 28 GB/s,
+scripts/ab_prologue_layout.py protocol).  The cached training path
+stays bit-exact with the uncached one — the same adds hit the same
+values in the same order, only the slot numbering changes.
 """
 
 from __future__ import annotations
@@ -44,8 +49,9 @@ def slot_rows(ids, num_rows: int):
     [0, num_rows).
 
     ``rowof``: (n,) int32 where n = ids.size — ``rowof[p]`` is the table
-    row cached in slot p when p is a run-first sorted position, else the
-    sentinel ``num_rows``.  ``slots``: ids.shape int32 — the slot of each
+    row cached in slot p for p < (distinct count), else the sentinel
+    ``num_rows``; NON-DECREASING (distinct rows ascending, holes at the
+    end).  ``slots``: ids.shape int32 — the slot (dense rank) of each
     occurrence; all occurrences of one row share one slot, and
     ``rowof[slots] == ids`` everywhere.  Requires 0 <= ids < num_rows.
     """
@@ -56,9 +62,12 @@ def slot_rows(ids, num_rows: int):
     s, perm = jax.lax.sort((flat, pos), num_keys=1, is_stable=False)
     flag = jnp.concatenate(
         [jnp.ones((1,), bool), s[1:] != s[:-1]])
-    firstpos = jax.lax.cummax(jnp.where(flag, pos, 0))
+    rank = jnp.cumsum(flag.astype(jnp.int32)) - 1
     # slots back in original order: sorting by the permutation is the
-    # scatter ``out[perm] = firstpos`` expressed as a (cheap) sort
-    _, slots = jax.lax.sort((perm, firstpos), num_keys=1, is_stable=False)
-    rowof = jnp.where(flag, s, jnp.int32(num_rows))
+    # scatter ``out[perm] = rank`` expressed as a (cheap) sort
+    _, slots = jax.lax.sort((perm, rank), num_keys=1, is_stable=False)
+    # compact: distinct rows to the front (ascending), sentinels last —
+    # the non-sentinel values are already ascending, so this sort only
+    # closes the holes
+    rowof = jax.lax.sort(jnp.where(flag, s, jnp.int32(num_rows)))
     return rowof, slots.reshape(ids.shape)

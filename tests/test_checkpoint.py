@@ -200,3 +200,56 @@ def test_two_models_same_op_name_do_not_collide():
     assert t1.key != t2.key
     t1.array = np.full_like(t1.array, 7.0)
     assert not np.allclose(t2.array, 7.0)
+
+
+def test_packed_storage_checkpoint_portability(tmp_path):
+    """Checkpoints cross storage modes (FFConfig.packed_tables): a save
+    WITH the model canonicalizes packed tables to logical shapes on
+    disk; restore re-forms for the restoring model's mode in either
+    direction — values identical throughout."""
+    def build(packed):
+        cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[512] * 2,
+                         embedding_bag_size=2, mlp_bot=[13, 16, 8],
+                         mlp_top=[8 * 2 + 8, 16, 1])
+        m = build_dlrm(cfg, ff.FFConfig(batch_size=16,
+                                        packed_tables=packed))
+        m.compile(optimizer=ff.AdamOptimizer(0.01, lazy_embeddings=True),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        return cfg, m
+
+    cfg, mp = build("on")
+    state = mp.init(seed=0)
+    emb = [op for op in mp.layers if op.op_type == "StackedEmbedding"][0]
+    assert emb.storage_pack == 16
+    loader = SyntheticDLRMLoader(32, 13, cfg.embedding_size, 2, 16)
+    inputs, labels = loader.peek()
+    state, _ = mp.train_step(state, inputs, labels)
+    w_logical = mp.get_weights(state, emb.name, "embedding")
+
+    # save with model: logical canonical form on disk
+    path = save_checkpoint(str(tmp_path / "canon"), state, model=mp)
+    # model-less save keeps the raw packed storage form
+    path2 = save_checkpoint(str(tmp_path / "rawsave"), state)
+    raw = restore_checkpoint(path)
+    assert raw.params[emb.name]["embedding"].shape == (2, 512, 8)
+    assert raw.opt_state["m"][emb.name]["embedding"].shape == (2, 512, 8)
+
+    # logical checkpoint -> packed model: storage form + identical train
+    rp = restore_checkpoint(path, mp)
+    assert rp.params[emb.name]["embedding"].shape == (64, 128)
+    s_direct, mets_direct = mp.train_step(state, inputs, labels)
+    s_res, mets_res = mp.train_step(rp, inputs, labels)
+    assert float(mets_direct["loss"]) == float(mets_res["loss"])
+
+    # logical checkpoint -> logical model: values match the packed run
+    _, ml = build("off")
+    rl = restore_checkpoint(path, ml)
+    assert rl.params[emb.name]["embedding"].shape == (2, 512, 8)
+    np.testing.assert_array_equal(
+        np.asarray(rl.params[emb.name]["embedding"]), w_logical)
+
+    # model-LESS save of a packed state -> logical model still restores
+    rl2 = restore_checkpoint(path2, ml)
+    assert rl2.params[emb.name]["embedding"].shape == (2, 512, 8)
+    np.testing.assert_array_equal(
+        np.asarray(rl2.params[emb.name]["embedding"]), w_logical)

@@ -28,6 +28,12 @@ def check(ids, num_rows):
     live[np.unique(sf)] = True
     assert (rowof[~live] == num_rows).all()
     assert (rowof[live] < num_rows).all()
+    # rowof is NON-DECREASING (distinct rows compacted to the front,
+    # sentinels at the end) — the writeback scatter's
+    # indices_are_sorted=True hint depends on this (model.py
+    # _cache_writeback; 3.8x on the mid-level writeback, PERF.md)
+    assert (np.diff(rowof.astype(np.int64)) >= 0).all()
+    assert live[:live.sum()].all()  # live slots contiguous at the front
 
 
 @pytest.mark.parametrize("n,num_rows,seed", [
