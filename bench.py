@@ -283,6 +283,15 @@ def bench_app(app: str):
     fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
     mesh = False if jax.device_count() == 1 else None
 
+    if app in ("alexnet", "inception"):
+        # conv apps run bf16 activation STORAGE by default: the conv
+        # path is activation-bandwidth-bound (PERF.md round-3
+        # decomposition) and the loss trajectory tracks f32 activations
+        # (pinned by tests/test_ops.py) — same treatment as
+        # compute_dtype.  One shared config mutation so future fc
+        # arguments aren't silently dropped for the conv branches.
+        fc.activation_dtype = os.environ.get("BENCH_ACT_DTYPE",
+                                             "bfloat16")
     if app == "alexnet":
         # "AlexNet single-device, synthetic data, default data-parallel"
         from dlrm_flexflow_tpu.apps.alexnet import build_alexnet
@@ -390,6 +399,12 @@ def bench_app(app: str):
                               epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
+    if app in ("inception", "alexnet"):
+        # provenance: bf16 activation storage (default since round 3);
+        # loss-trajectory-pinned, credited as a framework optimization
+        # like compute_dtype (not part of the anchor key)
+        extra["act_dtype"] = str(
+            getattr(model.config, "activation_dtype", "float32"))
     if app == "nmt":
         # the FULL scale tuple anchors the entry: any dimension change
         # (vocab/embed/hidden/layers/lengths) is a different workload

@@ -112,6 +112,16 @@ class FFConfig:
     # = single-device TPU; "on" forces it anywhere (tests); "off"
     # restores logical storage.
     packed_tables: str = "auto"
+    # Inter-op activation STORAGE dtype ("float32"|"bfloat16").
+    # "bfloat16" halves the HBM traffic of every intermediate activation
+    # (conv nets are activation-bandwidth-bound on TPU — PERF.md round-3
+    # inception decomposition) by declaring intermediate outputs bf16;
+    # compute stays mixed-precision (MXU bf16 with f32 accumulation,
+    # BatchNorm statistics in f32), and the FINAL output tensor stays
+    # float32 so losses/metrics are unchanged in dtype.  Orthogonal to
+    # compute_dtype; loss trajectory tracks the f32-activation run
+    # (pinned by test).
+    activation_dtype: str = "float32"
     # Manual table-parallel exchange for StackedEmbedding under a mesh
     # ("off"|"allgather"|"all_to_all"): route the table-sharded lookup
     # through an explicit shard_map + ICI collective

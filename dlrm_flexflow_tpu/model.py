@@ -491,6 +491,33 @@ class FFModel:
         final_uid = out.uid
         mesh_ = self.mesh
 
+        # ---- activation storage dtype (FFConfig.activation_dtype) --------
+        # "bfloat16" declares every INTERMEDIATE float32 output tensor
+        # bf16, halving inter-op activation HBM traffic (conv nets are
+        # activation-bandwidth-bound, PERF.md inception decomposition).
+        # Ops emit their declared output dtype and consumers cast to
+        # their compute dtype, so the rewrite is purely a storage-width
+        # change; the FINAL output stays f32 (losses/metrics unchanged).
+        # Idempotent across recompiles: original dtypes are remembered
+        # and restored when the config turns it back off.
+        act_dtype = getattr(self.config, "activation_dtype", "float32")
+        if act_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"activation_dtype must be 'float32'|'bfloat16', "
+                f"got {act_dtype!r}")
+        if not hasattr(self, "_orig_out_dtypes"):
+            self._orig_out_dtypes = {}
+        for op in self.layers:
+            for t in op.outputs:
+                if t.uid == final_uid:
+                    continue
+                if act_dtype == "bfloat16":
+                    if t.dtype == jnp.float32:
+                        self._orig_out_dtypes.setdefault(t.uid, t.dtype)
+                        t.dtype = jnp.bfloat16
+                elif t.uid in self._orig_out_dtypes:
+                    t.dtype = self._orig_out_dtypes.pop(t.uid)
+
         def loss_and_preds(params, inputs, labels, rng, bn_state):
             values, new_bn = self._apply(params, inputs, training=True,
                                          rng=rng, bn_state=bn_state)

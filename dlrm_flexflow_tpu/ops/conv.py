@@ -164,12 +164,16 @@ class Pool2D(Op):
         strides = (1, 1, sh, sw)
         pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == "max":
-            y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+            y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                      strides, pads)
         else:
-            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+            # avg accumulates in f32 even under bf16 activation storage
+            # (an 8x8 window summed in bf16 loses ~3 bits)
+            s = jax.lax.reduce_window(x.astype(jnp.float32), 0.0,
+                                      jax.lax.add, dims, strides, pads)
             y = s / (kh * kw)
         y = activation_fn(self.activation)(y)
-        return [y]
+        return [y.astype(self.outputs[0].dtype)]
 
 
     def input_rect(self, pc, input_idx, part_idx):
@@ -211,6 +215,10 @@ class BatchNorm(Op):
 
     def forward(self, params, xs, *, training=False, rng=None, state=None):
         (x,) = xs
+        # statistics and normalization in f32 regardless of the
+        # activation storage dtype (bf16 mean/var over N*H*W loses
+        # precision); the declared output dtype is emitted at the end
+        x = x.astype(jnp.float32)
         if training or state is None:
             mean = jnp.mean(x, axis=(0, 2, 3))
             var = jnp.var(x, axis=(0, 2, 3))
@@ -228,4 +236,4 @@ class BatchNorm(Op):
         if self.relu:
             y = jax.nn.relu(y)
         self._last_state = new_state
-        return [y]
+        return [y.astype(self.outputs[0].dtype)]

@@ -27,7 +27,13 @@ class Softmax(Op):
         self.outputs = [self._make_output(input_tensor.shape, input_tensor.dtype)]
 
     def forward(self, params, xs, *, training=False, rng=None):
-        return [jax.nn.softmax(xs[0], axis=self.axis)]
+        # the softmax itself runs in f32 (log/exp over bf16 activations
+        # loses the probabilities' low bits and the fused CCE takes a
+        # log of them downstream); the DECLARED output dtype is emitted,
+        # which under activation_dtype="bfloat16" is f32 exactly when
+        # this is the model's final output
+        y = jax.nn.softmax(xs[0].astype(jnp.float32), axis=self.axis)
+        return [y.astype(self.outputs[0].dtype)]
 
     def input_rect(self, pc, input_idx, part_idx):
         """Pointwise over the non-softmax dims; parts never split the

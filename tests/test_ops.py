@@ -299,3 +299,79 @@ class TestAttention:
             torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
             is_causal=True).numpy()
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+class TestActivationDtype:
+    """FFConfig.activation_dtype="bfloat16" (bf16 activation STORAGE
+    between ops — the conv-net bandwidth lever, PERF.md round 3): the
+    final output tensor stays f32, the rewrite is idempotent across
+    recompiles, and the loss trajectory tracks the f32-activation run."""
+
+    def _conv_model(self, act, softmax_final=False):
+        import dlrm_flexflow_tpu as ff
+        fc = ff.FFConfig(batch_size=8, compute_dtype="bfloat16",
+                         activation_dtype=act)
+        m = ff.FFModel(fc)
+        x = m.create_tensor((8, 3, 16, 16), name="input")
+        t = m.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+        t = m.batch_norm(t, relu=True)
+        t = m.pool2d(t, 2, 2, 2, 2, 0, 0, pool_type="avg")
+        t = m.conv2d(t, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+        t = m.flat(t)
+        t = m.dense(t, 10)
+        if softmax_final:
+            # the shape both benchmarked conv apps actually use
+            # (alexnet.py/inception.py end in m.softmax)
+            t = m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=("accuracy",), mesh=False)
+        return m
+
+    def _losses(self, m, steps=20):
+        rng = np.random.default_rng(0)
+        st = m.init(seed=0)
+        # one fixed batch, memorized over the steps — random labels are
+        # learnable only when repeated
+        inputs = {"input": rng.standard_normal(
+            (8, 3, 16, 16)).astype(np.float32)}
+        labels = rng.integers(0, 10, size=(8, 1)).astype(np.int32)
+        out = []
+        for _ in range(steps):
+            st, mets = m.train_step(st, inputs, labels)
+            out.append(float(mets["loss"]))
+        return out
+
+    @pytest.mark.parametrize("softmax_final", [False, True])
+    def test_final_output_stays_f32_and_intermediates_flip(
+            self, softmax_final):
+        m = self._conv_model("bfloat16", softmax_final=softmax_final)
+        inter = [t for op in m.layers for t in op.outputs]
+        final = m.layers[-1].outputs[0]
+        assert final.dtype == jnp.float32
+        assert all(t.dtype == jnp.bfloat16 for t in inter
+                   if t.uid != final.uid)
+        # the RUNTIME final array is f32 too (a producer that ignores
+        # its declared dtype — softmax-final was the review catch —
+        # would emit bf16 probabilities into the fused CCE)
+        st = m.init(seed=0)
+        rng = np.random.default_rng(1)
+        preds = m.forward(st, {"input": rng.standard_normal(
+            (8, 3, 16, 16)).astype(np.float32)})
+        assert preds.dtype == jnp.float32
+        # recompile with f32 restores every dtype (idempotence)
+        m.config.activation_dtype = "float32"
+        m.compile(optimizer=__import__(
+            "dlrm_flexflow_tpu").SGDOptimizer(lr=0.05),
+            loss_type="sparse_categorical_crossentropy",
+            metrics=("accuracy",), mesh=False)
+        assert all(t.dtype == jnp.float32 for t in inter)
+
+    @pytest.mark.parametrize("softmax_final", [False, True])
+    def test_loss_trajectory_tracks_f32_activations(self, softmax_final):
+        l_bf = self._losses(self._conv_model(
+            "bfloat16", softmax_final=softmax_final))
+        l_f32 = self._losses(self._conv_model(
+            "float32", softmax_final=softmax_final))
+        assert l_bf[-1] < l_bf[0]  # learns
+        assert abs(l_bf[-1] - l_f32[-1]) < 0.05
