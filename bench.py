@@ -270,6 +270,11 @@ def kaggle_inputs(cfg, batch: int, nb: int, seed: int = 0):
     return inputs, labels
 
 
+# conv apps that default to bf16 activation storage (one constant so the
+# config mutation and the act_dtype provenance emit can't drift apart)
+CONV_APPS = ("alexnet", "inception")
+
+
 def bench_app(app: str):
     import jax
     import dlrm_flexflow_tpu as ff
@@ -283,7 +288,7 @@ def bench_app(app: str):
     fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
     mesh = False if jax.device_count() == 1 else None
 
-    if app in ("alexnet", "inception"):
+    if app in CONV_APPS:
         # conv apps run bf16 activation STORAGE by default: the conv
         # path is activation-bandwidth-bound (PERF.md round-3
         # decomposition) and the loss trajectory tracks f32 activations
@@ -399,7 +404,7 @@ def bench_app(app: str):
                               epochs, reps)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
-    if app in ("inception", "alexnet"):
+    if app in CONV_APPS:
         # provenance: bf16 activation storage (default since round 3);
         # loss-trajectory-pinned, credited as a framework optimization
         # like compute_dtype (not part of the anchor key)

@@ -102,7 +102,10 @@ class Layer:
         model's TrainState to read when the layer is part of several.
         """
         owner, op = self._built_op(ffmodel)
-        return tuple(np.asarray(owner.state.params[op.name][s.param_name])
+        # core get_weights returns LOGICAL shapes (packed-storage
+        # embedding tables unpack at the host boundary)
+        return tuple(owner.ffmodel.get_weights(owner.state, op.name,
+                                               s.param_name)
                      for s in op.param_specs())
 
     def set_weights(self, *args):
@@ -517,10 +520,10 @@ class BaseModel:
                     dsp = d_specs.get(spec.param_name)
                     if dsp is None or tuple(dsp.shape) != tuple(spec.shape):
                         continue  # architectures diverged; keep fresh init
-                    val = src_owner.state.params[s_op.name][spec.param_name]
+                    val = src_owner.ffmodel.get_weights(
+                        src_owner.state, s_op.name, spec.param_name)
                     self.state = self.ffmodel.set_weights(
-                        self.state, d_op.name, spec.param_name,
-                        np.asarray(val))
+                        self.state, d_op.name, spec.param_name, val)
 
     def _as_input_dict(self, x) -> Dict[str, np.ndarray]:
         if isinstance(x, dict):

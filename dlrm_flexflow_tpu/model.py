@@ -489,7 +489,16 @@ class FFModel:
         self.label_tensor = Tensor(lshape, ldtype, name="label")
 
         final_uid = out.uid
+        final_dtype = out.dtype
         mesh_ = self.mesh
+
+        def _final(values):
+            """The model's final output, CLAMPED to its declared dtype —
+            the activation_dtype rewrite exempts the final tensor (f32
+            losses/metrics), and ops that pass their input dtype through
+            uncast (elementwise/concat-final graphs) must not leak bf16
+            past the declaration (review r3)."""
+            return values[final_uid].astype(final_dtype)
 
         # ---- activation storage dtype (FFConfig.activation_dtype) --------
         # "bfloat16" declares every INTERMEDIATE float32 output tensor
@@ -521,7 +530,7 @@ class FFModel:
         def loss_and_preds(params, inputs, labels, rng, bn_state):
             values, new_bn = self._apply(params, inputs, training=True,
                                          rng=rng, bn_state=bn_state)
-            preds = values[final_uid]
+            preds = _final(values)
             loss = self._loss_fn(preds, labels)
             return loss, (preds, new_bn)
 
@@ -626,7 +635,7 @@ class FFModel:
                            "rows__": rows_dict[name]}
             values, new_bn = self._apply(p, inputs, training=True, rng=rng,
                                          bn_state=bn_state)
-            preds = values[final_uid]
+            preds = _final(values)
             return self._loss_fn(preds, labels), (preds, new_bn)
 
         def _cache_gather(op, cache, slots):
@@ -810,7 +819,7 @@ class FFModel:
         def eval_step(state: TrainState, inputs, labels):
             values, _ = self._apply(state.params, inputs, training=False,
                                     rng=None, bn_state=state.bn_state)
-            preds = values[final_uid]
+            preds = _final(values)
             mets = compute_metrics(preds, labels, self.metrics, loss_type)
             mets["loss"] = self._loss_fn(preds, labels)
             return mets
@@ -818,7 +827,7 @@ class FFModel:
         def forward(params, inputs, bn_state=None):
             values, _ = self._apply(params, inputs, training=False, rng=None,
                                     bn_state=bn_state or {})
-            return values[final_uid]
+            return _final(values)
 
         # Epoch row-cache: big-table gather/scatter lowers to a full-table
         # SWEEP per step on TPU (cost scales with table bytes, PERF.md).

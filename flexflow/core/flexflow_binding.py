@@ -242,7 +242,10 @@ class Parameter(Tensor):
 
     def get_weights(self, ffmodel: "FFModel") -> np.ndarray:
         state = ffmodel._require_state()
-        return np.asarray(state.params[self._op_name][self._param_name])
+        # core get_weights returns the LOGICAL shape (packed-storage
+        # embedding tables unpack at the host boundary)
+        return ffmodel._core.get_weights(state, self._op_name,
+                                         self._param_name)
 
     def set_weights(self, ffmodel: "FFModel", np_array: np.ndarray):
         state = ffmodel._require_state()

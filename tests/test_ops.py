@@ -367,6 +367,27 @@ class TestActivationDtype:
             metrics=("accuracy",), mesh=False)
         assert all(t.dtype == jnp.float32 for t in inter)
 
+    def test_elementwise_final_clamped_to_f32(self):
+        """Ops that pass their input dtype through uncast (elementwise,
+        concat) must not leak bf16 past the exempted final tensor — the
+        model clamps the final output to its declared dtype (review
+        r3)."""
+        import dlrm_flexflow_tpu as ff
+        fc = ff.FFConfig(batch_size=8, compute_dtype="bfloat16",
+                         activation_dtype="bfloat16")
+        m = ff.FFModel(fc)
+        x = m.create_tensor((8, 4), name="input")
+        a = m.dense(x, 8, activation="relu")
+        b = m.dense(x, 8, activation="relu")
+        t = m.add(a, b)  # elementwise-final graph
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        st = m.init(seed=0)
+        rng = np.random.default_rng(2)
+        preds = m.forward(st, {"input": rng.standard_normal(
+            (8, 4)).astype(np.float32)})
+        assert preds.dtype == jnp.float32
+
     @pytest.mark.parametrize("softmax_final", [False, True])
     def test_loss_trajectory_tracks_f32_activations(self, softmax_final):
         l_bf = self._losses(self._conv_model(
