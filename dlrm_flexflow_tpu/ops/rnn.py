@@ -107,10 +107,15 @@ class LSTM(Op):
             return (h, c), h
 
         if init is not None:
-            h0, c0 = init
+            # the recurrent carry is ALWAYS f32 (cell state precision;
+            # the step body emits f32 from the f32-accumulated gates) —
+            # an initial state arriving as a bf16 activation-storage
+            # tensor must not set the carry dtype
+            h0 = init[0].astype(jnp.float32)
+            c0 = init[1].astype(jnp.float32)
         else:
-            h0 = jnp.zeros((b, h_dim), x.dtype)
-            c0 = jnp.zeros((b, h_dim), x.dtype)
+            h0 = jnp.zeros((b, h_dim), jnp.float32)
+            c0 = jnp.zeros((b, h_dim), jnp.float32)
         (h_f, c_f), hs = jax.lax.scan(step, (h0, c0),
                                       jnp.swapaxes(x_proj, 0, 1))  # (T, B, H)
         hs = jnp.swapaxes(hs, 0, 1)  # (B, T, H)

@@ -367,6 +367,30 @@ class TestActivationDtype:
             metrics=("accuracy",), mesh=False)
         assert all(t.dtype == jnp.float32 for t in inter)
 
+    def test_lstm_initial_state_under_bf16_activations(self):
+        """A decoder LSTM receives its initial (h, c) from encoder
+        output tensors, which the bf16 rewrite flips — the recurrent
+        carry must stay f32 regardless (scan requires carry-in ==
+        carry-out dtypes; review-r3 era bug found by the NMT A/B)."""
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.apps.nmt import NMTConfig, build_nmt
+        cfg = NMTConfig(vocab_size=128, embed_size=16, hidden_size=16,
+                        num_layers=1, src_len=5, tgt_len=4)
+        fc = ff.FFConfig(batch_size=4, compute_dtype="bfloat16",
+                         activation_dtype="bfloat16")
+        m = build_nmt(cfg, fc)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=(), mesh=False)
+        rng = np.random.default_rng(0)
+        st = m.init(seed=0)
+        inputs = {"src": rng.integers(0, 128, size=(4, 5), dtype=np.int32),
+                  "tgt_in": rng.integers(0, 128, size=(4, 4),
+                                         dtype=np.int32)}
+        labels = rng.integers(0, 128, size=(4, 4, 1)).astype(np.int32)
+        st, mets = m.train_step(st, inputs, labels)
+        assert np.isfinite(float(mets["loss"]))
+
     def test_elementwise_final_clamped_to_f32(self):
         """Ops that pass their input dtype through uncast (elementwise,
         concat) must not leak bf16 past the exempted final tensor — the
