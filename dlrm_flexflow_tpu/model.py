@@ -399,13 +399,25 @@ class FFModel:
         # backward (loss_functions.cu:36-62).  When the graph does NOT end
         # in Softmax, swap in the stable from-logits form so both styles
         # train identically.
+        # the tensor the LOSS consumes; predictions/metrics always read
+        # the final output.  For a graph ending in a Softmax OP, the
+        # loss reads the softmax's INPUT with the from-logits form —
+        # the same softmax+CCE fusion the reference's loss kernels
+        # assume (loss_functions.cu:36-62), and it avoids log(prob)
+        # with prob underflowing to 0.0 for confident wrong predictions
+        self._loss_uid = (self.layers[-1].outputs[0].uid if self.layers
+                          else None)
         if loss_type in ("sparse_categorical_crossentropy",
                          "sparse_crossentropy", "categorical_crossentropy",
                          "crossentropy") and self.layers:
-            if not self._output_is_softmaxed():
-                base = ("sparse_categorical_crossentropy"
-                        if "sparse" in loss_type
-                        else "categorical_crossentropy")
+            base = ("sparse_categorical_crossentropy"
+                    if "sparse" in loss_type
+                    else "categorical_crossentropy")
+            last = self.layers[-1]
+            if isinstance(last, Softmax):
+                self._loss_uid = last.inputs[0].uid
+                self._loss_fn = get_loss(base + "_from_logits")
+            elif not self._output_is_softmaxed():
                 self._loss_fn = get_loss(base + "_from_logits")
         self.metrics = tuple(metrics)
         if strategy is not None:
@@ -500,6 +512,15 @@ class FFModel:
             past the declaration (review r3)."""
             return values[final_uid].astype(final_dtype)
 
+        _lu = getattr(self, "_loss_uid", None)
+        loss_uid = final_uid if _lu is None else _lu
+
+        def _loss_in(values):
+            """The loss's input (the pre-softmax LOGITS when the fused
+            softmax+CCE path is active — see compile), in the final
+            dtype so bf16 activation storage never feeds the loss."""
+            return values[loss_uid].astype(final_dtype)
+
         # ---- activation storage dtype (FFConfig.activation_dtype) --------
         # "bfloat16" declares every INTERMEDIATE float32 output tensor
         # bf16, halving inter-op activation HBM traffic (conv nets are
@@ -518,7 +539,11 @@ class FFModel:
             self._orig_out_dtypes = {}
         for op in self.layers:
             for t in op.outputs:
-                if t.uid == final_uid:
+                if t.uid in (final_uid, loss_uid):
+                    # the final output AND the loss input (pre-softmax
+                    # logits under the fused softmax+CCE path) stay f32
+                    # — losses/gradients must not see bf16-rounded
+                    # logits while the no-softmax twin reads f32
                     continue
                 if act_dtype == "bfloat16":
                     if t.dtype == jnp.float32:
@@ -531,7 +556,7 @@ class FFModel:
             values, new_bn = self._apply(params, inputs, training=True,
                                          rng=rng, bn_state=bn_state)
             preds = _final(values)
-            loss = self._loss_fn(preds, labels)
+            loss = self._loss_fn(_loss_in(values), labels)
             return loss, (preds, new_bn)
 
         # only Dropout consumes per-step randomness; skipping the split for
@@ -636,7 +661,7 @@ class FFModel:
             values, new_bn = self._apply(p, inputs, training=True, rng=rng,
                                          bn_state=bn_state)
             preds = _final(values)
-            return self._loss_fn(preds, labels), (preds, new_bn)
+            return self._loss_fn(_loss_in(values), labels), (preds, new_bn)
 
         def _cache_gather(op, cache, slots):
             """Logical rows ``slots`` of an epoch/ladder cache, through
@@ -821,7 +846,7 @@ class FFModel:
                                     rng=None, bn_state=state.bn_state)
             preds = _final(values)
             mets = compute_metrics(preds, labels, self.metrics, loss_type)
-            mets["loss"] = self._loss_fn(preds, labels)
+            mets["loss"] = self._loss_fn(_loss_in(values), labels)
             return mets
 
         def forward(params, inputs, bn_state=None):

@@ -686,12 +686,17 @@ class FFModel:
         self._state = self._core.init()
         core = self._core
         final_uid = core.final_tensor.uid
+        # the loss reads core._loss_uid (the pre-softmax logits when the
+        # fused softmax+CCE path is active), matching the fused step
+        _lu = getattr(core, "_loss_uid", None)
+        loss_uid = final_uid if _lu is None else _lu
 
         def loss_preds_grads(params, inputs, labels, rng, bn_state):
             values, new_bn = core._apply(params, inputs, training=True,
                                          rng=rng, bn_state=bn_state)
             preds = values[final_uid]
-            return core._loss_fn(preds, labels), (preds, new_bn)
+            return core._loss_fn(values[loss_uid].astype(preds.dtype),
+                                 labels), (preds, new_bn)
 
         self._bwd = jax.jit(jax.value_and_grad(loss_preds_grads,
                                                has_aux=True))

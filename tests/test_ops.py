@@ -349,8 +349,15 @@ class TestActivationDtype:
         inter = [t for op in m.layers for t in op.outputs]
         final = m.layers[-1].outputs[0]
         assert final.dtype == jnp.float32
+        # the loss input is exempt like the final output: under the
+        # fused softmax+CCE path that's the pre-softmax logits tensor
+        exempt = {final.uid, m._loss_uid}
         assert all(t.dtype == jnp.bfloat16 for t in inter
-                   if t.uid != final.uid)
+                   if t.uid not in exempt)
+        if softmax_final:
+            logits = m.layers[-1].inputs[0]
+            assert m._loss_uid == logits.uid
+            assert logits.dtype == jnp.float32
         # the RUNTIME final array is f32 too (a producer that ignores
         # its declared dtype — softmax-final was the review catch —
         # would emit bf16 probabilities into the fused CCE)

@@ -5,6 +5,7 @@ loss gradients with 1/batch scaling (loss_functions.cu:36-74,146).
 """
 
 import numpy as np
+import pytest
 import torch
 
 import jax
@@ -182,3 +183,58 @@ class TestInitializers:
         assert float(jnp.max(jnp.abs(u))) <= 0.1
         n = ff.NormInitializer(1.0, 0.5)(k, (5000,))
         assert abs(float(jnp.mean(n)) - 1.0) < 0.05
+
+
+class TestFusedSoftmaxCCE:
+    """A graph ending in a Softmax OP trains its loss from the
+    pre-softmax LOGITS (the reference's fused softmax+CCE,
+    loss_functions.cu:36-62): identical trajectory to the same model
+    without the softmax, and no log(0) = -inf for confident wrong
+    predictions."""
+
+    def _model(self, with_softmax, act="float32"):
+        import dlrm_flexflow_tpu as ff
+        m = ff.FFModel(ff.FFConfig(batch_size=8, activation_dtype=act))
+        x = m.create_tensor((8, 4), name="input")
+        t = m.dense(x, 16, activation="relu")
+        t = m.dense(t, 10)
+        if with_softmax:
+            t = m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=(), mesh=False)
+        return m
+
+    @pytest.mark.parametrize("act", ["float32", "bfloat16"])
+    def test_softmax_final_matches_logits_final(self, act):
+        # bf16 activations too: the loss input (pre-softmax logits) is
+        # exempt from the activation rewrite exactly like the final
+        # output, so the two graphs keep reading identical f32 logits
+        import numpy as np
+        rng = np.random.default_rng(0)
+        inputs = {"input": rng.standard_normal((8, 4)).astype(np.float32)}
+        labels = rng.integers(0, 10, size=(8, 1)).astype(np.int32)
+        losses = {}
+        for with_softmax in (True, False):
+            m = self._model(with_softmax, act)
+            st = m.init(seed=0)
+            ls = []
+            for _ in range(5):
+                st, mets = m.train_step(st, inputs, labels)
+                ls.append(float(mets["loss"]))
+            losses[with_softmax] = ls
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_confident_wrong_prediction_stays_finite(self):
+        import numpy as np
+        import jax.numpy as jnp
+        m = self._model(True)
+        st = m.init(seed=0)
+        # drive the logits to extreme values via huge inputs: softmax
+        # probs underflow to exact 0.0 for the losing classes, where a
+        # log(prob) loss would be -inf/nan
+        inputs = {"input": np.full((8, 4), 1e4, np.float32)}
+        labels = np.zeros((8, 1), np.int32)
+        st, mets = m.train_step(st, inputs, labels)
+        assert np.isfinite(float(mets["loss"]))
