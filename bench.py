@@ -23,9 +23,10 @@ program chain produces — stalled 120 s until the real work finished.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference repo publishes no numbers (BASELINE.md) — vs_baseline is
 computed against the FIRST *fenced* bench_history.json entry whose shape
-config (batch/num_batches/epochs/rows/emb_dtype) matches this run; table
-storage dtype changes numerics, so fp32 and bf16 runs anchor separately
-(entries predating the field count as float32).  Entries recorded
+config (batch/num_batches/epochs/rows/emb_dtype, plus act_dtype for the
+conv apps) matches this run; table and activation STORAGE dtypes change
+numerics, so fp32 and bf16 runs anchor separately
+(entries predating the fields count as float32).  Entries recorded
 before the device_fence fix (block_until_ready could return early on the
 tunneled platform, so those values are not comparable) are kept for the
 record but never used as the anchor.  The COMPUTE precision default
@@ -64,6 +65,8 @@ def _emit(metric, thpt, key, extra=None):
                     hv = "dlrm"  # records written before the app field
                 if k == "emb_dtype" and hv is None:
                     hv = "float32"  # records written before emb_dtype
+                if k == "act_dtype" and hv is None:
+                    hv = "float32"  # records written before act_dtype
                 if hv != v:
                     return False
             return True
@@ -405,10 +408,12 @@ def bench_app(app: str):
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1)}
     if app in CONV_APPS:
-        # provenance: bf16 activation storage (default since round 3);
-        # loss-trajectory-pinned, credited as a framework optimization
-        # like compute_dtype (not part of the anchor key)
-        extra["act_dtype"] = str(
+        # activation STORAGE dtype changes numerics (loss pinned only to
+        # within 0.05), so like emb_dtype it is part of the anchor key:
+        # f32- and bf16-activation runs never share an anchor (advisor
+        # r3).  Records predating the field count as float32 in
+        # matches().  Cross-precision trajectory lives in PERF.md.
+        key["act_dtype"] = str(
             getattr(model.config, "activation_dtype", "float32"))
     if app == "nmt":
         # the FULL scale tuple anchors the entry: any dimension change

@@ -535,6 +535,13 @@ class FFModel:
             raise ValueError(
                 f"activation_dtype must be 'float32'|'bfloat16', "
                 f"got {act_dtype!r}")
+        # validate epoch_cache_view unconditionally here (like the two
+        # checks above) — cache_prologue only runs when the epoch
+        # row-cache is active, which would let a typo pass silently
+        _ecv = getattr(self.config, "epoch_cache_view", "auto")
+        if _ecv not in ("auto", "on", "off"):
+            raise ValueError(
+                f"epoch_cache_view must be 'auto'|'on'|'off', got {_ecv!r}")
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -543,7 +550,12 @@ class FFModel:
                     # the final output AND the loss input (pre-softmax
                     # logits under the fused softmax+CCE path) stay f32
                     # — losses/gradients must not see bf16-rounded
-                    # logits while the no-softmax twin reads f32
+                    # logits while the no-softmax twin reads f32.
+                    # A tensor that only BECAME exempt on this compile
+                    # (e.g. the loss input moved) may carry bf16 from a
+                    # prior rewrite: always restore it first.
+                    if t.uid in self._orig_out_dtypes:
+                        t.dtype = self._orig_out_dtypes.pop(t.uid)
                     continue
                 if act_dtype == "bfloat16":
                     if t.dtype == jnp.float32:

@@ -201,7 +201,11 @@ class Tensor:
                 return np.asarray(self._ffmodel._pending[name])
             val = self._ffmodel._last_values.get(self._t.uid)
             if val is not None:
-                return np.asarray(val)
+                # clamp to the tensor's DECLARED dtype: the final output
+                # is exempt from the bf16 activation rewrite, but a
+                # pass-through final op can leave a bf16 array under its
+                # uid (mirrors model.py's _final clamp)
+                return np.asarray(val).astype(self._t.dtype, copy=False)
         raise RuntimeError("tensor has no attached or computed value")
 
     def get_flat_array(self, ffconfig, data_type=None):
@@ -691,11 +695,16 @@ class FFModel:
         _lu = getattr(core, "_loss_uid", None)
         loss_uid = final_uid if _lu is None else _lu
 
+        final_dtype = core.final_tensor.dtype
+
         def loss_preds_grads(params, inputs, labels, rng, bn_state):
             values, new_bn = core._apply(params, inputs, training=True,
                                          rng=rng, bn_state=bn_state)
-            preds = values[final_uid]
-            return core._loss_fn(values[loss_uid].astype(preds.dtype),
+            # clamp to the declared final dtype, mirroring model.py's
+            # _final — under activation_dtype='bfloat16' a pass-through
+            # final op would otherwise leak bf16 preds/metrics here
+            preds = values[final_uid].astype(final_dtype)
+            return core._loss_fn(values[loss_uid].astype(final_dtype),
                                  labels), (preds, new_bn)
 
         self._bwd = jax.jit(jax.value_and_grad(loss_preds_grads,
@@ -787,7 +796,11 @@ class FFModel:
 
     def compute_metrics(self):
         _, labels = self._batch_inputs()
-        preds = self._last_values[self._core.final_tensor.uid]
+        # same declared-dtype clamp as loss_preds_grads: a pass-through
+        # final op under activation_dtype='bfloat16' must not feed
+        # bf16-rounded preds into the metrics
+        final = self._core.final_tensor
+        preds = self._last_values[final.uid].astype(final.dtype)
         mets = compute_metrics(preds, labels, self._acc.metrics or
                                self._core.metrics, self._core.loss_type)
         self._acc.update(mets)

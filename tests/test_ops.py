@@ -374,6 +374,38 @@ class TestActivationDtype:
             metrics=("accuracy",), mesh=False)
         assert all(t.dtype == jnp.float32 for t in inter)
 
+    def test_newly_exempt_loss_input_is_restored(self):
+        """A tensor bf16-flipped by one compile must return to f32 when
+        a recompile makes it the loss input (advisor r3): mse on a
+        softmax-final graph reads the softmax output, so the pre-softmax
+        logits are a plain intermediate (bf16); switching to the fused
+        softmax+CCE makes those logits the loss input — exempt, f32."""
+        import dlrm_flexflow_tpu as ff
+        m = self._conv_model("bfloat16", softmax_final=True)
+        logits = m.layers[-1].inputs[0]
+        assert logits.dtype == jnp.float32  # exempt under fused CCE
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        assert logits.dtype == jnp.bfloat16  # plain intermediate now
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=(), mesh=False)
+        assert logits.dtype == jnp.float32  # restored on re-exemption
+
+    def test_epoch_cache_view_validated_without_sparse_ops(self):
+        """epoch_cache_view typos must fail compile even when no sparse
+        embedding op exists to reach cache_prologue (advisor r3)."""
+        import dlrm_flexflow_tpu as ff
+        fc = ff.FFConfig(batch_size=8)
+        fc.epoch_cache_view = "one"  # typo for "on"
+        m = ff.FFModel(fc)
+        x = m.create_tensor((8, 4), name="input")
+        t = m.dense(x, 2)
+        with pytest.raises(ValueError, match="epoch_cache_view"):
+            m.compile(optimizer=ff.SGDOptimizer(lr=0.1),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
+
     def test_lstm_initial_state_under_bf16_activations(self):
         """A decoder LSTM receives its initial (h, c) from encoder
         output tensors, which the bf16 rewrite flips — the recurrent
