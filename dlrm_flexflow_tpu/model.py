@@ -53,6 +53,17 @@ from .parallel.parallel_config import Strategy
 from .tensor import Tensor, as_dtype
 
 
+def _validated_epoch_cache_view(config) -> str:
+    """epoch_cache_view, validated — one shared check so compile (always)
+    and cache_prologue (re-reads config, catches post-compile mutation)
+    can't drift apart."""
+    view_mode = getattr(config, "epoch_cache_view", "auto")
+    if view_mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"epoch_cache_view must be 'auto'|'on'|'off', got {view_mode!r}")
+    return view_mode
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class TrainState:
@@ -538,10 +549,7 @@ class FFModel:
         # validate epoch_cache_view unconditionally here (like the two
         # checks above) — cache_prologue only runs when the epoch
         # row-cache is active, which would let a typo pass silently
-        _ecv = getattr(self.config, "epoch_cache_view", "auto")
-        if _ecv not in ("auto", "on", "off"):
-            raise ValueError(
-                f"epoch_cache_view must be 'auto'|'on'|'off', got {_ecv!r}")
+        _validated_epoch_cache_view(self.config)
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -1024,11 +1032,7 @@ class FFModel:
             slots).  Returns (state-with-caches, slots, writebacks,
             originals)."""
             from .ops.pallas_scatter import use_packed_view
-            view_mode = getattr(self.config, "epoch_cache_view", "auto")
-            if view_mode not in ("auto", "on", "off"):
-                raise ValueError(
-                    f"epoch_cache_view must be 'auto'|'on'|'off', "
-                    f"got {view_mode!r}")
+            view_mode = _validated_epoch_cache_view(self.config)
             # "on" still requires no mesh (under SPMD the view fights
             # the sharded layout, like every packed-view path)
             if view_mode == "on":

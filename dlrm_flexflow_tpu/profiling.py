@@ -64,6 +64,87 @@ def device_fence(x):
     return x
 
 
+def parse_device_trace(logdir: str):
+    """Sum slice durations by op name across the device (non-host) tracks
+    of the NEWEST ``*.trace.json.gz`` under ``logdir``.
+
+    Returns ``(trace_path, process_names, {op_name: total_us})``.  Shared
+    by ``scripts/profile_headline.py`` and the bench protocol's
+    ``device_busy_ms`` measurement (PERF.md: wall-clock on the shared
+    tunneled chip is a queue lottery; trace-derived device-busy time is
+    the defensible per-entry number)."""
+    import gzip
+    import json
+    import os
+
+    paths = []
+    for root, _dirs, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".trace.json.gz"):
+                paths.append(os.path.join(root, f))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pnames = {}
+    tnames = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pnames[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            tnames[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pnames.items()
+                if "TPU" in n or "/device" in n.lower()}
+    if not dev_pids:  # fall back: anything that is not explicitly host
+        dev_pids = {p for p, n in pnames.items()
+                    if "host" not in n.lower() and "python" not in n.lower()}
+    # A device pid carries NESTED tracks ("XLA Modules" spans the same
+    # wall time as the "XLA Ops" it contains — verified on this
+    # platform), so summing every track double-counts.  Keep only the
+    # op-level tracks when they exist.
+    op_tids = {pt for pt, n in tnames.items()
+               if pt[0] in dev_pids and "XLA Ops" in n}
+
+    def _keep(e):
+        if e.get("pid") not in dev_pids:
+            return False
+        return not op_tids or (e["pid"], e.get("tid")) in op_tids
+
+    tot = {}
+    for e in events:
+        if e.get("ph") == "X" and _keep(e):
+            tot[e["name"]] = tot.get(e["name"], 0.0) + e.get("dur", 0.0)
+    if not tot:
+        raise ValueError(
+            f"no device op slices found in {path} "
+            f"(processes: {sorted(pnames.values())})")
+    return path, pnames, tot
+
+
+def traced_device_busy_ms(fn, logdir: str | None = None) -> float:
+    """Run ``fn()`` under a profiler trace and return total device-op
+    time in ms.  ``fn`` must fence its own work (device_fence) so the
+    trace covers it.  Temp trace dirs are cleaned up afterwards."""
+    import shutil
+    import tempfile
+
+    own = logdir is None
+    if own:
+        logdir = tempfile.mkdtemp(prefix="ff_bench_trace_")
+    try:
+        with trace(logdir):
+            fn()
+        _path, _pnames, tot = parse_device_trace(logdir)
+        return sum(tot.values()) / 1e3
+    finally:
+        if own:
+            shutil.rmtree(logdir, ignore_errors=True)
+
+
 class Timer:
     """Fenced wall-clock timing (reference dlrm.cc:154-198 protocol)."""
 

@@ -10,8 +10,6 @@ Env: PROF_ROWS (default 1e6), PROF_BATCH (256), PROF_LEVELS (ladder
 override, e.g. "256,32,8"), PROF_TOP (default 30 lines).
 """
 
-import gzip
-import json
 import os
 import sys
 import time
@@ -58,34 +56,14 @@ def build():
 
 
 def parse_trace(logdir, min_frac=0.001):
-    """Sum slice durations by name across the device (non-CPU) tracks of
-    the newest trace.json.gz under ``logdir``."""
-    paths = []
-    for root, _dirs, files in os.walk(logdir):
-        for f in files:
-            if f.endswith(".trace.json.gz"):
-                paths.append(os.path.join(root, f))
-    if not paths:
-        raise SystemExit(f"no trace.json.gz under {logdir}")
-    path = max(paths, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    # pid -> process name, to keep only device tracks
-    pnames = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pnames[e["pid"]] = e["args"].get("name", "")
-    dev_pids = {p for p, n in pnames.items()
-                if "TPU" in n or "/device" in n.lower()}
-    if not dev_pids:  # fall back: anything that is not explicitly host
-        dev_pids = {p for p, n in pnames.items()
-                    if "host" not in n.lower() and "python" not in n.lower()}
-    tot = {}
-    for e in events:
-        if e.get("ph") == "X" and e.get("pid") in dev_pids:
-            tot[e["name"]] = tot.get(e["name"], 0.0) + e.get("dur", 0.0)
-    return path, pnames, tot
+    """Shared implementation lives in dlrm_flexflow_tpu.profiling (the
+    bench protocol records the same statistic as ``device_busy_ms``)."""
+    from dlrm_flexflow_tpu.profiling import parse_device_trace
+
+    try:
+        return parse_device_trace(logdir)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
 
 
 def main():
