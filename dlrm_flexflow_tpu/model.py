@@ -1074,15 +1074,15 @@ class FFModel:
 
         def ladder_sizes(nb):
             """Static block sizes of the in-graph cache ladder for an
-            nb-step scan, outermost first.  The top level is the former
-            host-side chunk — running it as an in-graph scan level lets
-            a multi-epoch run fuse into ONE dispatch with one prologue —
-            the innermost is ``epoch_cache_inner``, and "auto" inserts a
-            geometric mid level when top/inner > 8 so no level's rebuild
-            sweeps more than ~8 blocks' worth of parent-cache rows
-            (PERF.md round 3).  ``epoch_cache_levels`` overrides: "off"
-            disables the ladder, a comma list (or tuple) names explicit
-            sizes."""
+            nb-step scan, outermost first.  "auto" is the shallow
+            two-level shape [8*inner, inner] (round-4 measurement — see
+            the comment below; ``epoch_cache_chunk`` no longer shapes
+            the auto ladder, it only sizes host-side dispatch chunks for
+            epochs the ladder cannot engage).  When 8*inner does not
+            divide nb, auto falls back to [geometric mid, inner], and
+            when ``epoch_cache_inner`` <= 1 to a chunk-sized single
+            level.  ``epoch_cache_levels`` overrides: "off" disables the
+            ladder, a comma list (or tuple) names explicit sizes."""
             cfg_levels = getattr(self.config, "epoch_cache_levels", "auto")
             if cfg_levels in ("off", "", None):
                 return []
@@ -1091,23 +1091,43 @@ class FFModel:
                     return [int(s) for s in cfg_levels.split(",")
                             if s.strip()]
                 return [int(s) for s in cfg_levels]
-            chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
             inner = int(getattr(self.config, "epoch_cache_inner", 8))
-            sizes, cur = [], nb
-            if 0 < chunk < cur and cur % chunk == 0:
-                sizes.append(chunk)
-                cur = chunk
-            if 0 < inner < cur and cur % inner == 0:
-                if cur // inner > 8:
-                    import math
-                    target = math.isqrt(cur * inner)
-                    cands = [s for s in range(inner + 1, cur)
-                             if cur % s == 0 and s % inner == 0]
-                    if cands:
-                        sizes.append(min(cands,
-                                         key=lambda s: abs(s - target)))
-                sizes.append(inner)
-            return sizes
+            # Auto is the SHALLOW two-level shape [8*inner, inner]: the
+            # round-3 deep [chunk, mid, inner] ladder existed because
+            # explicit-level probes looked 3.5x worse — but that was
+            # chunked DISPATCH overhead, not device work (round-4
+            # profile: [64,8] busy 259 ms vs [256,32,8] busy 322 ms at
+            # the headline shape — every extra level adds its own
+            # rebuild+writeback boundary traffic, ~4 bytes moved per
+            # occurrence-row per level).  The mid cache (8*inner steps)
+            # stays small enough for XLA:TPU to keep in fast scoped
+            # memory while its writebacks into the epoch cache amortize
+            # over 8 inner blocks.
+            if 0 < inner < nb:
+                top = inner * 8
+                if top < nb and nb % top == 0:
+                    return [top, inner]
+                if nb % inner == 0:
+                    # non-divisible top: single level, plus a geometric
+                    # mid when the epoch is long enough to need one
+                    sizes = []
+                    if nb // inner > 8:
+                        import math
+                        target = math.isqrt(nb * inner)
+                        cands = [s for s in range(inner + 1, nb)
+                                 if nb % s == 0 and s % inner == 0]
+                        if cands:
+                            sizes.append(min(cands,
+                                             key=lambda s: abs(s - target)))
+                    sizes.append(inner)
+                    return sizes
+            # inner disabled (<= 1) or not engaging: a chunk-sized
+            # single level still bounds the per-step cache sweep (the
+            # pre-round-3 behavior for epoch_cache_inner=0)
+            chunk = int(getattr(self.config, "epoch_cache_chunk", 256))
+            if 0 < chunk < nb and nb % chunk == 0:
+                return [chunk]
+            return []
 
         def ladder_meta(nb, slots_ep, rows0):
             """Static ladder plan [(size, {op: cache rows}), ...]: at
@@ -1536,12 +1556,27 @@ class FFModel:
         if not (self._epoch_cache_active and chunk > 0 and nb > chunk):
             return None
         levels = getattr(self.config, "epoch_cache_levels", "auto")
-        if levels == "auto" and nb % chunk == 0:
-            # the in-graph ladder scans chunk-sized blocks INSIDE the
-            # jitted epoch, so the whole (multi-epoch) run is one
-            # dispatch with one prologue; host-side chunking remains
-            # only for epochs the chunk does not divide
+        inner = int(getattr(self.config, "epoch_cache_inner", 8))
+        if levels == "auto" and (nb % chunk == 0
+                                 or (inner > 1 and nb % inner == 0)):
+            # an in-graph ladder level engages over the full epoch, so
+            # the whole (multi-epoch) run is one dispatch with one
+            # prologue; host-side chunking remains only for epochs no
+            # level divides
             return None
+        if levels not in ("auto", "off", "", None):
+            # explicit ladder sizes: run unchunked whenever at least one
+            # level engages (divides nb) — host-side chunking would pay
+            # one ~5 ms tunnel dispatch per chunk plus a per-chunk cache
+            # fill, which is what the round-3 ladder-shape probes
+            # actually measured (the "3.5x worse" shallow shapes have
+            # device-busy equal to auto's; the regression was all
+            # dispatch, PERF.md round 4)
+            sizes = ([int(s) for s in levels.split(",") if s.strip()]
+                     if isinstance(levels, str)
+                     else [int(s) for s in levels])
+            if any(0 < s < nb and nb % s == 0 for s in sizes):
+                return None
         inner = int(getattr(self.config, "epoch_cache_inner", 8))
         if inner > 1 and chunk > inner:
             # work in whole inner blocks so every main chunk keeps the

@@ -65,14 +65,20 @@ def device_fence(x):
 
 
 def parse_device_trace(logdir: str):
-    """Sum slice durations by op name across the device (non-host) tracks
-    of the NEWEST ``*.trace.json.gz`` under ``logdir``.
+    """Parse the NEWEST ``*.trace.json.gz`` under ``logdir``.
 
-    Returns ``(trace_path, process_names, {op_name: total_us})``.  Shared
-    by ``scripts/profile_headline.py`` and the bench protocol's
-    ``device_busy_ms`` measurement (PERF.md: wall-clock on the shared
-    tunneled chip is a queue lottery; trace-derived device-busy time is
-    the defensible per-entry number)."""
+    Returns ``(trace_path, process_names, {op_name: self_us}, busy_ms)``.
+
+    ``self_us`` is per-op SELF time on the device op track: op slices
+    NEST (a scan's ``while`` slice spans every op executed inside it —
+    verified on this platform: Ops-track raw sum 163 ms vs 46.8 ms true
+    module time), so each slice's children are subtracted before
+    accumulating.  ``busy_ms`` is the "XLA Modules" track total — the
+    device-occupied wall, the number the bench records as
+    ``device_busy_ms`` (PERF.md: wall-clock on the shared tunneled chip
+    is a queue lottery; trace-derived busy time is the defensible
+    per-entry number).  Shared by ``scripts/profile_headline.py`` and
+    ``bench.py``."""
     import gzip
     import json
     import os
@@ -103,26 +109,51 @@ def parse_device_trace(logdir: str):
         dev_pids = {p for p, n in pnames.items()
                     if "host" not in n.lower() and "python" not in n.lower()}
     # A device pid carries NESTED tracks ("XLA Modules" spans the same
-    # wall time as the "XLA Ops" it contains — verified on this
-    # platform), so summing every track double-counts.  Keep only the
-    # op-level tracks when they exist.
+    # wall time as the "XLA Ops" it contains), and the Ops track itself
+    # nests (a scan's `while` slice spans its body's ops).  Busy time
+    # comes from the Modules track; per-op times are SELF times.
     op_tids = {pt for pt, n in tnames.items()
                if pt[0] in dev_pids and "XLA Ops" in n}
+    mod_tids = {pt for pt, n in tnames.items()
+                if pt[0] in dev_pids and "XLA Modules" in n}
 
-    def _keep(e):
-        if e.get("pid") not in dev_pids:
-            return False
-        return not op_tids or (e["pid"], e.get("tid")) in op_tids
+    def _slices(keep_tids):
+        for e in events:
+            if (e.get("ph") == "X"
+                    and e.get("pid") in dev_pids
+                    and (not keep_tids
+                         or (e["pid"], e.get("tid")) in keep_tids)):
+                yield e
 
+    busy_ms = sum(e.get("dur", 0.0) for e in _slices(mod_tids)) / 1e3
+
+    # self time per op: sort by (ts, -dur) so a parent precedes the
+    # children it contains; a stack tracks open slices per track
     tot = {}
-    for e in events:
-        if e.get("ph") == "X" and _keep(e):
-            tot[e["name"]] = tot.get(e["name"], 0.0) + e.get("dur", 0.0)
+    by_tid = {}
+    for e in _slices(op_tids):
+        by_tid.setdefault((e["pid"], e.get("tid")), []).append(e)
+    for track in by_tid.values():
+        track.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack = []  # [end_ts, children_dur, name, dur]
+        for e in track:
+            ts, dur = e["ts"], e.get("dur", 0.0)
+            while stack and stack[-1][0] <= ts:
+                _end, kids, nm, d = stack.pop()
+                tot[nm] = tot.get(nm, 0.0) + (d - kids)
+            if stack:
+                stack[-1][1] += dur
+            stack.append([ts + dur, 0.0, e["name"], dur])
+        while stack:
+            _end, kids, nm, d = stack.pop()
+            tot[nm] = tot.get(nm, 0.0) + (d - kids)
     if not tot:
         raise ValueError(
             f"no device op slices found in {path} "
             f"(processes: {sorted(pnames.values())})")
-    return path, pnames, tot
+    if not busy_ms:  # no Modules track on this platform: fall back
+        busy_ms = sum(tot.values()) / 1e3
+    return path, pnames, tot, busy_ms
 
 
 def traced_device_busy_ms(fn, logdir: str | None = None) -> float:
@@ -138,8 +169,8 @@ def traced_device_busy_ms(fn, logdir: str | None = None) -> float:
     try:
         with trace(logdir):
             fn()
-        _path, _pnames, tot = parse_device_trace(logdir)
-        return sum(tot.values()) / 1e3
+        _path, _pnames, _tot, busy_ms = parse_device_trace(logdir)
+        return busy_ms
     finally:
         if own:
             shutil.rmtree(logdir, ignore_errors=True)

@@ -57,7 +57,9 @@ def build():
 
 def parse_trace(logdir, min_frac=0.001):
     """Shared implementation lives in dlrm_flexflow_tpu.profiling (the
-    bench protocol records the same statistic as ``device_busy_ms``)."""
+    bench protocol records the same busy statistic as ``device_busy_ms``).
+    Op times are SELF times — a scan's ``while`` slice spans its body in
+    the trace, so raw sums would double-count."""
     from dlrm_flexflow_tpu.profiling import parse_device_trace
 
     try:
@@ -93,11 +95,14 @@ def main():
     device_fence(state.step)
     jax.profiler.stop_trace()
 
-    path, pnames, tot = parse_trace(logdir)
+    path, pnames, tot, busy_ms = parse_trace(logdir)
     print(f"# trace: {path}")
     print(f"# tracks: {sorted(set(pnames.values()))}")
     total = sum(tot.values())
-    print(f"# device total: {total/1e3:.1f} ms over {len(tot)} op names")
+    print(f"# device busy (module track): {busy_ms:.1f} ms = "
+          f"{busy_ms*1e3/steps:.1f} us/step")
+    print(f"# op self-time total: {total/1e3:.1f} ms over "
+          f"{len(tot)} op names")
     top = int(os.environ.get("PROF_TOP", 30))
     for name, dur in sorted(tot.items(), key=lambda kv: -kv[1])[:top]:
         print(f"{dur/1e3:10.2f} ms  {dur/total*100:5.1f}%  "

@@ -619,12 +619,18 @@ class TestEpochRowCache:
         m = ffm.FFModel(ff.FFConfig(epoch_cache_chunk=256,
                                     epoch_cache_inner=8))
         m._epoch_cache_active = True
-        bounds = m._epoch_chunk_bounds(1000)
+        # inner divides nb -> an in-graph ladder level engages over the
+        # whole epoch, so the dispatch is UNCHUNKED (round 4: host-side
+        # chunking cost ~5 ms/dispatch and was the real source of the
+        # round-3 "shallow ladders are slow" artifact)
+        assert m._epoch_chunk_bounds(1000) is None
+        # nothing engages (inner does not divide) -> chunked, with all
+        # but the tail rounded to whole inner blocks
+        bounds = m._epoch_chunk_bounds(1001)
         sizes = [hi - lo for lo, hi in bounds]
-        assert sum(sizes) == 1000
-        # all but the tail are multiples of the inner block
+        assert sum(sizes) == 1001
         assert all(s % 8 == 0 for s in sizes[:-1])
-        assert bounds[-1][1] == 1000
+        assert bounds[-1][1] == 1001
 
 
 class TestMeshSparseFastPath:
