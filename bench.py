@@ -292,9 +292,65 @@ def kaggle_inputs(cfg, batch: int, nb: int, seed: int = 0):
     return inputs, labels
 
 
-# conv apps that default to bf16 activation storage (one constant so the
-# config mutation and the act_dtype provenance emit can't drift apart)
-CONV_APPS = ("alexnet", "inception")
+# conv apps and their default activation STORAGE dtype (one constant so
+# the config mutation and the act_dtype anchor-key emit can't drift
+# apart).  Defaults are the paired-A/B winners (PERF.md round 4,
+# trace-busy measured, reproducible to ±0.1 ms): bf16 activations win
+# 21% on Inception (big spatial activations -> bandwidth dominates) and
+# LOSE 3% on AlexNet (small activations vs giant FC weights -> the
+# inserted converts cost more than the saved bytes; this also explains
+# the round-3 AlexNet regression, which tracked the bf16-act default).
+CONV_APPS = {"alexnet": "float32", "inception": "bfloat16"}
+
+
+def build_conv_app(app: str, batch: int, nb: int,
+                   dtype: str | None = None, act_dtype: str | None = None):
+    """THE conv-app bench construction, shared by ``bench_app`` and
+    ``scripts/profile_app.py`` so profiles always attribute the exact
+    configuration the bench anchors (advisor r4): same config mutations
+    (incl. the per-app activation-storage default from CONV_APPS), same
+    compile arguments, same synthetic data.  Returns
+    ``(model, inputs, labels)`` with HOST inputs."""
+    import jax
+    import dlrm_flexflow_tpu as ff
+
+    if dtype is None:
+        dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    rng = np.random.default_rng(0)
+    fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
+    mesh = False if jax.device_count() == 1 else None
+    # per-app activation-storage default (see CONV_APPS); loss
+    # trajectory pinned by tests/test_ops.py either way
+    fc.activation_dtype = (act_dtype
+                           or os.environ.get("BENCH_ACT_DTYPE",
+                                             CONV_APPS[app]))
+    if app == "alexnet":
+        # "AlexNet single-device, synthetic data, default data-parallel"
+        from dlrm_flexflow_tpu.apps.alexnet import build_alexnet
+        model = build_alexnet(fc)
+        strategy, side = None, 229
+    elif app == "inception":
+        # "InceptionV3 with SOAP auto-searched op/attr-parallel strategy"
+        from dlrm_flexflow_tpu.apps.inception import build_inception
+        model = build_inception(fc)
+        strategy, side = None, 299
+        if jax.device_count() > 1:
+            # a searched strategy only changes execution when there is a
+            # mesh to shard over; on one chip skip the search rather than
+            # discard its result
+            from dlrm_flexflow_tpu.sim.search import mcmc_search
+            strategy = mcmc_search(model, jax.device_count(),
+                                   budget=int(os.environ.get("BENCH_BUDGET",
+                                                             100)))
+    else:
+        raise ValueError(f"not a conv app: {app!r}")
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="sparse_categorical_crossentropy",
+                  metrics=("accuracy",), mesh=mesh, strategy=strategy)
+    inputs = {"input": rng.standard_normal(
+        (nb, batch, 3, side, side)).astype(np.float32)}
+    labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
+    return model, inputs, labels
 
 
 def bench_app(app: str):
@@ -311,43 +367,7 @@ def bench_app(app: str):
     mesh = False if jax.device_count() == 1 else None
 
     if app in CONV_APPS:
-        # conv apps run bf16 activation STORAGE by default: the conv
-        # path is activation-bandwidth-bound (PERF.md round-3
-        # decomposition) and the loss trajectory tracks f32 activations
-        # (pinned by tests/test_ops.py) — same treatment as
-        # compute_dtype.  One shared config mutation so future fc
-        # arguments aren't silently dropped for the conv branches.
-        fc.activation_dtype = os.environ.get("BENCH_ACT_DTYPE",
-                                             "bfloat16")
-    if app == "alexnet":
-        # "AlexNet single-device, synthetic data, default data-parallel"
-        from dlrm_flexflow_tpu.apps.alexnet import build_alexnet
-        model = build_alexnet(fc)
-        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
-                      loss_type="sparse_categorical_crossentropy",
-                      metrics=("accuracy",), mesh=mesh)
-        inputs = {"input": rng.standard_normal(
-            (nb, batch, 3, 229, 229)).astype(np.float32)}
-        labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
-    elif app == "inception":
-        # "InceptionV3 with SOAP auto-searched op/attr-parallel strategy"
-        from dlrm_flexflow_tpu.apps.inception import build_inception
-        model = build_inception(fc)
-        strategy = None
-        if jax.device_count() > 1:
-            # a searched strategy only changes execution when there is a
-            # mesh to shard over; on one chip skip the search rather than
-            # discard its result
-            from dlrm_flexflow_tpu.sim.search import mcmc_search
-            strategy = mcmc_search(model, jax.device_count(),
-                                   budget=int(os.environ.get("BENCH_BUDGET",
-                                                             100)))
-        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
-                      loss_type="sparse_categorical_crossentropy",
-                      metrics=("accuracy",), mesh=mesh, strategy=strategy)
-        inputs = {"input": rng.standard_normal(
-            (nb, batch, 3, 299, 299)).astype(np.float32)}
-        labels = rng.integers(0, 10, size=(nb, batch, 1)).astype(np.int32)
+        model, inputs, labels = build_conv_app(app, batch, nb, dtype)
     elif app == "nmt":
         # "NMT LSTM seq2seq (nmt/), attribute-parallel RNN layers" at the
         # REFERENCE scale (nmt/nmt.cc:36-50: vocab 20480, embed/hidden

@@ -1,0 +1,67 @@
+"""Attribute a BENCH_APP config's device time by HLO op.
+
+The conv-app twin of ``profile_headline.py``: builds the app exactly as
+``bench.bench_app`` does (same config mutations, incl. the bf16
+activation-storage default for conv apps), runs one fused window under a
+profiler trace, and prints the per-op SELF-time breakdown plus the
+module-track device-busy total.
+
+Usage: BENCH_APP=inception python scripts/profile_app.py [nb] [epochs]
+Env: BENCH_BATCH (default 64), BENCH_ACT_DTYPE, PROF_TOP (default 25).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from bench import build_conv_app
+    from dlrm_flexflow_tpu.profiling import (device_fence,
+                                             parse_device_trace, trace)
+
+    app = os.environ.get("BENCH_APP", "inception")
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+    nb = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    # one construction path with bench_app (same config mutations, same
+    # per-app activation-dtype default, same data) so per-op
+    # attributions always correspond to anchored bench entries
+    model, inputs, labels = build_conv_app(app, batch, nb)
+    state = model.init(seed=0)
+    inputs, labels = model.place_dataset(inputs, labels)
+
+    def window(st):
+        st, _ = model.train_epochs(st, inputs, labels, epochs)
+        return st
+
+    state = window(state)  # compile
+    device_fence(state.step)
+    t0 = time.perf_counter()
+    state = window(state)
+    device_fence(state.step)
+    dt = time.perf_counter() - t0
+    steps = nb * epochs
+    print(f"# fused window (untraced): {dt*1e3:.1f} ms, {steps} steps -> "
+          f"{dt/steps*1e6:.1f} us/step, {steps*batch/dt:,.0f} samples/s")
+
+    logdir = os.environ.get("PROF_LOGDIR", "/tmp/ff_trace_app")
+    with trace(logdir):
+        state = window(state)
+        device_fence(state.step)
+    path, _pnames, tot, busy_ms = parse_device_trace(logdir)
+    print(f"# trace: {path}")
+    print(f"# device busy (module track): {busy_ms:.1f} ms = "
+          f"{busy_ms*1e3/steps:.1f} us/step -> "
+          f"{steps*batch/(busy_ms/1e3):,.0f} samples/s busy-equivalent")
+    total = sum(tot.values())
+    top = int(os.environ.get("PROF_TOP", 25))
+    for name, dur in sorted(tot.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{dur/1e3:10.2f} ms  {dur/total*100:5.1f}%  "
+              f"{dur/steps:8.1f} us/step  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
