@@ -314,6 +314,8 @@ def build_conv_app(app: str, batch: int, nb: int,
     import jax
     import dlrm_flexflow_tpu as ff
 
+    if app not in CONV_APPS:
+        raise ValueError(f"not a conv app: {app!r}")
     if dtype is None:
         dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     rng = np.random.default_rng(0)
@@ -362,12 +364,18 @@ def bench_app(app: str):
     epochs = int(os.environ.get("BENCH_EPOCHS", 2))
     reps = int(os.environ.get("BENCH_REPS", 3))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    rng = np.random.default_rng(0)
-    fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
-    mesh = False if jax.device_count() == 1 else None
-
     if app in CONV_APPS:
+        # build_conv_app owns the conv-app config/rng/mesh (shared with
+        # scripts/profile_app.py) — nothing else is constructed here so
+        # the two paths cannot drift
         model, inputs, labels = build_conv_app(app, batch, nb, dtype)
+        rng = fc = mesh = None
+    else:
+        rng = np.random.default_rng(0)
+        fc = ff.FFConfig(batch_size=batch, compute_dtype=dtype)
+        mesh = False if jax.device_count() == 1 else None
+    if app in CONV_APPS:
+        pass
     elif app == "nmt":
         # "NMT LSTM seq2seq (nmt/), attribute-parallel RNN layers" at the
         # REFERENCE scale (nmt/nmt.cc:36-50: vocab 20480, embed/hidden

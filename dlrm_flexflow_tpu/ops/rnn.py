@@ -15,6 +15,8 @@ the framework's per-op ParallelConfig on the time dimension.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -87,9 +89,14 @@ class LSTM(Op):
 
         from .base import matmul
 
-        # hoist the input projection out of the scan: one big (B*T, I)x(I,4H)
-        # MXU matmul instead of T small ones
-        x_proj = matmul(x, wx, self.compute_dtype) + bias
+        # hoist the input projection out of the scan: one big (T*B, I)x(I,4H)
+        # MXU matmul instead of T small ones.  Transpose to time-major
+        # BEFORE the matmul so the scan's xs array is produced in the
+        # layout its per-timestep slices want (round-4 NMT trace: the
+        # (B,T,4H)-produced array got a B-inner physical layout and the
+        # in-scan slices paid a strided read + relayout per timestep).
+        xt = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+        x_proj = matmul(xt, wx, self.compute_dtype) + bias
 
         if self.compute_dtype in ("bfloat16", jnp.bfloat16):
             wh = wh.astype(jnp.bfloat16)  # cast once, outside the scan
@@ -116,8 +123,18 @@ class LSTM(Op):
         else:
             h0 = jnp.zeros((b, h_dim), jnp.float32)
             c0 = jnp.zeros((b, h_dim), jnp.float32)
-        (h_f, c_f), hs = jax.lax.scan(step, (h0, c0),
-                                      jnp.swapaxes(x_proj, 0, 1))  # (T, B, H)
+        # FF_LSTM_UNROLL batches the per-timestep xs dynamic-slices (11%
+        # of NMT device time at the reference scale, round-4 trace).
+        # MEASURED NEGATIVE at that scale: unroll 4 -> 1212 ms busy,
+        # 8 -> 1373 vs 1102 at no unroll (the unrolled body breaks the
+        # hh weight-grad accumulation fusions, which outweighs the slice
+        # saving) — default stays 1, knob kept for other shapes.
+        t_len = x_proj.shape[0]
+        unroll = int(os.environ.get("FF_LSTM_UNROLL", 1))
+        if unroll <= 1 or t_len % unroll:
+            unroll = 1
+        (h_f, c_f), hs = jax.lax.scan(step, (h0, c0), x_proj,  # (T,B,H)
+                                      unroll=unroll)
         hs = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
         if self.reverse:
             hs = jnp.flip(hs, axis=1)

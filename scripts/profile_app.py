@@ -1,10 +1,11 @@
 """Attribute a BENCH_APP config's device time by HLO op.
 
-The conv-app twin of ``profile_headline.py``: builds the app exactly as
-``bench.bench_app`` does (same config mutations, incl. the bf16
-activation-storage default for conv apps), runs one fused window under a
-profiler trace, and prints the per-op SELF-time breakdown plus the
-module-track device-busy total.
+The conv-app twin of ``profile_headline.py``: builds the app through
+``bench.build_conv_app`` — the SAME construction bench_app anchors
+(same config mutations, incl. the per-app activation-storage defaults
+from ``bench.CONV_APPS``) — runs one fused window under a profiler
+trace, and prints the per-op SELF-time breakdown plus the module-track
+device-busy total.
 
 Usage: BENCH_APP=inception python scripts/profile_app.py [nb] [epochs]
 Env: BENCH_BATCH (default 64), BENCH_ACT_DTYPE, PROF_TOP (default 25).
