@@ -99,6 +99,21 @@ class FFConfig:
     # active); "on" forces it on any backend (tests); "off" restores
     # logical-row transport.
     epoch_cache_view: str = "auto"
+    # First-touch-SEGMENTED epoch slot assignment ("auto"|"on"|"off"):
+    # with an engaged ladder top level and packed table storage, each
+    # distinct row's epoch-cache slot lives in the segment of the first
+    # scan block that touches it, so the top level's block fetch and
+    # writeback stream their own-segment rows (dynamic_slice/
+    # dynamic_update_slice) instead of random-gathering them, plus a
+    # B=m/4-prefix scatter for reused rows; blocks whose reuse exceeds
+    # the budget fall back to the full gather/scatter per block
+    # (lax.cond — heavy-reuse ids land there).  Value-identical at the
+    # table level (tests).  "auto" == "off": measured NEGATIVE on the
+    # headline (PERF.md round 4 — when epoch draws ~= table rows, later
+    # blocks reuse ~60% of their rows from earlier blocks, so the
+    # fallback dominates while paying the branch overhead); "on" opts
+    # in for genuinely low-reuse regimes (epoch draws << rows).
+    epoch_cache_segmented: str = "auto"
     # Physical embedding-table storage ("auto"|"on"|"off").  "auto"/"on"
     # store d<128 tables lane-PACKED as (R/pack, 128) arrays end-to-end
     # (pack = 128/d): the logical (R, d) form's T(8,128) tiling pads

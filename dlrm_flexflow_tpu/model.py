@@ -593,6 +593,20 @@ class FFModel:
         # checks above) — cache_prologue only runs when the epoch
         # row-cache is active, which would let a typo pass silently
         _validated_epoch_cache_view(self.config)
+        _seg_mode = getattr(self.config, "epoch_cache_segmented", "auto")
+        if _seg_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"epoch_cache_segmented must be 'auto'|'on'|'off', "
+                f"got {_seg_mode!r}")
+        # auto == OFF: measured NEGATIVE on the headline (307 vs 243.5
+        # ms busy, PERF.md round 4) — at uniform epoch-draws ~= table
+        # rows, later blocks reuse ~60% of their rows from ANY earlier
+        # block, so most blocks take the fallback branch while paying
+        # the cond's broken carry aliasing + the segmented prologue
+        # sorts.  "on" remains for genuinely low-reuse regimes
+        # (epoch draws << rows), pinned bit-exact by
+        # TestSegmentedEpochSlots.
+        seg_enabled = _seg_mode == "on"
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -978,7 +992,7 @@ class FFModel:
                                 mode="clip").reshape(-1, fl.shape[1])
             return jnp.take(fl, rowof, axis=0, mode="clip")
 
-        def build_cache(flat, ids, pack, view_ok, storage=1):
+        def build_cache(flat, ids, pack, view_ok, storage=1, seg_blocks=1):
             """Shared-slot cache of the rows ``ids`` touches in the
             (R, d) source ``flat``: (cache, slots, rowof, pack_used) or
             None when the cache would not be smaller than the source.
@@ -1007,20 +1021,34 @@ class FFModel:
                 # packed STORAGE: flat already is the (Rv, 128) view and
                 # rowof addresses its view rows directly — the epoch
                 # cache is packed too, so every later fetch/writeback is
-                # a plain whole-row take/set (wpack=1)
+                # a plain whole-row take/set (wpack=1).  With an engaged
+                # ladder top level, slots are FIRST-TOUCH SEGMENTED
+                # (ops/slotting.py) so the top level's block fetch and
+                # writeback stream their own-segment rows instead of
+                # random-gathering them (PERF.md round 4).
                 if size >= flat.shape[0]:
                     return None
-                rowof_v, vslots = slot_rows(ids // storage, sentinel)
+                seg = seg_blocks > 1 and size % seg_blocks == 0
+                if seg:
+                    from .ops.slotting import slot_rows_segmented
+                    rowof_v, vslots = slot_rows_segmented(
+                        ids // storage, sentinel, seg_blocks)
+                else:
+                    rowof_v, vslots = slot_rows(ids // storage, sentinel)
                 slots = vslots * storage + (ids % storage).astype(
                     jnp.int32)
-                return _cache_fetch(flat, rowof_v), slots, rowof_v, 1
+                # a SEGMENTED rowof is NOT non-decreasing (segments
+                # interleave rows and sentinels) — the epilogue's
+                # scatter must not carry the sorted hint (review r4)
+                return (_cache_fetch(flat, rowof_v), slots, rowof_v, 1,
+                        not seg)
             if (view_ok and pack > 1 and flat.shape[0] % pack == 0
                     and size < flat.shape[0] // pack):
                 vrows = flat.shape[0] // pack
                 rowof_v, vslots = slot_rows(ids // pack, vrows)
                 slots = vslots * pack + (ids % pack).astype(jnp.int32)
-                return _cache_fetch(flat, rowof_v, pack), slots, \
-                    rowof_v, pack
+                return (_cache_fetch(flat, rowof_v, pack), slots,
+                        rowof_v, pack, True)
             # pad to the lane-pack multiple so the packed view
             # applies to the cache too
             m = -(-size // pack) * pack
@@ -1030,7 +1058,7 @@ class FFModel:
             if m > size:
                 rowof = jnp.concatenate(
                     [rowof, jnp.full((m - size,), sentinel, rowof.dtype)])
-            return _cache_fetch(flat, rowof), slots, rowof, 1
+            return _cache_fetch(flat, rowof), slots, rowof, 1, True
 
         from .ops.pallas_scatter import lane_pack
         op_pack = {op.name: lane_pack(op.param_specs()[0].shape[-1])
@@ -1039,27 +1067,83 @@ class FFModel:
         # caches in VIEW-row units at every ladder level (see build_cache)
         op_storage = {op.name: op.storage_pack for op in sparse_emb}
 
-        def _cache_writeback(parent, rowof, cache_final, pack=1):
+        def _cache_writeback(parent, rowof, cache_final, pack=1,
+                             sorted_rowof=True):
             """THE cache writeback all levels share: live rows set once,
             sentinel holes dropped — param and optimizer-slot tables
             must stay bit-identical in this formulation for the
             hierarchy's exactness claim.  ``pack > 1``: rowof addresses
             view rows (see _cache_fetch).  ``rowof`` is non-decreasing
-            by construction (ops/slotting.py compacts distinct rows to
-            the front, sentinel pads at the end), so the scatter carries
+            by construction for every DENSE-RANK slot plan
+            (ops/slotting.py compacts distinct rows to the front,
+            sentinel pads at the end), so the scatter carries
             indices_are_sorted — measured 3.8x on the mid-level
-            writeback shape (PERF.md round 3 continuation)."""
+            writeback shape (PERF.md round 3 continuation).  Callers
+            whose rowof is NOT sorted (the first-touch-SEGMENTED epoch
+            plan interleaves segments and sentinels) MUST pass
+            ``sorted_rowof=False`` — lying to the scatter emitter is
+            implementation-defined on TPU (review r4)."""
             fl = parent.reshape(-1, parent.shape[-1])
             if pack > 1:
                 view = fl.reshape(fl.shape[0] // pack,
                                   fl.shape[1] * pack)
                 out = view.at[rowof].set(
                     cache_final.reshape(-1, fl.shape[1] * pack),
-                    mode="drop", indices_are_sorted=True)
+                    mode="drop", indices_are_sorted=sorted_rowof)
                 return out.reshape(parent.shape)
             return fl.at[rowof].set(
                 cache_final, mode="drop",
-                indices_are_sorted=True).reshape(parent.shape)
+                indices_are_sorted=sorted_rowof).reshape(parent.shape)
+
+        def _seg_fetch(parent, rowof, k, P, m):
+            """Top-level block fetch against FIRST-TOUCH-SEGMENTED epoch
+            slots (ops/slotting.py): the block's OWN rows live
+            contiguously at epoch slots [k*m, k*m+n_new) and land at
+            cache positions [P, P+n_new) (P = reused count, sorted
+            order puts reused slots first) — one streaming
+            dynamic_slice + roll, plus a static B-prefix gather for the
+            reused rows.  Falls back to the full gather when the block
+            reuses more than the B budget (P > B) — e.g. Zipf-skewed
+            ids, where most rows repeat earlier blocks.  Value-identical
+            to the full gather at every LIVE position; sentinel
+            positions may hold different garbage (nothing addresses
+            them — pinned by the equivalence suites at table level)."""
+            d = parent.shape[-1]
+            B = max(m // 4, 1)
+
+            def contig(_):
+                seg = jax.lax.dynamic_slice(parent, (k * m, 0), (m, d))
+                rolled = jnp.roll(seg, P, axis=0)
+                front = jnp.take(parent, rowof[:B], axis=0, mode="clip")
+                return jax.lax.dynamic_update_slice(rolled, front, (0, 0))
+
+            def full(_):
+                return jnp.take(parent, rowof, axis=0, mode="clip")
+
+            return jax.lax.cond(P <= B, contig, full, None)
+
+        def _seg_writeback(parent, rowof, child, k, P, m):
+            """Writeback twin of ``_seg_fetch``: stream the whole block
+            cache into the op's own segment (padding rows land in
+            segment padding slots, which no slot addresses and the
+            epilogue drops), then scatter-set the static B-prefix (the
+            reused rows; own-slot entries in the prefix rewrite the
+            value the slice just wrote — idempotent)."""
+            fl = parent.reshape(-1, parent.shape[-1])
+            B = max(m // 4, 1)
+
+            def contig(p):
+                segw = jnp.roll(child, -P, axis=0)
+                p = jax.lax.dynamic_update_slice(p, segw, (k * m, 0))
+                return p.at[rowof[:B]].set(child[:B], mode="drop",
+                                           indices_are_sorted=True)
+
+            def full(p):
+                return p.at[rowof].set(child, mode="drop",
+                                       indices_are_sorted=True)
+
+            return jax.lax.cond(P <= B, contig, full, fl).reshape(
+                parent.shape)
 
         def _swap_opt_entry(opt_state, sn, name, arr):
             """Rebuild opt_state with slot tree ``sn``'s entry for
@@ -1106,16 +1190,19 @@ class FFModel:
                 flat = tb.reshape(-1, tb.shape[-1])
                 built = build_cache(flat, op.flat_ids(ids),
                                     op_pack[op.name], view_ok,
-                                    storage=op.storage_pack)
+                                    storage=op.storage_pack,
+                                    seg_blocks=_seg_blocks_for(
+                                        ids.shape[0]))
                 if built is None:
                     # cache would be as big as the table — no win; keep
                     # this op on the direct per-step path
                     continue
-                cache, slots, rowof, wpack = built
+                cache, slots, rowof, wpack, sorted_ok = built
                 originals[op.name] = tb
                 params[op.name] = {"embedding": cache}
                 slots_ep[op.name] = slots
-                writebacks.append((op.name, tb.shape, rowof, wpack))
+                writebacks.append((op.name, tb.shape, rowof, wpack,
+                                   sorted_ok))
                 if lazy_slots:
                     for sn in lazy_slots:
                         originals[(sn, op.name)] = (
@@ -1185,6 +1272,21 @@ class FFModel:
                 return [chunk]
             return []
 
+        def _seg_blocks_for(nb):
+            """K for first-touch-segmented epoch slots: the top ladder
+            level's block count, or 1 when no level engages (then
+            nothing exploits segmentation, so plain dense-rank slotting
+            keeps the prologue cheapest)."""
+            if not seg_enabled:
+                return 1
+            sizes = ladder_sizes(nb)
+            if not sizes:
+                return 1
+            top = sizes[0]
+            if 0 < top < nb and nb % top == 0:
+                return nb // top
+            return 1
+
         def ladder_meta(nb, slots_ep, rows0):
             """Static ladder plan [(size, {op: cache rows}), ...]: at
             each level every op whose padded block cache would be
@@ -1214,13 +1316,17 @@ class FFModel:
                     cur = size
             return meta
 
-        def ladder_arrays(slots, meta, rows):
+        def ladder_arrays(slots, meta, rows, top=True):
             """The ladder's slot plans, precomputed OUTSIDE the scans
             (the slot math — ops/slotting.py sorts — depends only on the
             epoch's ids, so under ``train_epochs`` it runs once for ALL
             fused epochs).  Returns a nested pytree consumed as scan xs:
             each level {"rowof": {op: (nblk, m)}, "next": ...}; the leaf
-            carries the per-step slots into each op's innermost cache."""
+            carries the per-step slots into each op's innermost cache.
+            At the TOP level, ops with first-touch-segmented epoch slots
+            also get {"segP": {op: (nblk,)}, "segk": (nblk,)} — the
+            per-block reused-row count and block index the segmented
+            fetch/writeback consume."""
             if not meta:
                 return {"slots": slots}
             from .ops.slotting import slot_rows
@@ -1253,9 +1359,26 @@ class FFModel:
                         slots_d[name] = b
                 return {"rowof": rowof_d,
                         "next": ladder_arrays(slots_d, rest,
-                                              {**rows, **part})}
+                                              {**rows, **part},
+                                              top=False)}
 
-            return jax.vmap(per_block)(blks)
+            arrs = jax.vmap(per_block)(blks)
+            if top and nblk > 1:
+                segP = {}
+                for name in part:
+                    n_occ = int(np.prod(slots[name].shape))
+                    if (op_storage[name] > 1
+                            and nblk == _seg_blocks_for(nb)
+                            and part[name] * nblk == n_occ):
+                        ro = arrs["rowof"][name]  # (nblk, m)
+                        base = (jnp.arange(nblk, dtype=jnp.int32)
+                                * part[name])
+                        segP[name] = jax.vmap(
+                            lambda r, b: jnp.searchsorted(r, b))(ro, base)
+                if segP:
+                    arrs["segP"] = segP
+                    arrs["segk"] = jnp.arange(nblk, dtype=jnp.int32)
+            return arrs
 
         def step_body(st, batch):
             """The innermost scan body, shared by the flat epoch scan
@@ -1284,37 +1407,50 @@ class FFModel:
 
             def outer(st, xs_k):
                 in_k, lab_k, a_k = xs_k
+                seg_ps = a_k.get("segP", {})
+                seg_k = a_k.get("segk")
                 params2 = dict(st.params)
                 opt2 = st.opt_state
                 wb, slot_wb = [], []
                 for name in part:
                     parent = st.params[name]["embedding"]
                     rowof = a_k["rowof"][name]
-                    params2[name] = {"embedding": _cache_fetch(parent,
-                                                               rowof)}
-                    wb.append((name, rowof, parent))
+                    seg = ((seg_k, seg_ps[name], part[name])
+                           if name in seg_ps else None)
+
+                    def _fetch(fl, r=rowof, s=seg):
+                        if s is None:
+                            return _cache_fetch(fl, r)
+                        return _seg_fetch(fl.reshape(-1, fl.shape[-1]),
+                                          r, s[0], s[1], s[2])
+
+                    def _wback(p, r, child, s=seg):
+                        if s is None:
+                            return _cache_writeback(p, r, child)
+                        return _seg_writeback(p, r, child,
+                                              s[0], s[1], s[2])
+
+                    params2[name] = {"embedding": _fetch(parent)}
+                    wb.append((name, rowof, parent, _wback))
                     if lazy_slots:
                         for sn in lazy_slots:
                             slot_wb.append(
                                 (sn, name, rowof,
-                                 opt2[sn][name]["embedding"]))
-                        opt2 = _swap_slot_caches(
-                            opt2, name,
-                            lambda fl, r=rowof: _cache_fetch(fl, r))
+                                 opt2[sn][name]["embedding"], _wback))
+                        opt2 = _swap_slot_caches(opt2, name, _fetch)
                 st2 = TrainState(params2, opt2, st.bn_state,
                                  st.rng, st.step)
                 st2, mets_k = ladder_scan(st2, in_k, lab_k, rest,
                                           a_k["next"])
                 new_p = dict(st2.params)
                 opt3 = st2.opt_state
-                for name, rowof, parent in wb:
-                    new_p[name] = {"embedding": _cache_writeback(
+                for name, rowof, parent, _wback in wb:
+                    new_p[name] = {"embedding": _wback(
                         parent, rowof, st2.params[name]["embedding"])}
-                for sn, name, rowof, parent in slot_wb:
+                for sn, name, rowof, parent, _wback in slot_wb:
                     final = st2.opt_state[sn][name]["embedding"]
                     opt3 = _swap_opt_entry(
-                        opt3, sn, name,
-                        _cache_writeback(parent, rowof, final))
+                        opt3, sn, name, _wback(parent, rowof, final))
                 st3 = TrainState(new_p, opt3, st2.bn_state,
                                  st2.rng, st2.step)
                 return st3, mets_k
@@ -1356,17 +1492,18 @@ class FFModel:
                 return state
             new_params = dict(state.params)
             opt_state = state.opt_state
-            for name, tb_shape, rowof, wpack in writebacks:
+            for name, tb_shape, rowof, wpack, sorted_ok in writebacks:
                 new_params[name] = {"embedding": _cache_writeback(
                     originals[name], rowof,
-                    state.params[name]["embedding"], wpack)}
+                    state.params[name]["embedding"], wpack,
+                    sorted_rowof=sorted_ok)}
                 for sn in lazy_slots:
                     opt_state = _swap_opt_entry(
                         opt_state, sn, name,
                         _cache_writeback(
                             originals[(sn, name)], rowof,
                             state.opt_state[sn][name]["embedding"],
-                            wpack))
+                            wpack, sorted_rowof=sorted_ok))
             return TrainState(new_params, opt_state,
                               state.bn_state, state.rng, state.step)
 
