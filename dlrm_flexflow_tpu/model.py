@@ -1778,7 +1778,6 @@ class FFModel:
                      else [int(s) for s in levels])
             if any(0 < s < nb and nb % s == 0 for s in sizes):
                 return None
-        inner = int(getattr(self.config, "epoch_cache_inner", 8))
         if inner > 1 and chunk > inner:
             # work in whole inner blocks so every main chunk keeps the
             # in-graph L0 level; a sub-block remainder becomes one tiny

@@ -130,7 +130,10 @@ class LSTM(Op):
         # hh weight-grad accumulation fusions, which outweighs the slice
         # saving) — default stays 1, knob kept for other shapes.
         t_len = x_proj.shape[0]
-        unroll = int(os.environ.get("FF_LSTM_UNROLL", 1))
+        try:
+            unroll = int(os.environ.get("FF_LSTM_UNROLL", 1))
+        except ValueError:
+            unroll = 1  # malformed value: documented default
         if unroll <= 1 or t_len % unroll:
             unroll = 1
         (h_f, c_f), hs = jax.lax.scan(step, (h0, c0), x_proj,  # (T,B,H)

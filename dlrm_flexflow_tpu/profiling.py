@@ -118,10 +118,14 @@ def parse_device_trace(logdir: str):
                 if pt[0] in dev_pids and "XLA Modules" in n}
 
     def _slices(keep_tids):
+        # keep_tids=None disables the filter; an EMPTY set filters
+        # everything out (a trace with named threads but no Modules
+        # track must NOT fall back to raw-summing nested slices — that
+        # is the exact double-counting this function exists to avoid)
         for e in events:
             if (e.get("ph") == "X"
                     and e.get("pid") in dev_pids
-                    and (not keep_tids
+                    and (keep_tids is None
                          or (e["pid"], e.get("tid")) in keep_tids)):
                 yield e
 
@@ -131,7 +135,16 @@ def parse_device_trace(logdir: str):
     # children it contains; a stack tracks open slices per track
     tot = {}
     by_tid = {}
-    for e in _slices(op_tids):
+    # Per-op slices come from the Ops track; a trace without one but
+    # WITH a Modules track attributes at module granularity instead.
+    # Take-all is safe only when the device pids carry NO thread-name
+    # metadata at all — with named-but-unrecognized tracks (e.g.
+    # "Steps" mirrors the same wall time) summing across tracks would
+    # double-count, so let the empty filter raise the informative
+    # error below instead.
+    dev_named = any(pt[0] in dev_pids for pt in tnames)
+    op_keep = op_tids or mod_tids or (set() if dev_named else None)
+    for e in _slices(op_keep):
         by_tid.setdefault((e["pid"], e.get("tid")), []).append(e)
     for track in by_tid.values():
         track.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))

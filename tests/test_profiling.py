@@ -1,0 +1,119 @@
+"""Unit tests for profiling.parse_device_trace on synthetic traces.
+
+The device trace's "XLA Ops" track NESTS (a scan's `while` slice spans
+the ops of its body), so raw-summing slice durations overcounts; busy
+time comes from the "XLA Modules" track, per-op time is SELF time.
+These tests pin that accounting — including the advisor's round-4
+finding that a trace WITH thread-name metadata but WITHOUT a Modules
+track must fall back to the self-time sum rather than raw-summing
+nested slices (reference analogue: per-op cudaEvent timing,
+src/ops/linear.cu:499-531 never double-counts nested kernels).
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from dlrm_flexflow_tpu.profiling import parse_device_trace
+
+
+def _write_trace(tmpdir, events):
+    path = os.path.join(tmpdir, "t.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _meta(pid, name, tid=None, tname=None):
+    out = [{"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}]
+    if tid is not None:
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name", "args": {"name": tname}})
+    return out
+
+
+def _slice(pid, tid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": ts, "dur": dur}
+
+
+class TestParseDeviceTrace:
+    def test_modules_track_is_busy_ops_are_self_times(self, tmp_path):
+        # device pid 1: Modules track (tid 10) + Ops track (tid 20)
+        # with a nesting while(0..100) containing fusion(10..40) and
+        # fusion(50..90): raw ops sum = 100+30+40 = 170 us, but busy
+        # must be the module total (100) and per-op SELF times
+        # while=30, fusion=70.
+        ev = (_meta(1, "/device:TPU:0", 10, "XLA Modules")
+              + _meta(1, "/device:TPU:0", 20, "XLA Ops")
+              + [_slice(1, 10, "jit_step", 0, 100),
+                 _slice(1, 20, "while", 0, 100),
+                 _slice(1, 20, "fusion", 10, 30),
+                 _slice(1, 20, "fusion", 50, 40)])
+        _write_trace(tmp_path, ev)
+        _p, _pn, tot, busy_ms = parse_device_trace(str(tmp_path))
+        assert busy_ms == pytest.approx(0.100)
+        assert tot["fusion"] == pytest.approx(70.0)
+        assert tot["while"] == pytest.approx(30.0)
+
+    def test_no_modules_track_falls_back_to_self_time_sum(self, tmp_path):
+        # Thread-name metadata present, but NO "XLA Modules" thread:
+        # busy must be the SELF-time sum (100 us), not the raw nested
+        # sum (170 us) — the advisor-flagged double-count.
+        ev = (_meta(1, "/device:TPU:0", 20, "XLA Ops")
+              + [_slice(1, 20, "while", 0, 100),
+                 _slice(1, 20, "fusion", 10, 30),
+                 _slice(1, 20, "fusion", 50, 40)])
+        _write_trace(tmp_path, ev)
+        _p, _pn, tot, busy_ms = parse_device_trace(str(tmp_path))
+        assert busy_ms == pytest.approx(0.100)
+        assert tot["fusion"] == pytest.approx(70.0)
+
+    def test_no_thread_names_at_all_uses_all_device_slices(self, tmp_path):
+        # No thread metadata: every device slice is an op slice
+        # (non-nested here), busy = self-time sum.
+        ev = (_meta(1, "/device:TPU:0")
+              + [_slice(1, 20, "fusion", 0, 30),
+                 _slice(1, 20, "copy", 40, 20)])
+        _write_trace(tmp_path, ev)
+        _p, _pn, tot, busy_ms = parse_device_trace(str(tmp_path))
+        assert busy_ms == pytest.approx(0.050)
+        assert tot == {"fusion": pytest.approx(30.0),
+                       "copy": pytest.approx(20.0)}
+
+    def test_modules_only_attributes_at_module_granularity(self, tmp_path):
+        # Named Modules track but no Ops track: busy AND per-op totals
+        # both come from the module slices (no double-count).
+        ev = (_meta(1, "/device:TPU:0", 10, "XLA Modules")
+              + [_slice(1, 10, "jit_step", 0, 100)])
+        _write_trace(tmp_path, ev)
+        _p, _pn, tot, busy_ms = parse_device_trace(str(tmp_path))
+        assert busy_ms == pytest.approx(0.100)
+        assert tot == {"jit_step": pytest.approx(100.0)}
+
+    def test_named_but_unrecognized_tracks_raise(self, tmp_path):
+        # Thread names exist but neither Ops nor Modules: tracks like
+        # "Steps" mirror the same wall time, so summing across them
+        # would double-count — the parser must refuse, not guess.
+        ev = (_meta(1, "/device:TPU:0", 30, "Steps")
+              + _meta(1, "/device:TPU:0", 40, "TensorFlow Name Scope")
+              + [_slice(1, 30, "step0", 0, 100),
+                 _slice(1, 40, "scope", 0, 100)])
+        _write_trace(tmp_path, ev)
+        with pytest.raises(ValueError):
+            parse_device_trace(str(tmp_path))
+
+    def test_host_slices_excluded(self, tmp_path):
+        ev = (_meta(1, "/device:TPU:0", 10, "XLA Modules")
+              + _meta(1, "/device:TPU:0", 20, "XLA Ops")
+              + _meta(2, "host threads", 5, "python")
+              + [_slice(1, 10, "jit_step", 0, 50),
+                 _slice(1, 20, "fusion", 0, 50),
+                 _slice(2, 5, "hostwork", 0, 1000)])
+        _write_trace(tmp_path, ev)
+        _p, _pn, tot, busy_ms = parse_device_trace(str(tmp_path))
+        assert busy_ms == pytest.approx(0.050)
+        assert "hostwork" not in tot
