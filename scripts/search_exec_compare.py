@@ -11,8 +11,10 @@ mesh, and prints fenced per-step wall times.
 
 Usage:
   python scripts/search_exec_compare.py [app] [budget] [batch] [steps]
-    app: inception (default) | mlp
-Env: FF_SEARCH_SEED (default 0).
+    app: inception (default) | mlp | dlrm
+Env: FF_SEARCH_SEED (default 0), FF_DLRM_ROWS (rows per table for
+app=dlrm, default 100000 — the sim's north-star claim is shape-stable,
+see PERF.md; execution uses a CPU-mesh-sized table).
 """
 
 import os
@@ -54,6 +56,31 @@ def build(app, batch, strategy, mesh):
             (batch, 3, side, side)).astype(np.float32)}
         labels = np.random.default_rng(1).integers(
             0, 10, size=(batch, 1)).astype(np.int32)
+    elif app == "dlrm":
+        # The north-star graph (BASELINE.json: "DLRM under a
+        # SOAP-searched hybrid strategy", reference dlrm_strategy.cc:
+        # 242-296): stacked embedding + bottom/top MLP + cat
+        # interaction.  Table rows sized for CPU-mesh execution
+        # (FF_DLRM_ROWS); the searched-vs-DP RANKING is the claim under
+        # test, and the deciding term — DP's table-shaped grad
+        # all-reduce vs a sharded table — scales with table bytes in
+        # both worlds.
+        from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+        rows = int(os.environ.get("FF_DLRM_ROWS", 100_000))
+        cfg = DLRMConfig()
+        t = len(cfg.embedding_size)  # table count (default mlp_top fits it)
+        cfg.embedding_size = [rows] * t
+        model = build_dlrm(cfg, fc)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=mesh, strategy=strategy)
+        rng = np.random.default_rng(0)
+        inputs = {"dense": rng.standard_normal(
+                      (batch, cfg.mlp_bot[0])).astype(np.float32),
+                  "sparse": rng.integers(
+                      0, rows, size=(batch, t, cfg.embedding_bag_size),
+                      dtype=np.int64)}
+        labels = rng.integers(0, 2, size=(batch, 1)).astype(np.float32)
     elif app == "mlp":
         model = ff.FFModel(fc)
         x = model.create_tensor((batch, 512), name="x")
@@ -120,6 +147,27 @@ def project_strategy_to_mesh(strategy, mesh_axes, model):
     return out
 
 
+CANDIDATE_MESHES = ({"data": 8}, {"data": 4, "model": 2},
+                    {"data": 2, "model": 4}, {"model": 8})
+
+
+def best_projection(searched, sim, probe, verbose=False):
+    """Pick the candidate mesh whose PROJECTED searched strategy
+    simulates best (a mesh executes projections, not raw strategies).
+    Shared with tests/test_sim_ordering.py so script and regression
+    test always rank the same candidate set.
+    Returns (axes, projected_strategy, simulated_time)."""
+    best_axes, best_proj, t_proj = None, None, float("inf")
+    for axes in CANDIDATE_MESHES:
+        proj = project_strategy_to_mesh(searched, axes, probe)
+        t = sim.simulate(proj)
+        if verbose:
+            print(f"#   projected onto {axes}: sim {t*1e3:.3f} ms")
+        if t < t_proj:
+            best_axes, best_proj, t_proj = axes, proj, t
+    return best_axes, best_proj, t_proj
+
+
 def main():
     app = sys.argv[1] if len(sys.argv) > 1 else "inception"
     budget = int(sys.argv[2]) if len(sys.argv) > 2 else 300
@@ -153,15 +201,8 @@ def main():
     # the same programs both worlds see.
     w_dp = wall_per_step(*build(app, batch, dp, ff.make_mesh({"data": 8})),
                          steps=steps)
-    cands = [{"data": 8}, {"data": 4, "model": 2},
-             {"data": 2, "model": 4}, {"model": 8}]
-    best_axes, best_proj, t_proj = None, None, float("inf")
-    for axes in cands:
-        proj = project_strategy_to_mesh(searched, axes, probe)
-        t = sim.simulate(proj)
-        print(f"#   projected onto {axes}: sim {t*1e3:.3f} ms")
-        if t < t_proj:
-            best_axes, best_proj, t_proj = axes, proj, t
+    best_axes, best_proj, t_proj = best_projection(searched, sim, probe,
+                                                   verbose=True)
     w_se = wall_per_step(*build(app, batch, best_proj,
                                 ff.make_mesh(best_axes)), steps=steps)
     print(f"# executed: dp on data:8 {w_dp*1e3:.1f} ms/step; searched "
