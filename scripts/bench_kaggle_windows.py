@@ -41,12 +41,11 @@ def main(batch=64, nb=16, reps=3):
     for epochs in (2, 4, 8):
         # fresh state per config: the fused train_epochs donates it
         state = m.init(seed=0)
-        thpt, probe_us, busy_ms = _windows(m, state, inputs, labels, batch,
-                                           nb, epochs, reps)
+        thpt, probe_us, prov = _windows(m, state, inputs, labels, batch,
+                                        nb, epochs, reps)
         out.append({"epochs": epochs,
                     "samples_per_sec": round(thpt),
-                    "probe_us": round(probe_us, 1),
-                    "device_busy_ms": busy_ms})
+                    "probe_us": round(probe_us, 1), **prov})
     print(json.dumps({"windows": out}))
 
 
