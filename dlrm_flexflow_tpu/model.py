@@ -676,9 +676,11 @@ class FFModel:
         # scripts/profile_headline.py).  Round 4: also under a mesh for
         # ops whose table is REPLICATED (the DP configuration) — the
         # SPMD/logical fallback measured 2.82x device-busy on the real
-        # chip (1-device mesh A/B, PERF.md).  Model-axis TABLE-PARALLEL
-        # ops keep logical storage: the sharded dim is the logical row,
-        # and the manual exchange paths address logical rows.
+        # chip (1-device mesh A/B, PERF.md).  Round 5: also for
+        # model-axis TABLE-parallel ops whose sharded logical dim is the
+        # row/table dim (see _storage_ok_under_mesh); only the manual
+        # exchange paths (excluded via _device_table_op) and
+        # feature-sharded single Embeddings keep logical storage.
         packed_mode = getattr(self.config, "packed_tables", "auto")
         if packed_mode not in ("auto", "on", "off"):
             raise ValueError(
@@ -688,13 +690,34 @@ class FFModel:
                       or (packed_mode == "auto" and backend == "tpu"))
 
         def _storage_ok_under_mesh(op):
-            """Packed storage composes with a mesh only when the op's
-            table is replicated (DP): no sharded logical-row dim to
-            fight the (R/pack, 128) view."""
+            """Packed storage under a mesh (round 4: replicated/DP
+            tables; round 5 extends to model-axis TABLE-parallel ops):
+            the (R/pack, 128) view is a row-major bitcast, so when the
+            op's sharded LOGICAL dim is the row/table dim (sharded_dim
+            0 — Stacked/Ragged; the ragged TOTAL row space is padded
+            to a multiple of lane_pack(d)*8 exactly so this divides —
+            shard boundaries may split a ragged table, same as the
+            logical sharding), a
+            contiguous model-axis shard of VIEW rows holds the same
+            logical rows as the logical sharding — shard the view
+            instead and keep the packed fast path.  A feature-sharded
+            single Embedding (sharded_dim 1) folds d into the lanes and
+            cannot; it keeps logical storage."""
             if mesh_ is None:
                 return True
             pc = op.parallel_config
-            return not (pc is not None and any(d > 1 for d in pc.dims[1:]))
+            if not (pc is not None and any(d > 1 for d in pc.dims[1:])):
+                return True  # replicated (DP) — round 4
+            msize = mesh_.shape.get(MODEL_AXIS, 1)
+            if msize <= 1:
+                return True  # no model axis: nothing shards the table
+            spec = next((s for s in op.param_specs()
+                         if s.param_name == "embedding"), None)
+            pack = op.storage_eligible_pack()
+            if spec is None or spec.sharded_dim != 0 or pack <= 1:
+                return False
+            view_rows = int(np.prod(spec.shape[:-1])) // pack
+            return view_rows % msize == 0
 
         def _device_table_op(op):
             """THE per-op eligibility both packed storage and the
@@ -1604,9 +1627,13 @@ class FFModel:
             def _pspec(s):
                 if sp > 1 and s.param_name == "embedding":
                     # packed storage: the PHYSICAL param is the rank-2
-                    # (R/pack, 128) view, replicated (packed-under-mesh
-                    # is gated to non-table-parallel ops)
-                    return param_pspec(None, 2, self.mesh, False)
+                    # (R/pack, 128) view — model-axis table-parallel
+                    # ops shard its ROW dim (a contiguous view-row
+                    # shard holds exactly the logical shard's rows,
+                    # round 5; compile gates eligibility in
+                    # _storage_ok_under_mesh), DP ops replicate it
+                    return param_pspec(0 if tp else None, 2,
+                                       self.mesh, tp)
                 return param_pspec(s.sharded_dim, len(s.shape),
                                    self.mesh, tp)
 
