@@ -26,7 +26,6 @@ Usage: python scripts/ab_boundary.py [reps]
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -43,12 +42,14 @@ def main():
     R, n, d = 1_048_576, 131_072, 128
     rng = np.random.default_rng(0)
     rowof = np.sort(rng.choice(R, size=n, replace=False)).astype(np.int32)
-    cache_h = rng.standard_normal((R, d)).astype(np.float32)
-    l1_h = rng.standard_normal((n, d)).astype(np.float32)
+    # chain() does not donate, so the jit copies its inputs internally —
+    # one device placement serves every variant and timing run
+    cache_d = jax.device_put(
+        rng.standard_normal((R, d)).astype(np.float32))
+    l1_d = jax.device_put(rng.standard_normal((n, d)).astype(np.float32))
 
     def fresh():
-        # donation consumes the carry arrays: re-place per timing run
-        return jax.device_put(cache_h), jax.device_put(l1_h)
+        return cache_d, l1_d
     rowof_d = jax.device_put(rowof)
 
     def chain(body):
@@ -113,8 +114,15 @@ def main():
     timeit("ds(contiguous)", fresh, ds_body, row_bytes)
 
     # -- pallas per-row-DMA kernel: issue-rate curve -------------------
-    from dlrm_flexflow_tpu.ops.pallas_scatter import sparse_row_update
+    from dlrm_flexflow_tpu.ops.pallas_scatter import (
+        sparse_row_update, supports_pallas_row_update)
     for nk in (2048, 8192, 32768, 131072):
+        # force=True does not bypass the static eligibility gate — an
+        # inherited FF_SCATTER_BLOCK that doesn't divide nk would make
+        # sparse_row_update silently time the XLA fallback and label it
+        # kernel data (ab_scatter.py guards the same way)
+        assert supports_pallas_row_update(R, d, nk), (
+            f"FF_SCATTER_BLOCK must divide n={nk} for a real kernel A/B")
         ids_k = jax.device_put(np.sort(
             rng.choice(R, size=nk, replace=False)).astype(np.int32))
         upd_k = jax.device_put(
