@@ -122,6 +122,99 @@ class TestLSTMOp:
         np.testing.assert_allclose(out, expected.numpy(), atol=1e-5,
                                    rtol=1e-5)
 
+    def test_lstm_custom_vjp_grads_match_autodiff_and_torch(
+            self, monkeypatch):
+        """The hand-written LSTM backward (ops/rnn.py::_lstm_core —
+        no xs-cotangent zero broadcasts, dwh hoisted post-scan) must
+        produce the same gradients as jax autodiff of the same scan AND
+        as torch.nn.LSTM."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        b, t, i, h = 3, 5, 4, 6
+        x = rng.standard_normal((b, t, i)).astype(np.float32)
+        m = ff.FFModel(ff.FFConfig(batch_size=b))
+        xt = m.create_tensor((b, t, i), name="x")
+        m.lstm(xt, h, name="rnn")
+        m.compile(loss_type="mean_squared_error", metrics=(), mesh=False)
+        state = m.init(seed=0)
+        op = m.get_op("rnn")
+
+        def loss(params, xv):
+            out = op.forward(params, [xv])[0]
+            return jnp.sum(out * out)
+
+        grads = {}
+        for mode in ("1", "0"):
+            monkeypatch.setenv("FF_LSTM_CUSTOM_VJP", mode)
+            grads[mode] = jax.grad(loss)(state.params["rnn"],
+                                         jnp.asarray(x))
+        for k in grads["1"]:
+            np.testing.assert_allclose(
+                np.asarray(grads["1"][k]), np.asarray(grads["0"][k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
+
+        wx = m.get_weights(state, "rnn", "wx")
+        wh = m.get_weights(state, "rnn", "wh")
+        ref = torch.nn.LSTM(i, h, batch_first=True)
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.from_numpy(wx.T))
+            ref.weight_hh_l0.copy_(torch.from_numpy(wh.T))
+            ref.bias_ih_l0.zero_()
+            ref.bias_hh_l0.zero_()
+        xt_t = torch.from_numpy(x).requires_grad_(True)
+        out, _ = ref(xt_t)
+        (out * out).sum().backward()
+        np.testing.assert_allclose(np.asarray(grads["1"]["wh"]),
+                                   ref.weight_hh_l0.grad.numpy().T,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["1"]["wx"]),
+                                   ref.weight_ih_l0.grad.numpy().T,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_custom_vjp_bf16_and_state_cotangents(self, monkeypatch):
+        """The bf16 branch (wh cast outside the scan; dwh cast back)
+        and the dh0/dc0 cotangent outputs (exercised only via
+        initial_state chaining) must also match autodiff.  bf16
+        tolerance is loose: autodiff accumulated dwh in bf16 across
+        timesteps, the manual backward accumulates the one hoisted dot
+        in f32 — reassociation at bf16 precision."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        b, t, i, h = 2, 4, 3, 4
+        x = rng.standard_normal((b, t, i)).astype(np.float32)
+        for dtype, rtol, atol in ((None, 1e-4, 1e-5),
+                                  ("bfloat16", 3e-2, 3e-2)):
+            m = ff.FFModel(ff.FFConfig(batch_size=b, compute_dtype=dtype))
+            xt = m.create_tensor((b, t, i), name="x")
+            seq, hf, cf = m.lstm(xt, h, return_state=True, name="enc")
+            m.lstm(seq, h, initial_state=(hf, cf), name="dec")
+            m.compile(loss_type="mean_squared_error", metrics=(),
+                      mesh=False)
+            state = m.init(seed=0)
+            enc, dec = m.get_op("enc"), m.get_op("dec")
+
+            def loss(params, xv):
+                s, hfv, cfv = enc.forward(params["enc"], [xv])
+                out = dec.forward(params["dec"], [s, hfv, cfv])[0]
+                return jnp.sum(out * out)
+
+            grads = {}
+            for mode in ("1", "0"):
+                monkeypatch.setenv("FF_LSTM_CUSTOM_VJP", mode)
+                grads[mode] = jax.grad(loss)(state.params,
+                                             jnp.asarray(x))
+            for opn in grads["1"]:
+                for k in grads["1"][opn]:
+                    np.testing.assert_allclose(
+                        np.asarray(grads["1"][opn][k]),
+                        np.asarray(grads["0"][opn][k]),
+                        rtol=rtol, atol=atol,
+                        err_msg=f"{dtype}/{opn}/{k}")
+
     def test_lstm_state_handoff(self):
         b, t, i, h = 2, 3, 4, 4
         rng = np.random.default_rng(0)
