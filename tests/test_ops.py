@@ -228,6 +228,37 @@ class TestConvPool:
         ref = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
         np.testing.assert_allclose(out, ref, atol=ATOL, rtol=RTOL)
 
+    def test_maxpool_mask_backward_matches_sas_and_torch(self, rng):
+        """The equality-mask maxpool backward (ops/conv.py::_maxpool —
+        replaces select_and_scatter, 7.4% of Inception busy) must match
+        autodiff's select_and_scatter gradient on continuous data and
+        torch's max_pool2d gradient, across overlapping/strided/padded
+        window configs (reference pool_2d.cu:510 semantics)."""
+        import jax
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.conv import _maxpool, _maxpool_reduce
+
+        for (k, s, p, h, w) in [((3, 3), (2, 2), (0, 0), 13, 15),
+                                ((3, 3), (1, 1), (1, 1), 9, 9),
+                                ((2, 2), (2, 2), (0, 0), 8, 8)]:
+            x = rng.standard_normal((2, 3, h, w), dtype=np.float32)
+            xj = jnp.asarray(x)
+            gm = jax.grad(lambda v: jnp.sum(jnp.sin(
+                _maxpool(v, k, s, p))))(xj)
+            gs = jax.grad(lambda v: jnp.sum(jnp.sin(
+                _maxpool_reduce(v, k, s, p))))(xj)
+            np.testing.assert_allclose(np.asarray(gm), np.asarray(gs),
+                                       rtol=1e-6, atol=1e-6,
+                                       err_msg=str((k, s, p)))
+            xt = torch.from_numpy(x).requires_grad_(True)
+            yt = torch.nn.functional.max_pool2d(
+                xt, k, stride=s, padding=p)
+            torch.sin(yt).sum().backward()
+            np.testing.assert_allclose(np.asarray(gm),
+                                       xt.grad.numpy(),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=str((k, s, p)))
+
     def test_pool2d_avg_vs_torch(self, rng):
         x = rng.standard_normal((2, 3, 8, 8), dtype=np.float32)
         m, _ = one_op_model(
