@@ -114,6 +114,20 @@ class FFConfig:
     # fallback dominates while paying the branch overhead); "on" opts
     # in for genuinely low-reuse regimes (epoch draws << rows).
     epoch_cache_segmented: str = "auto"
+    # BLOCK-MAJOR epoch-cache regions ("auto"|"on"|"off"): lay the epoch
+    # cache out as one occurrence-sized region per ladder-top block and
+    # STREAM each block's writeback into its own region
+    # (dynamic_update_slice — measured 8.4x the scatter emitter's
+    # density-scaled RMW sweep at the boundary shape, ab_boundary.py);
+    # cross-block coherence moves into the fetch, a same-cost gather at
+    # prologue-computed circular-predecessor positions
+    # (ops/slotting.py::region_plan), and the epilogue gathers each
+    # row's last copy.  Bit-exact with shared-slot mode (tests).
+    # Engages for single-device packed-storage ops when the ladder top
+    # level divides the epoch and segmented slots are off.  "auto" = on
+    # (round-5 headline A/B: busy 243.5 -> 233.5 ms); "off" restores
+    # shared-slot mode.
+    epoch_cache_regions: str = "auto"
     # Physical embedding-table storage ("auto"|"on"|"off").  "auto"/"on"
     # store d<128 tables lane-PACKED as (R/pack, 128) arrays end-to-end
     # (pack = 128/d): the logical (R, d) form's T(8,128) tiling pads

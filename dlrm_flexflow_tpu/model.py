@@ -607,6 +607,11 @@ class FFModel:
         # (epoch draws << rows), pinned bit-exact by
         # TestSegmentedEpochSlots.
         seg_enabled = _seg_mode == "on"
+        # epoch_cache_regions "auto" resolution (see FFConfig): ON —
+        # round-5 headline A/B measured busy 243.5 -> 233.5 ms (the dus
+        # writeback saves 43 ms, the last-copy epilogue gather and plan
+        # sorts give back ~33), bit-exact incl. lazy Adam and Zipf ids
+        region_auto_on = True
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -1193,7 +1198,9 @@ class FFModel:
             and pull the touched rows in with one table sweep (plus, in
             lazy mode, the optimizer slot tables — same rowof, same
             slots).  Returns (state-with-caches, slots, writebacks,
-            originals)."""
+            originals, region_src); ``writebacks`` entries are
+            (name, tb_shape, rowof, wpack, sorted_ok, final_src) with
+            final_src None outside region mode."""
             from .ops.pallas_scatter import use_packed_view
             view_mode = _validated_epoch_cache_view(self.config)
             # "on" still requires no mesh (under SPMD the view fights
@@ -1207,10 +1214,30 @@ class FFModel:
             params = dict(state.params)
             opt_state = state.opt_state
             slots_ep, writebacks, originals = {}, [], {}
+            region_src = {}
             for op in (sparse_emb if epoch_cache else ()):
                 ids = inputs[id_name[op.name]].astype(jnp.int32)
                 tb = params[op.name]["embedding"]
                 flat = tb.reshape(-1, tb.shape[-1])
+                nb = ids.shape[0]
+                reg = _region_layout(op, flat, ids, nb)
+                if reg is not None:
+                    cache, slots, src, final_rowof, final_src, \
+                        rowof_all = reg
+                    originals[op.name] = tb
+                    params[op.name] = {"embedding": cache}
+                    slots_ep[op.name] = slots
+                    region_src[op.name] = src
+                    writebacks.append((op.name, tb.shape, final_rowof,
+                                       1, True, final_src))
+                    if lazy_slots:
+                        for sn in lazy_slots:
+                            originals[(sn, op.name)] = (
+                                opt_state[sn][op.name]["embedding"])
+                        opt_state = _swap_slot_caches(
+                            opt_state, op.name,
+                            lambda fl, r=rowof_all: _cache_fetch(fl, r))
+                    continue
                 built = build_cache(flat, op.flat_ids(ids),
                                     op_pack[op.name], view_ok,
                                     storage=op.storage_pack,
@@ -1225,7 +1252,7 @@ class FFModel:
                 params[op.name] = {"embedding": cache}
                 slots_ep[op.name] = slots
                 writebacks.append((op.name, tb.shape, rowof, wpack,
-                                   sorted_ok))
+                                   sorted_ok, None))
                 if lazy_slots:
                     for sn in lazy_slots:
                         originals[(sn, op.name)] = (
@@ -1236,7 +1263,63 @@ class FFModel:
                             fl, r, p))
             state = TrainState(params, opt_state, state.bn_state,
                                state.rng, state.step)
-            return state, slots_ep, writebacks, originals
+            return state, slots_ep, writebacks, originals, region_src
+
+        def _region_layout(op, flat, ids, nb):
+            """Block-major region layout for the epoch cache
+            (FFConfig.epoch_cache_regions; ops/slotting.py::region_plan
+            for the design), or None when it does not engage.  Returns
+            (cache, slots, src, final_rowof, final_src, rowof_all)."""
+            mode = getattr(self.config, "epoch_cache_regions", "off")
+            if mode not in ("auto", "on", "off"):
+                raise ValueError(
+                    f"epoch_cache_regions must be 'auto'|'on'|'off', "
+                    f"got {mode!r}")
+            if mode == "off" or (mode == "auto" and not region_auto_on):
+                return None
+            sp = op.storage_pack
+            if sp <= 1 or _seg_blocks_for(nb) > 1 or mesh_ is not None:
+                # packed-storage ops only; segmented owns the top level;
+                # under a mesh the region dus/gather would fight the
+                # SPMD-sharded cache layout (untested) — keep shared
+                # slots there
+                return None
+            sizes = ladder_sizes(nb)
+            top = sizes[0] if sizes else 0
+            if not (0 < top < nb and nb % top == 0):
+                return None
+            nblk = nb // top
+            if nblk <= 1:
+                return None
+            fv = op.flat_ids(ids)
+            n_occ = int(np.prod(fv.shape))
+            # the region cache holds n_occ PACKED view rows — compare
+            # against the table's packed rows (build_cache's guard),
+            # not the logical count (review r5)
+            if n_occ >= flat.shape[0]:  # cache not smaller: no win
+                return None
+            if mode == "auto" and n_occ < (1 << 18):
+                # the region plan's fixed costs (per-block sorts, the
+                # last-copy epilogue gather) beat the saved scatters
+                # only on big epochs: kaggle-shape A/B measured busy
+                # 4.275 -> 5.252 ms with regions at 26k occurrences,
+                # while the 1M-occurrence headline gains 10 ms
+                # (PERF.md round 5); "on" forces engagement for tests
+                return None
+            from .ops.slotting import region_plan, slot_rows
+            m_occ = n_occ // nblk
+            v = fv.reshape(nblk, m_occ)
+            sentinel = flat.shape[0]
+            rowof_blocks, vslots = jax.vmap(
+                lambda b: slot_rows(b // sp, sentinel))(v)
+            base = (jnp.arange(nblk, dtype=jnp.int32) * m_occ)[:, None]
+            slots = ((base + vslots) * sp
+                     + (v % sp).astype(jnp.int32)).reshape(fv.shape)
+            rowof_all = rowof_blocks.reshape(-1)
+            cache = _cache_fetch(flat, rowof_all)
+            src, final_rowof, final_src = region_plan(rowof_blocks,
+                                                      sentinel)
+            return cache, slots, src, final_rowof, final_src, rowof_all
 
         def ladder_sizes(nb):
             """Static block sizes of the in-graph cache ladder for an
@@ -1339,7 +1422,7 @@ class FFModel:
                     cur = size
             return meta
 
-        def ladder_arrays(slots, meta, rows, top=True):
+        def ladder_arrays(slots, meta, rows, top=True, region_src=None):
             """The ladder's slot plans, precomputed OUTSIDE the scans
             (the slot math — ops/slotting.py sorts — depends only on the
             epoch's ids, so under ``train_epochs`` it runs once for ALL
@@ -1358,8 +1441,14 @@ class FFModel:
             nblk = nb // size
             blks = {n: s.reshape((nblk, size) + s.shape[1:])
                     for n, s in slots.items()}
+            # block-major region ops (top level only): the fetch
+            # indices are the circular-predecessor src plan, and the
+            # writeback streams into the block's own region (outer()
+            # keys on "region_base")
+            srcs = {n: s for n, s in (region_src or {}).items()
+                    if top and n in part}
 
-            def per_block(blk):
+            def per_block(blk, src_blk):
                 rowof_d, slots_d = {}, {}
                 for name, b in blk.items():
                     if name in part:
@@ -1377,6 +1466,13 @@ class FFModel:
                             rowof = jnp.concatenate(
                                 [rowof, jnp.full((m - n,), rows[name],
                                                  rowof.dtype)])
+                        if name in src_blk:
+                            # region mode: fetch by src; block-local
+                            # slots (dense ranks of the region slots)
+                            # coincide with the region positions by
+                            # construction, so only the fetch indices
+                            # change
+                            rowof = src_blk[name]
                         rowof_d[name], slots_d[name] = rowof, s
                     else:
                         slots_d[name] = b
@@ -1385,7 +1481,11 @@ class FFModel:
                                               {**rows, **part},
                                               top=False)}
 
-            arrs = jax.vmap(per_block)(blks)
+            arrs = jax.vmap(per_block)(blks, srcs)
+            if srcs:
+                arrs["region_base"] = {
+                    n: jnp.arange(nblk, dtype=jnp.int32) * part[n]
+                    for n in srcs}
             if top and nblk > 1:
                 segP = {}
                 for name in part:
@@ -1432,6 +1532,7 @@ class FFModel:
                 in_k, lab_k, a_k = xs_k
                 seg_ps = a_k.get("segP", {})
                 seg_k = a_k.get("segk")
+                reg_b = a_k.get("region_base", {})
                 params2 = dict(st.params)
                 opt2 = st.opt_state
                 wb, slot_wb = [], []
@@ -1440,14 +1541,25 @@ class FFModel:
                     rowof = a_k["rowof"][name]
                     seg = ((seg_k, seg_ps[name], part[name])
                            if name in seg_ps else None)
+                    base_k = reg_b.get(name)
 
                     def _fetch(fl, r=rowof, s=seg):
+                        # region mode: r IS the src plan — same gather
                         if s is None:
                             return _cache_fetch(fl, r)
                         return _seg_fetch(fl.reshape(-1, fl.shape[-1]),
                                           r, s[0], s[1], s[2])
 
-                    def _wback(p, r, child, s=seg):
+                    def _wback(p, r, child, s=seg, b=base_k):
+                        if b is not None:
+                            # block-major region: stream the whole block
+                            # cache into the block's own region (the
+                            # measured-8.4x dus; ab_boundary.py)
+                            fl = p.reshape(-1, p.shape[-1])
+                            out = jax.lax.dynamic_update_slice(
+                                fl, child.reshape(-1, fl.shape[-1]),
+                                (b, 0))
+                            return out.reshape(p.shape)
                         if s is None:
                             return _cache_writeback(p, r, child)
                         return _seg_writeback(p, r, child,
@@ -1495,7 +1607,7 @@ class FFModel:
                       for k, v in mets.items()}
             return state, folded
 
-        def ladder_plan(state, slots_ep, nb):
+        def ladder_plan(state, slots_ep, nb, region_src=None):
             """(meta, arrays) of the in-graph ladder, or ({}, None)."""
             if not slots_ep:
                 return [], None
@@ -1504,7 +1616,15 @@ class FFModel:
             meta = ladder_meta(nb, slots_ep, rows0)
             if not meta:
                 return [], None
-            return meta, ladder_arrays(slots_ep, meta, rows0)
+            if region_src:
+                # region layout presumes its ops engage the top level
+                # at exactly the nblk the plan was built for
+                top = meta[0][0]
+                for name, s in region_src.items():
+                    assert name in meta[0][1] and s.shape[0] == nb // top, \
+                        (name, s.shape, top, nb)
+            return meta, ladder_arrays(slots_ep, meta, rows0,
+                                       region_src=region_src)
 
         def cache_epilogue(state, writebacks, originals):
             """Write the final rows back, each live slot exactly once
@@ -1515,17 +1635,25 @@ class FFModel:
                 return state
             new_params = dict(state.params)
             opt_state = state.opt_state
-            for name, tb_shape, rowof, wpack, sorted_ok in writebacks:
+            for name, tb_shape, rowof, wpack, sorted_ok, fsrc in writebacks:
+                def _final(cache, fsrc=fsrc):
+                    # region layout: each row's LAST copy, compacted to
+                    # global row order (final_src — region_plan), so the
+                    # table scatter stays sorted
+                    fl = cache.reshape(-1, cache.shape[-1])
+                    if fsrc is None:
+                        return fl
+                    return jnp.take(fl, fsrc, axis=0)
                 new_params[name] = {"embedding": _cache_writeback(
                     originals[name], rowof,
-                    state.params[name]["embedding"], wpack,
+                    _final(state.params[name]["embedding"]), wpack,
                     sorted_rowof=sorted_ok)}
                 for sn in lazy_slots:
                     opt_state = _swap_opt_entry(
                         opt_state, sn, name,
                         _cache_writeback(
                             originals[(sn, name)], rowof,
-                            state.opt_state[sn][name]["embedding"],
+                            _final(state.opt_state[sn][name]["embedding"]),
                             wpack, sorted_rowof=sorted_ok))
             return TrainState(new_params, opt_state,
                               state.bn_state, state.rng, state.step)
@@ -1539,8 +1667,10 @@ class FFModel:
             dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
             batches resident on device; ``labels``: (nb, batch, ...).
             """
-            state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
-            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0])
+            state, slots_ep, writebacks, orig, rsrc = cache_prologue(
+                state, inputs)
+            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0],
+                                     rsrc)
             state, folded = epoch_scan(state, inputs, labels, slots_ep,
                                        meta, arrs)
             return cache_epilogue(state, writebacks, orig), folded
@@ -1555,8 +1685,10 @@ class FFModel:
             across epochs performs the same adds on the same values.
             Returns per-epoch folded metrics stacked on a leading
             (n_epochs,) axis."""
-            state, slots_ep, writebacks, orig = cache_prologue(state, inputs)
-            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0])
+            state, slots_ep, writebacks, orig, rsrc = cache_prologue(
+                state, inputs)
+            meta, arrs = ladder_plan(state, slots_ep, labels.shape[0],
+                                     rsrc)
 
             def ep_body(st, _):
                 return epoch_scan(st, inputs, labels, slots_ep, meta, arrs)
