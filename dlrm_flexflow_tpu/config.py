@@ -123,9 +123,11 @@ class FFConfig:
     # prologue-computed circular-predecessor positions
     # (ops/slotting.py::region_plan), and the epilogue gathers each
     # row's last copy.  Bit-exact with shared-slot mode (tests).
+    # With a two-level ladder the L1 cache is itself L0-region-major
+    # (grouped circular plan), so the L0 writebacks stream too.
     # Engages for single-device packed-storage ops when the ladder top
     # level divides the epoch and segmented slots are off.  "auto" = on
-    # (round-5 headline A/B: busy 243.5 -> 233.5 ms); "off" restores
+    # (round-5 headline A/B: busy 243.5 -> 219.0 ms); "off" restores
     # shared-slot mode.
     epoch_cache_regions: str = "auto"
     # Physical embedding-table storage ("auto"|"on"|"off").  "auto"/"on"

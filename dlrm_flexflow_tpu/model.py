@@ -608,9 +608,9 @@ class FFModel:
         # TestSegmentedEpochSlots.
         seg_enabled = _seg_mode == "on"
         # epoch_cache_regions "auto" resolution (see FFConfig): ON —
-        # round-5 headline A/B measured busy 243.5 -> 233.5 ms (the dus
-        # writeback saves 43 ms, the last-copy epilogue gather and plan
-        # sorts give back ~33), bit-exact incl. lazy Adam and Zipf ids
+        # round-5 headline A/B measured busy 243.5 -> 219.0 ms
+        # (two-level, scatter-free plans), bit-exact incl. lazy Adam
+        # and Zipf ids
         region_auto_on = True
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
@@ -1222,12 +1222,12 @@ class FFModel:
                 nb = ids.shape[0]
                 reg = _region_layout(op, flat, ids, nb)
                 if reg is not None:
-                    cache, slots, src, final_rowof, final_src, \
+                    cache, slots, rinfo, final_rowof, final_src, \
                         rowof_all = reg
                     originals[op.name] = tb
                     params[op.name] = {"embedding": cache}
                     slots_ep[op.name] = slots
-                    region_src[op.name] = src
+                    region_src[op.name] = rinfo
                     writebacks.append((op.name, tb.shape, final_rowof,
                                        1, True, final_src))
                     if lazy_slots:
@@ -1306,10 +1306,47 @@ class FFModel:
                 # while the 1M-occurrence headline gains 10 ms
                 # (PERF.md round 5); "on" forces engagement for tests
                 return None
-            from .ops.slotting import region_plan, slot_rows
+            from .ops.slotting import (grouped_region_plan, region_plan,
+                                       region_plan_l0, slot_rows)
+            sentinel = flat.shape[0]
+            inner = sizes[1] if len(sizes) >= 2 else 0
+            if 0 < inner < top and top % inner == 0:
+                # TWO-LEVEL regions: the L1 cache itself is L0-region-
+                # major, so the L0 writebacks stream too (dus into the
+                # scoped L1 buffer); the L1 fetch uses the GROUPED
+                # circular plan (same-L1-block siblings are not valid
+                # sources — they are written by the same dus)
+                nl0 = top // inner
+                v0 = fv.reshape(nblk * nl0, -1)
+                m0 = v0.shape[1]
+                m1 = nl0 * m0
+                rowof_l0, vs_l0 = jax.vmap(
+                    lambda b: slot_rows(b // sp, sentinel))(v0)
+                base0 = (jnp.arange(nblk * nl0, dtype=jnp.int32)
+                         * m0)[:, None]
+                slots = ((base0 + vs_l0) * sp
+                         + (v0 % sp).astype(jnp.int32)).reshape(fv.shape)
+                rowof_all = rowof_l0.reshape(-1)
+                cache = _cache_fetch(flat, rowof_all)
+                src_l1, final_rowof, final_src = grouped_region_plan(
+                    rowof_l0, nblk, sentinel)
+                src_l0 = jax.vmap(
+                    lambda rb: region_plan_l0(rb, sentinel))(
+                        rowof_l0.reshape(nblk, nl0, m0))
+                info = {
+                    "src": src_l1,
+                    "base": jnp.arange(nblk, dtype=jnp.int32) * m1,
+                    "inner": {
+                        "src": src_l0,
+                        "base": jnp.broadcast_to(
+                            jnp.arange(nl0, dtype=jnp.int32) * m0,
+                            (nblk, nl0)),
+                    },
+                }
+                return cache, slots, info, final_rowof, final_src, \
+                    rowof_all
             m_occ = n_occ // nblk
             v = fv.reshape(nblk, m_occ)
-            sentinel = flat.shape[0]
             rowof_blocks, vslots = jax.vmap(
                 lambda b: slot_rows(b // sp, sentinel))(v)
             base = (jnp.arange(nblk, dtype=jnp.int32) * m_occ)[:, None]
@@ -1319,7 +1356,9 @@ class FFModel:
             cache = _cache_fetch(flat, rowof_all)
             src, final_rowof, final_src = region_plan(rowof_blocks,
                                                       sentinel)
-            return cache, slots, src, final_rowof, final_src, rowof_all
+            info = {"src": src,
+                    "base": jnp.arange(nblk, dtype=jnp.int32) * m_occ}
+            return cache, slots, info, final_rowof, final_src, rowof_all
 
         def ladder_sizes(nb):
             """Static block sizes of the in-graph cache ladder for an
@@ -1441,19 +1480,27 @@ class FFModel:
             nblk = nb // size
             blks = {n: s.reshape((nblk, size) + s.shape[1:])
                     for n, s in slots.items()}
-            # block-major region ops (top level only): the fetch
-            # indices are the circular-predecessor src plan, and the
-            # writeback streams into the block's own region (outer()
-            # keys on "region_base")
+            # block-major region ops: the fetch indices are the
+            # precomputed predecessor src plan, block slots are the
+            # region POSITIONS (a subtraction, not a re-ranking — the
+            # two-level layout's inter-region sentinel holes make
+            # dense ranks diverge from positions), and the writeback
+            # streams into the block's own region (outer() keys on
+            # "region_base").  ``region_src`` entries:
+            # {"src": (nblk, m), "base": (nblk,), ["inner": ...]} —
+            # "inner" recurses one level down.
             srcs = {n: s for n, s in (region_src or {}).items()
-                    if top and n in part}
+                    if n in part}
 
             def per_block(blk, src_blk):
                 rowof_d, slots_d = {}, {}
                 for name, b in blk.items():
                     if name in part:
                         sp = op_storage[name]
-                        if sp > 1:
+                        if name in src_blk:
+                            rowof = src_blk[name]["src"]
+                            s = b - src_blk[name]["base"] * sp
+                        elif sp > 1:
                             # view-unit slotting: parent rows are view
                             # rows; each occurrence gets a view slot,
                             # its logical slot offset by the id's half
@@ -1466,26 +1513,20 @@ class FFModel:
                             rowof = jnp.concatenate(
                                 [rowof, jnp.full((m - n,), rows[name],
                                                  rowof.dtype)])
-                        if name in src_blk:
-                            # region mode: fetch by src; block-local
-                            # slots (dense ranks of the region slots)
-                            # coincide with the region positions by
-                            # construction, so only the fetch indices
-                            # change
-                            rowof = src_blk[name]
                         rowof_d[name], slots_d[name] = rowof, s
                     else:
                         slots_d[name] = b
+                inner_srcs = {n: s["inner"] for n, s in src_blk.items()
+                              if "inner" in s}
                 return {"rowof": rowof_d,
                         "next": ladder_arrays(slots_d, rest,
                                               {**rows, **part},
-                                              top=False)}
+                                              top=False,
+                                              region_src=inner_srcs)}
 
             arrs = jax.vmap(per_block)(blks, srcs)
             if srcs:
-                arrs["region_base"] = {
-                    n: jnp.arange(nblk, dtype=jnp.int32) * part[n]
-                    for n in srcs}
+                arrs["region_base"] = {n: srcs[n]["base"] for n in srcs}
             if top and nblk > 1:
                 segP = {}
                 for name in part:
@@ -1618,11 +1659,22 @@ class FFModel:
                 return [], None
             if region_src:
                 # region layout presumes its ops engage the top level
-                # at exactly the nblk the plan was built for
+                # at exactly the nblk the plan was built for — and the
+                # TWO-level layout additionally presumes the inner
+                # level engages with exactly nl0 blocks (a row has one
+                # slot PER L0 REGION; without the inner level,
+                # same-L1-block occurrences would stop propagating
+                # updates to each other — silently bit-inexact)
                 top = meta[0][0]
-                for name, s in region_src.items():
-                    assert name in meta[0][1] and s.shape[0] == nb // top, \
-                        (name, s.shape, top, nb)
+                for name, info in region_src.items():
+                    assert (name in meta[0][1]
+                            and info["src"].shape[0] == nb // top), \
+                        (name, info["src"].shape, top, nb)
+                    if "inner" in info:
+                        assert (len(meta) >= 2 and name in meta[1][1]
+                                and info["inner"]["src"].shape[1]
+                                == top // meta[1][0]), \
+                            (name, info["inner"]["src"].shape, meta)
             return meta, ladder_arrays(slots_ep, meta, rows0,
                                        region_src=region_src)
 

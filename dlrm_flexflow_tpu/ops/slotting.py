@@ -106,23 +106,132 @@ def region_plan(rowof_blocks, num_rows: int):
     n = nblk * m
     rows = rowof_blocks.reshape(n).astype(jnp.int32)
     pos = jnp.arange(n, dtype=jnp.int32)
-    # lexicographic (row, position): runs of one row ordered by block
+    # lexicographic (row, position): runs of one row ordered by block.
+    # Everything below is sorts, scans, shifts, and gathers — NO
+    # scattered writes (scalar scatters cost 3-9 ms each on this
+    # platform; the round-3 slot_rows lesson, re-learned on the first
+    # cut of this function: the .at[].max/.set forms added ~50 ms of
+    # prologue at the headline shape)
     srows, spos = jax.lax.sort((rows, pos), num_keys=2)
-    first = jnp.concatenate([jnp.ones((1,), bool), srows[1:] != srows[:-1]])
-    run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
-    last_pos = jnp.zeros((n,), jnp.int32).at[run_id].max(spos)
+    first, last_idx = _run_bounds(srows)
+    last_pos = jnp.take(spos, last_idx)       # run's last pos, per entry
     prev = jnp.concatenate([spos[:1], spos[:-1]])
-    src_sorted = jnp.where(first, jnp.take(last_pos, run_id), prev)
+    src_sorted = jnp.where(first, last_pos, prev)
     # back to position order (out[spos] = src_sorted, as a sort)
     _, src = jax.lax.sort((spos, src_sorted), num_keys=1)
-    # epilogue compaction: run-firsts land at run_id (ascending rows,
-    # sentinel runs sort last and compact to sentinel entries)
-    tgt = jnp.where(first, run_id, jnp.int32(n))
-    final_rowof = jnp.full((n,), jnp.int32(num_rows)).at[tgt].set(
-        srows, mode="drop")
-    final_src = jnp.zeros((n,), jnp.int32).at[tgt].set(
-        jnp.take(last_pos, run_id), mode="drop")
+    # epilogue compaction, scatter-free: keep run-firsts, push the rest
+    # to the sentinel end with one value-carrying sort (rows ascend)
+    key = jnp.where(first, srows, jnp.int32(num_rows))
+    final_rowof, final_src = jax.lax.sort((key, last_pos), num_keys=1)
     return src.reshape(nblk, m), final_rowof, final_src
+
+
+def _run_bounds(keys):
+    """(first, last_idx) of equal-key runs in a sorted 1-D array —
+    scan-based, no scatters.  ``first[i]`` marks run starts;
+    ``last_idx[i]`` is the sorted-space index of the run's LAST entry,
+    broadcast per entry."""
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), keys[1:] != keys[:-1]])
+    return first, _last_idx_from_first(first)
+
+
+def _last_idx_from_first(first):
+    """Per-entry index of the containing run's LAST entry, given the
+    run-start flags of a sorted array.  Reverse cummin of
+    where(first, idx, n) at i yields the nearest run start at-or-after
+    i; shifting left makes it the next run's start, minus one."""
+    n = first.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    nxt = jnp.flip(jax.lax.cummin(
+        jnp.flip(jnp.where(first, idx, jnp.int32(n)))))
+    nxt_start = jnp.concatenate([nxt[1:], jnp.full((1,), n, jnp.int32)])
+    return nxt_start - 1
+
+
+def region_plan_l0(rowof_l0, num_rows: int):
+    """Within-L1 predecessor plan for L0-level regions (round 5).
+
+    The L1 cache is laid out as one region per L0 block; each L0
+    block's writeback streams into its own region (dus) and the L0
+    fetch gathers each position's value from the row's LAST copy in an
+    EARLIER L0 block of the same L1 pass — or from ITSELF when none
+    exists (the L1-level fetch re-seeds every position with the row's
+    pre-L1-block value at the start of each pass, so self-default is
+    correct on every epoch).
+
+    ``rowof_l0``: (nl0, m0) per-L0-block sorted distinct rows with
+    sentinel (num_rows) padding.  Returns ``src`` (nl0, m0): L1-cache
+    positions (p = j*m0 + r).
+    """
+    nl0, m0 = rowof_l0.shape
+    n = nl0 * m0
+    rows = rowof_l0.reshape(n).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    srows, spos = jax.lax.sort((rows, pos), num_keys=2)
+    first = jnp.concatenate([jnp.ones((1,), bool), srows[1:] != srows[:-1]])
+    # previous copy of the same row in an earlier L0 block — positions
+    # sort by block within a run; same-block duplicates cannot occur
+    # (rowof is distinct per block).  First-of-run: self.
+    prev = jnp.concatenate([spos[:1], spos[:-1]])
+    src_sorted = jnp.where(first, spos, prev)
+    _, src = jax.lax.sort((spos, src_sorted), num_keys=1)
+    return src.reshape(nl0, m0)
+
+
+def grouped_region_plan(rowof_l0, nblk_l1: int, num_rows: int):
+    """Circular L1-level predecessor plan over an L0-REGION-major epoch
+    cache (round 5 — the two-level extension of ``region_plan``).
+
+    The epoch cache holds ``nblk_l1`` L1 regions, each of which is the
+    L1 cache's L0-region-major layout ((nl0_per_l1, m0) per L1 block).
+    The L1 fetch of block k gathers each position's value from the
+    row's LAST-L0 copy within the latest L1 block STRICTLY before k in
+    CIRCULAR order (all copies within one L1 block are written in the
+    same dus, so a same-L1-block sibling is NOT a valid source; full
+    wrap resolves to the row's own canonical copy from the previous
+    epoch, seeded with table values before the first).
+
+    ``rowof_l0``: (nblk_l1 * nl0, m0) — ALL L0 blocks' sorted distinct
+    rows, L1-major.  Returns ``(src, final_rowof, final_src)`` exactly
+    as ``region_plan`` (src shaped (nblk_l1, m1) with m1 = nl0*m0).
+    """
+    nl0_total, m0 = rowof_l0.shape
+    assert nl0_total % nblk_l1 == 0
+    nl0 = nl0_total // nblk_l1
+    m1 = nl0 * m0
+    n = nblk_l1 * m1
+    rows = rowof_l0.reshape(n).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    grp = pos // m1  # L1 block of each position
+    # scatter-free throughout (see region_plan): sorts + scans + gathers
+    srows, sgrp, spos = jax.lax.sort((rows, grp, pos), num_keys=3)
+    row_first = jnp.concatenate(
+        [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
+    sub_first = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (srows[1:] != srows[:-1]) | (sgrp[1:] != sgrp[:-1])])
+    sub_last_idx = _last_idx_from_first(sub_first)
+    row_last_idx = _last_idx_from_first(row_first)
+    # canonical copy of a (row, L1-block) subrun = its LAST position
+    # (positions ascend within a subrun = L0-natural order)
+    canon = jnp.take(spos, sub_last_idx)           # per entry
+    # predecessor subrun's canon, circular within the row: previous
+    # entry's canon at subrun-firsts (the previous subrun's last
+    # entry); row-firsts wrap to the canon at the row's LAST entry
+    canon_prev = jnp.concatenate([canon[:1], canon[:-1]])
+    canon_wrap = jnp.take(canon, row_last_idx)
+    pred_at_first = jnp.where(row_first, canon_wrap, canon_prev)
+    # broadcast over the subrun: gather at the subrun's first index
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sub_first_idx = jax.lax.cummax(jnp.where(sub_first, idx, 0))
+    src_sorted = jnp.take(pred_at_first, sub_first_idx)
+    _, src = jax.lax.sort((spos, src_sorted), num_keys=1)
+    # epilogue: per row, the canon of its LAST L1 block = canon at the
+    # row's last entry; compact run-firsts by one value-carrying sort
+    key = jnp.where(row_first, srows, jnp.int32(num_rows))
+    final_rowof, final_src = jax.lax.sort((key, canon_wrap), num_keys=1)
+    return src.reshape(nblk_l1, m1), final_rowof, final_src
 
 
 def slot_rows_segmented(ids, num_rows: int, nblocks: int):

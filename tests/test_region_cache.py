@@ -57,11 +57,82 @@ class TestRegionPlan:
             assert (frow[len(allrows):] == rows_n).all()
 
 
+class TestGroupedRegionPlan:
+    def test_against_brute_force(self):
+        """The two-level plan: L1 fetch takes the row's LAST-L0 copy
+        within the latest CIRCULARLY-prior L1 block (same-block
+        siblings are invalid — one dus writes them all); the epilogue
+        takes the last L1 block's canonical copy."""
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.slotting import (grouped_region_plan,
+                                                    region_plan_l0,
+                                                    slot_rows)
+
+        rng = np.random.default_rng(1)
+        for trial in range(40):
+            nl1 = int(rng.integers(2, 4))
+            nl0 = int(rng.integers(2, 4))
+            per = int(rng.integers(2, 5))
+            rows_n = int(rng.integers(4, 10))
+            ids = rng.integers(0, rows_n, size=(nl1 * nl0, per))
+            rb = np.stack(
+                [np.asarray(slot_rows(jnp.asarray(ids[b]), rows_n)[0])
+                 for b in range(nl1 * nl0)])
+            m0 = rb.shape[1]
+            m1 = nl0 * m0
+            src, frow, fsrc = map(np.asarray, grouped_region_plan(
+                jnp.asarray(rb), nl1, rows_n))
+
+            def canon(k, r):
+                best = None
+                for j in range(nl0):
+                    hits = np.where(rb[k * nl0 + j] == r)[0]
+                    if len(hits):
+                        best = k * m1 + j * m0 + hits[0]
+                return best
+
+            for k in range(nl1):
+                for p in range(m1):
+                    j, t = divmod(p, m0)
+                    r = rb[k * nl0 + j, t]
+                    if r == rows_n:
+                        continue
+                    exp = next(c for d in range(1, nl1 + 1)
+                               if (c := canon((k - d) % nl1, r))
+                               is not None)
+                    assert src[k, p] == exp, (trial, k, p, r)
+            allrows = sorted(set(rb[rb < rows_n].ravel()))
+            for i, r in enumerate(allrows):
+                assert frow[i] == r
+                assert fsrc[i] == [canon(k, r) for k in range(nl1)
+                                   if canon(k, r) is not None][-1]
+            assert (frow[len(allrows):] == rows_n).all()
+
+            # the within-L1 plan: last copy in an EARLIER L0 block,
+            # self-default
+            for k in range(nl1):
+                sub = rb[k * nl0:(k + 1) * nl0]
+                src0 = np.asarray(region_plan_l0(jnp.asarray(sub),
+                                                 rows_n))
+                for j in range(nl0):
+                    for t in range(m0):
+                        r = sub[j, t]
+                        if r == rows_n:
+                            continue
+                        exp = j * m0 + t
+                        for jb in range(j - 1, -1, -1):
+                            hits = np.where(sub[jb] == r)[0]
+                            if len(hits):
+                                exp = jb * m0 + hits[0]
+                                break
+                        assert src0[j, t] == exp, (trial, k, j, t)
+
+
 # Table large enough that the region cache (n_occ = nb*8*4*2 = 1024
-# packed rows) is SMALLER than the table's packed rows (8192*4/16 =
-# 2048) — the size guard a 64-row table silently fails, which made the
+# packed rows) is SMALLER than the table's packed rows (16384*4/16 =
+# 4096) — the size guard a 64-row table silently fails, which made the
 # first cut of these tests vacuous (review r5: region_plan ran 0 times)
-ROWS = 8192
+ROWS = 16384
 
 
 def _train(regions, opt="sgd", zipf=False, epochs=2, nb=16,
@@ -80,14 +151,18 @@ def _train(regions, opt="sgd", zipf=False, epochs=2, nb=16,
     st = m.init(seed=0)
     assert m.get_op("emb").storage_pack > 1
     if expect_engaged is not None:
-        # spy on region_plan so the engagement claim can never go
-        # silently vacuous again (review r5)
+        # spy on the plan functions so the engagement claim can never
+        # go silently vacuous again (review r5) — per-function lists so
+        # a silent single-level fallback in the two-level case is
+        # caught too (second review pass)
         import dlrm_flexflow_tpu.ops.slotting as slotting
-        calls = []
-        real = slotting.region_plan
-        monkeypatch.setattr(
-            slotting, "region_plan",
-            lambda *a, **k: calls.append(1) or real(*a, **k))
+        calls = {"region_plan": [], "grouped_region_plan": []}
+        for fn in calls:
+            real = getattr(slotting, fn)
+            monkeypatch.setattr(
+                slotting, fn,
+                lambda *a, _r=real, _c=calls[fn], **k:
+                    _c.append(1) or _r(*a, **k))
     rng = np.random.default_rng(7)
     if zipf:
         ids = np.minimum(rng.zipf(1.5, size=(nb, 8, 4, 2)) - 1,
@@ -99,7 +174,15 @@ def _train(regions, opt="sgd", zipf=False, epochs=2, nb=16,
     labels = rng.integers(0, 2, size=(nb, 8, 1)).astype(np.float32)
     st, mets = m.train_epochs(st, inputs, labels, epochs)
     if expect_engaged is not None:
-        assert bool(calls) == expect_engaged, (regions, calls)
+        if not expect_engaged:
+            assert not any(calls.values()), (regions, calls)
+        elif nb >= 32:
+            # the two-level layout (ladder [16, 2]) must use the
+            # GROUPED plan specifically — a fallback to single-level
+            # would still be bit-exact and pass silently
+            assert calls["grouped_region_plan"], (regions, calls)
+        else:
+            assert calls["region_plan"], (regions, calls)
     out = {"embedding": np.asarray(st.params["emb"]["embedding"]),
            "loss": np.asarray(mets["loss"])}
     if opt == "adam":
@@ -111,15 +194,18 @@ def _train(regions, opt="sgd", zipf=False, epochs=2, nb=16,
 class TestRegionEquivalence:
     @pytest.mark.parametrize("opt", ["sgd", "adam"])
     @pytest.mark.parametrize("zipf", [False, True])
-    def test_bit_exact_vs_shared_slots(self, opt, zipf, monkeypatch):
+    @pytest.mark.parametrize("nb", [16, 32])
+    def test_bit_exact_vs_shared_slots(self, opt, zipf, nb, monkeypatch):
         """"on" forces region engagement below the auto size gate; the
         fused multi-epoch run must be BIT-identical to shared-slot mode
         — same adds on the same values, only the address space
         changes (the ladder's exactness proof extends).  Engagement is
-        spy-asserted."""
-        a = _train("on", opt, zipf, expect_engaged=True,
+        spy-asserted.  nb=16 runs the SINGLE-level region layout
+        (ladder [2]); nb=32 runs the TWO-level layout (ladder [16, 2] —
+        L0 regions inside the L1 cache, grouped circular plan)."""
+        a = _train("on", opt, zipf, nb=nb, expect_engaged=True,
                    monkeypatch=monkeypatch)
-        b = _train("off", opt, zipf, expect_engaged=False,
+        b = _train("off", opt, zipf, nb=nb, expect_engaged=False,
                    monkeypatch=monkeypatch)
         for k in a:
             np.testing.assert_array_equal(a[k], b[k], err_msg=k)
