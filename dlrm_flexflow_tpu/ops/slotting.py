@@ -113,8 +113,12 @@ def region_plan(rowof_blocks, num_rows: int):
     # cut of this function: the .at[].max/.set forms added ~50 ms of
     # prologue at the headline shape)
     srows, spos = jax.lax.sort((rows, pos), num_keys=2)
-    first, last_idx = _run_bounds(srows)
-    last_pos = jnp.take(spos, last_idx)       # run's last pos, per entry
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), srows[1:] != srows[:-1]])
+    last = jnp.concatenate([first[1:], jnp.ones((1,), bool)])
+    # run's last pos, per entry: positions ascend within a run, and
+    # run-lasts are exactly the marked positions at-or-after each entry
+    last_pos = _fill_from_marked(spos, last, reverse=True)
     prev = jnp.concatenate([spos[:1], spos[:-1]])
     src_sorted = jnp.where(first, last_pos, prev)
     # back to position order (out[spos] = src_sorted, as a sort)
@@ -126,27 +130,53 @@ def region_plan(rowof_blocks, num_rows: int):
     return src.reshape(nblk, m), final_rowof, final_src
 
 
-def _run_bounds(keys):
-    """(first, last_idx) of equal-key runs in a sorted 1-D array —
-    scan-based, no scatters.  ``first[i]`` marks run starts;
-    ``last_idx[i]`` is the sorted-space index of the run's LAST entry,
-    broadcast per entry."""
-    first = jnp.concatenate(
-        [jnp.ones((1,), bool), keys[1:] != keys[:-1]])
-    return first, _last_idx_from_first(first)
+def _fill_from_marked(vals, marked, *, reverse=False):
+    """``out[i] = vals[j]`` at the nearest marked ``j <= i`` (``>= i``
+    when ``reverse``) — the segmented broadcast every region plan
+    needs, scatter-free AND gather-free.
 
+    The first cut of these plans broadcast run values with
+    ``jnp.take(vals, per_entry_idx)``; on this platform a 1-D gather
+    pays the emitter's per-ROW issue cost (~7.5 ns/element) regardless
+    of element size, so each 2^20-element broadcast cost 7.48 ms — the
+    three of them were 10% of headline busy (round-5 trace).  An
+    associative forward-fill moves the same data at vector rates
+    (~0.2 ms): scan along the minor axis of a (r, 256) reshape
+    (vectorized over rows), then a tiny cross-row carry pass.
 
-def _last_idx_from_first(first):
-    """Per-entry index of the containing run's LAST entry, given the
-    run-start flags of a sorted array.  Reverse cummin of
-    where(first, idx, n) at i yields the nearest run start at-or-after
-    i; shifting left makes it the next run's start, minus one."""
-    n = first.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    nxt = jnp.flip(jax.lax.cummin(
-        jnp.flip(jnp.where(first, idx, jnp.int32(n)))))
-    nxt_start = jnp.concatenate([nxt[1:], jnp.full((1,), n, jnp.int32)])
-    return nxt_start - 1
+    Positions before the first mark (after the last, when ``reverse``)
+    are undefined; every plan below guarantees a mark at the boundary.
+    """
+    n = vals.shape[0]
+    c = min(256, n)
+    r = -(-n // c)
+    pad = r * c - n
+    if pad:
+        vals = jnp.concatenate([vals, jnp.zeros((pad,), vals.dtype)])
+        marked = jnp.concatenate([marked, jnp.zeros((pad,), bool)])
+
+    def op(a, b):
+        # b is the later element in scan order: its mark wins
+        av, am = a
+        bv, bm = b
+        return jnp.where(bm, bv, av), am | bm
+
+    sv, sm = jax.lax.associative_scan(
+        op, (vals.reshape(r, c), marked.reshape(r, c)),
+        axis=1, reverse=reverse)
+    # cross-row carries: exclusive pair-scan of each row's full combine
+    edge = (sv[:, 0], sm[:, 0]) if reverse else (sv[:, -1], sm[:, -1])
+    cv, cm = jax.lax.associative_scan(op, edge, axis=0, reverse=reverse)
+    if reverse:
+        cv = jnp.concatenate([cv[1:], cv[-1:]])
+        cm = jnp.concatenate([cm[1:], jnp.zeros((1,), bool)])
+    else:
+        cv = jnp.concatenate([cv[:1], cv[:-1]])
+        cm = jnp.concatenate([jnp.zeros((1,), bool), cm[:-1]])
+    out = jnp.where(sm, sv, jnp.where(cm, cv, jnp.zeros((), vals.dtype)
+                                      )[:, None])
+    out = out.reshape(-1)
+    return out[:n] if pad else out
 
 
 def region_plan_l0(rowof_l0, num_rows: int):
@@ -211,21 +241,17 @@ def grouped_region_plan(rowof_l0, nblk_l1: int, num_rows: int):
     sub_first = jnp.concatenate(
         [jnp.ones((1,), bool),
          (srows[1:] != srows[:-1]) | (sgrp[1:] != sgrp[:-1])])
-    sub_last_idx = _last_idx_from_first(sub_first)
-    row_last_idx = _last_idx_from_first(row_first)
-    # canonical copy of a (row, L1-block) subrun = its LAST position
-    # (positions ascend within a subrun = L0-natural order)
-    canon = jnp.take(spos, sub_last_idx)           # per entry
-    # predecessor subrun's canon, circular within the row: previous
-    # entry's canon at subrun-firsts (the previous subrun's last
-    # entry); row-firsts wrap to the canon at the row's LAST entry
-    canon_prev = jnp.concatenate([canon[:1], canon[:-1]])
-    canon_wrap = jnp.take(canon, row_last_idx)
-    pred_at_first = jnp.where(row_first, canon_wrap, canon_prev)
-    # broadcast over the subrun: gather at the subrun's first index
-    idx = jnp.arange(n, dtype=jnp.int32)
-    sub_first_idx = jax.lax.cummax(jnp.where(sub_first, idx, 0))
-    src_sorted = jnp.take(pred_at_first, sub_first_idx)
+    row_last = jnp.concatenate([row_first[1:], jnp.ones((1,), bool)])
+    # a row's wrap target is the canon of its LAST subrun = the spos at
+    # the row's last entry (a subrun's canonical copy is its LAST
+    # position — positions ascend within a subrun = L0-natural order)
+    canon_wrap = _fill_from_marked(spos, row_last, reverse=True)
+    # predecessor subrun's canon at a non-row-first subrun-first: the
+    # previous entry IS the prior subrun's last entry, i.e. its canon
+    prev = jnp.concatenate([spos[:1], spos[:-1]])
+    pred_at_first = jnp.where(row_first, canon_wrap, prev)
+    # broadcast over the subrun (meaningful at subrun-firsts only)
+    src_sorted = _fill_from_marked(pred_at_first, sub_first)
     _, src = jax.lax.sort((spos, src_sorted), num_keys=1)
     # epilogue: per row, the canon of its LAST L1 block = canon at the
     # row's last entry; compact run-firsts by one value-carrying sort

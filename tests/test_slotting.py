@@ -61,3 +61,43 @@ def test_jittable_and_deterministic():
     b = slot_rows(ids, 64)
     np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
     np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+@pytest.mark.parametrize("n,frac,reverse,seed", [
+    (64, 0.3, False, 0),
+    (64, 0.3, True, 1),
+    (1000, 0.05, False, 2),   # pads to a 256-col multiple; long runs
+    (1000, 0.05, True, 3),
+    (4096, 0.9, False, 4),    # dense marks
+    (4096, 0.9, True, 5),
+    (1, 1.0, False, 6),
+    (1, 1.0, True, 7),
+    (257, 0.2, False, 8),     # one element past a full row
+    (257, 0.2, True, 9),
+])
+def test_fill_from_marked_brute_force(n, frac, reverse, seed):
+    """The segmented broadcast under every region plan: out[i] = vals
+    at the nearest marked index at-or-before i (at-or-after when
+    reverse).  The boundary position is always marked, matching the
+    plans' contract."""
+    from dlrm_flexflow_tpu.ops.slotting import _fill_from_marked
+    rng = np.random.default_rng(seed)
+    marked = rng.random(n) < frac
+    marked[-1 if reverse else 0] = True
+    vals = rng.integers(0, 1 << 30, size=n).astype(np.int32)
+    got = np.asarray(_fill_from_marked(
+        jnp.asarray(vals), jnp.asarray(marked), reverse=reverse))
+    exp = np.empty(n, np.int32)
+    if reverse:
+        cur = 0
+        for i in range(n - 1, -1, -1):
+            if marked[i]:
+                cur = vals[i]
+            exp[i] = cur
+    else:
+        cur = 0
+        for i in range(n):
+            if marked[i]:
+                cur = vals[i]
+            exp[i] = cur
+    np.testing.assert_array_equal(got, exp)
