@@ -612,6 +612,16 @@ class FFModel:
         # (two-level, scatter-free plans), bit-exact incl. lazy Adam
         # and Zipf ids
         region_auto_on = True
+        # When EVERY cache op takes the region path, auto's ladder
+        # collapses to the single leaf level ([inner]): under regions
+        # the mid level saves no HBM gather issues (the fetch row count
+        # per epoch is the occurrence count either way) while adding
+        # its own S(1) rebuild gather + dus layer — measured busy
+        # 185.0 -> 171.8 ms at the headline (round 5).  cache_prologue
+        # sets the flag before any ladder_sizes consumer runs; mixed
+        # eligibility keeps the two-level shape so non-region ops
+        # never rebuild straight from the table every 8 steps.
+        ladder_ctx = {"region_single": False}
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -1215,12 +1225,25 @@ class FFModel:
             opt_state = state.opt_state
             slots_ep, writebacks, originals = {}, [], {}
             region_src = {}
-            for op in (sparse_emb if epoch_cache else ()):
+            cache_ops = sparse_emb if epoch_cache else ()
+            # one engagement decision per op, shared by the ladder-shape
+            # choice below AND _region_layout (review r5: the gate must
+            # not be evaluated twice or the two could diverge);
+            # parent_rows is pure shape math — no traced reshape
+            region_ok = {
+                op.name: _region_engages(
+                    op, inputs[id_name[op.name]].astype(jnp.int32),
+                    int(np.prod(params[op.name]["embedding"].shape[:-1])))
+                for op in cache_ops}
+            ladder_ctx["region_single"] = bool(region_ok) and all(
+                region_ok.values())
+            for op in cache_ops:
                 ids = inputs[id_name[op.name]].astype(jnp.int32)
                 tb = params[op.name]["embedding"]
                 flat = tb.reshape(-1, tb.shape[-1])
                 nb = ids.shape[0]
-                reg = _region_layout(op, flat, ids, nb)
+                reg = (_region_layout(op, flat, ids, nb)
+                       if region_ok[op.name] else None)
                 if reg is not None:
                     cache, slots, rinfo, final_rowof, final_src, \
                         rowof_all = reg
@@ -1265,25 +1288,53 @@ class FFModel:
                                state.rng, state.step)
             return state, slots_ep, writebacks, originals, region_src
 
-        def _region_layout(op, flat, ids, nb):
-            """Block-major region layout for the epoch cache
-            (FFConfig.epoch_cache_regions; ops/slotting.py::region_plan
-            for the design), or None when it does not engage.  Returns
-            (cache, slots, src, final_rowof, final_src, rowof_all)."""
+        def _region_engages(op, ids, parent_rows):
+            """Size/flag gate of the region layout — everything that
+            does NOT depend on the ladder shape, so cache_prologue can
+            decide the auto ladder (single leaf level when every cache
+            op engages) before any ladder_sizes consumer runs."""
             mode = getattr(self.config, "epoch_cache_regions", "off")
             if mode not in ("auto", "on", "off"):
                 raise ValueError(
                     f"epoch_cache_regions must be 'auto'|'on'|'off', "
                     f"got {mode!r}")
             if mode == "off" or (mode == "auto" and not region_auto_on):
-                return None
+                return False
             sp = op.storage_pack
-            if sp <= 1 or _seg_blocks_for(nb) > 1 or mesh_ is not None:
-                # packed-storage ops only; segmented owns the top level;
-                # under a mesh the region dus/gather would fight the
+            if sp <= 1 or seg_enabled or mesh_ is not None:
+                # packed-storage ops only; first-touch segmentation owns
+                # the top level whenever it is enabled (checking the
+                # flag itself — not _seg_blocks_for — keeps this gate
+                # free of ladder_sizes, whose region-collapse branch
+                # reads the flag this gate computes; review r5); under
+                # a mesh the region dus/gather would fight the
                 # SPMD-sharded cache layout (untested) — keep shared
                 # slots there
-                return None
+                return False
+            n_occ = int(np.prod(op.flat_ids(ids).shape))
+            # the region cache holds n_occ PACKED view rows — compare
+            # against the table's packed rows (build_cache's guard),
+            # not the logical count (review r5)
+            if n_occ >= parent_rows:  # cache not smaller: no win
+                return False
+            if mode == "auto" and n_occ < (1 << 18):
+                # the region plan's fixed costs (per-block sorts, the
+                # last-copy epilogue gather) beat the saved scatters
+                # only on big epochs: kaggle-shape A/B measured busy
+                # 4.275 -> 5.252 ms with regions at 26k occurrences,
+                # while the 1M-occurrence headline gains 10 ms
+                # (PERF.md round 5); "on" forces engagement for tests
+                return False
+            return True
+
+        def _region_layout(op, flat, ids, nb):
+            """Block-major region layout for the epoch cache
+            (FFConfig.epoch_cache_regions; ops/slotting.py::region_plan
+            for the design), or None when the ladder shape does not
+            support it (the size/flag gate is the caller's region_ok —
+            computed ONCE per op in cache_prologue).  Returns
+            (cache, slots, src, final_rowof, final_src, rowof_all)."""
+            sp = op.storage_pack
             sizes = ladder_sizes(nb)
             top = sizes[0] if sizes else 0
             if not (0 < top < nb and nb % top == 0):
@@ -1293,19 +1344,6 @@ class FFModel:
                 return None
             fv = op.flat_ids(ids)
             n_occ = int(np.prod(fv.shape))
-            # the region cache holds n_occ PACKED view rows — compare
-            # against the table's packed rows (build_cache's guard),
-            # not the logical count (review r5)
-            if n_occ >= flat.shape[0]:  # cache not smaller: no win
-                return None
-            if mode == "auto" and n_occ < (1 << 18):
-                # the region plan's fixed costs (per-block sorts, the
-                # last-copy epilogue gather) beat the saved scatters
-                # only on big epochs: kaggle-shape A/B measured busy
-                # 4.275 -> 5.252 ms with regions at 26k occurrences,
-                # while the 1M-occurrence headline gains 10 ms
-                # (PERF.md round 5); "on" forces engagement for tests
-                return None
             from .ops.slotting import (grouped_region_plan, region_plan,
                                        region_plan_l0, slot_rows)
             sentinel = flat.shape[0]
@@ -1391,7 +1429,16 @@ class FFModel:
             # stays small enough for XLA:TPU to keep in fast scoped
             # memory while its writebacks into the epoch cache amortize
             # over 8 inner blocks.
+            #
+            # Under REGIONS for every cache op the mid level loses its
+            # reason to exist — the region fetch issues one HBM gather
+            # row per occurrence per epoch whether it reads into a mid
+            # cache or straight into the leaf block, so the mid level
+            # only adds its own S(1) rebuild + dus layer: the ladder
+            # collapses to [inner] (busy 185.0 -> 171.8 ms, round 5).
             if 0 < inner < nb:
+                if ladder_ctx["region_single"] and nb % inner == 0:
+                    return [inner]
                 top = inner * 8
                 if top < nb and nb % top == 0:
                     return [top, inner]

@@ -338,10 +338,16 @@ def view_gather(view, ids, d: int):
     pack = view.shape[-1] // d
     if pack <= 1:
         return jnp.take(view, ids, axis=0)
-    q = ids // pack
-    h = (ids % pack).astype(jnp.int32)
-    vrows = jnp.take(view, q, axis=0)          # ids.shape + (pack*d,)
-    vrows = vrows.reshape(ids.shape + (pack, d))
+    # FLAT select-then-reshape: the gather, the half-select, and the
+    # final reshape all run on (n, ...) 2-D/3-D forms.  The earlier
+    # ids.shape + (pack, d) 5-D form made XLA tile the intermediates
+    # T(2,128) and insert per-step layout copies around the select
+    # (~7 us/step of pure data formatting at the headline shape,
+    # round-5 trace: reshape.445 + copy.145/146).
+    q = ids.reshape(-1) // pack
+    h = (ids.reshape(-1) % pack).astype(jnp.int32)
+    vrows = jnp.take(view, q, axis=0)          # (n, pack*d)
+    vrows = vrows.reshape(-1, pack, d)
     # half-select as a WHERE chain, not take_along_axis: the dynamic
     # gather compiled to its own latency-bound kernel (~15 us/step at
     # the headline shape, 36 GB/s — round-4 trace); selects fuse into
@@ -350,12 +356,13 @@ def view_gather(view, ids, d: int):
     # The chain is O(pack) sequential selects, so small-dim tables
     # (large pack) keep the single-gather form.
     if pack > 4:
-        return jnp.take_along_axis(
-            vrows, h[..., None, None], axis=-2).squeeze(-2)
-    out = vrows[..., 0, :]
+        out = jnp.take_along_axis(
+            vrows, h[:, None, None], axis=-2).squeeze(-2)
+        return out.reshape(ids.shape + (d,))
+    out = vrows[:, 0, :]
     for i in range(1, pack):
-        out = jnp.where((h == i)[..., None], vrows[..., i, :], out)
-    return out
+        out = jnp.where((h == i)[:, None], vrows[:, i, :], out)
+    return out.reshape(ids.shape + (d,))
 
 
 def _expand_lanes(ids_flat, upd_flat, pack, dtype):
