@@ -617,7 +617,7 @@ class FFModel:
         # the mid level saves no HBM gather issues (the fetch row count
         # per epoch is the occurrence count either way) while adding
         # its own S(1) rebuild gather + dus layer — measured busy
-        # 185.0 -> 171.8 ms at the headline (round 5).  cache_prologue
+        # 185.0 -> 171.6 ms at the headline, bench-recorded 171.5 (round 5).  cache_prologue
         # sets the flag before any ladder_sizes consumer runs; mixed
         # eligibility keeps the two-level shape so non-region ops
         # never rebuild straight from the table every 8 steps.
@@ -1435,7 +1435,7 @@ class FFModel:
             # row per occurrence per epoch whether it reads into a mid
             # cache or straight into the leaf block, so the mid level
             # only adds its own S(1) rebuild + dus layer: the ladder
-            # collapses to [inner] (busy 185.0 -> 171.8 ms, round 5).
+            # collapses to [inner] (busy 185.0 -> 171.6 ms, bench-recorded 171.5, round 5).
             if 0 < inner < nb:
                 if ladder_ctx["region_single"] and nb % inner == 0:
                     return [inner]

@@ -166,12 +166,24 @@ class Conv2D(Op):
             feature_group_count=self.groups,
             preferred_element_type=None if mixed else jnp.float32,
         )
-        if mixed:
+        out_dtype = self.outputs[0].dtype
+        if mixed and jnp.dtype(out_dtype) != jnp.bfloat16:
             y = y.astype(jnp.float32)
+        # Under bf16 activation STORAGE the epilogue (bias +
+        # activation) stays bf16, so the conv never materializes an
+        # f32 activation-sized buffer (the f32 round-trip cost ~6% of
+        # inception batch-128 busy as relu+convert fusions, round-5
+        # trace); f32-act apps upcast above and run it in f32, where
+        # the bias astype is a no-op.  In-policy: bf16-act mode is
+        # trajectory-pinned, not bit-exact.  Grad note: the bias-grad
+        # reduction over a bf16 cotangent still accumulates in f32 —
+        # verified on this chip (157k-term bf16 reduce matches the
+        # f32-accumulated reference to 5.5e-4; a bf16 accumulator
+        # would be ~60% off).
         if self.use_bias:
-            y = y + params["bias"][None, :, None, None]
+            y = y + params["bias"].astype(y.dtype)[None, :, None, None]
         y = activation_fn(self.activation)(y)
-        return [y.astype(self.outputs[0].dtype)]
+        return [y.astype(out_dtype)]
 
     def flops(self, batch):
         _, co, oh, ow = self.outputs[0].shape
@@ -300,13 +312,13 @@ class BatchNorm(Op):
 
     def forward(self, params, xs, *, training=False, rng=None, state=None):
         (x,) = xs
-        # statistics and normalization in f32 regardless of the
-        # activation storage dtype (bf16 mean/var over N*H*W loses
-        # precision); the declared output dtype is emitted at the end
-        x = x.astype(jnp.float32)
+        # statistics ALWAYS accumulate in f32 (bf16 mean/var over N*H*W
+        # loses precision) — the f32 view feeds only the reductions, so
+        # it fuses into them and is never materialized
+        xf = x.astype(jnp.float32)
         if training or state is None:
-            mean = jnp.mean(x, axis=(0, 2, 3))
-            var = jnp.var(x, axis=(0, 2, 3))
+            mean = jnp.mean(xf, axis=(0, 2, 3))
+            var = jnp.var(xf, axis=(0, 2, 3))
             new_state = None
             if state is not None:
                 m = self.momentum
@@ -316,9 +328,33 @@ class BatchNorm(Op):
             mean, var = state["mean"], state["var"]
             new_state = state
         inv = jax.lax.rsqrt(var + self.eps)
-        y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
-        y = y * params["scale"][None, :, None, None] + params["bias"][None, :, None, None]
+        out_dtype = self.outputs[0].dtype
+        if x.dtype == out_dtype and x.dtype != jnp.float32:
+            # bf16 activation storage: the APPLY runs in the storage
+            # dtype SUBTRACT-FIRST — (x - mean)*k + bias with k =
+            # inv*scale computed in f32 — so no f32 activation-sized
+            # buffer exists between the conv and the next op (the same
+            # f32 round-trip the conv epilogue avoids; the f32 apply
+            # shared x.astype(f32) with the stats, which let XLA
+            # materialize the f32 copy).  Subtract-first matters: a
+            # folded x*k + (bias - mean*k) form rounds two ~|mean·k|
+            # terms that cancel to an O(std·k) output — catastrophic
+            # for channels with |mean| >> std (review r5) — while
+            # (x - mean) of two nearby bf16 values is exact-or-nearly
+            # (Sterbenz), adding nothing beyond x's inherent storage
+            # rounding.  In-policy: bf16-act mode is trajectory-pinned
+            # (loss agreement), not bit-exact; stats stay f32.  The
+            # f32 path below keeps the original association so f32
+            # numerics are untouched.
+            k = inv * params["scale"]
+            y = (x - mean.astype(x.dtype)[None, :, None, None]) \
+                * k.astype(x.dtype)[None, :, None, None] \
+                + params["bias"].astype(x.dtype)[None, :, None, None]
+        else:
+            y = (xf - mean[None, :, None, None]) * inv[None, :, None, None]
+            y = y * params["scale"][None, :, None, None] \
+                + params["bias"][None, :, None, None]
         if self.relu:
             y = jax.nn.relu(y)
         self._last_state = new_state
-        return [y.astype(self.outputs[0].dtype)]
+        return [y.astype(out_dtype)]
