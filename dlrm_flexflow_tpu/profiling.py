@@ -56,7 +56,15 @@ def device_fence(x):
                 if getattr(d, "ndim", None) == 0:
                     np.asarray(d)
                 elif getattr(d, "size", 0):
-                    np.asarray(d.ravel()[0])
+                    # index the first element — NOT d.ravel()[0]: ravel
+                    # of a tiled (R, 128) device array compiles to a
+                    # full-array re-tiling copy (1.25 ms device busy
+                    # for the kaggle table, ~7 ms for the 2 GB headline
+                    # table — round-5 trace, jit_ravel module), while a
+                    # first-element index is a ~2 us dynamic-slice with
+                    # the same fencing semantics (its transfer cannot
+                    # complete before d's producer has)
+                    np.asarray(d[(0,) * d.ndim])
                 else:  # zero-size shard: nothing to read, fall back
                     jax.block_until_ready(d)
         except (AttributeError, TypeError):
