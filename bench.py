@@ -365,13 +365,13 @@ def kaggle_inputs(cfg, batch: int, nb: int, seed: int = 0):
 
 # conv apps and their default activation STORAGE dtype (one constant so
 # the config mutation and the act_dtype anchor-key emit can't drift
-# apart).  Defaults are the paired-A/B winners (PERF.md round 4,
-# trace-busy measured, reproducible to ±0.1 ms): bf16 activations win
-# 21% on Inception (big spatial activations -> bandwidth dominates) and
-# LOSE 3% on AlexNet (small activations vs giant FC weights -> the
-# inserted converts cost more than the saved bytes; this also explains
-# the round-3 AlexNet regression, which tracked the bf16-act default).
-CONV_APPS = {"alexnet": "float32", "inception": "bfloat16"}
+# apart).  Defaults are the paired-A/B winners, trace-busy measured:
+# bf16 activations win 21% on Inception (big spatial activations ->
+# bandwidth dominates, PERF.md round 4) and — since the round-5 bf16
+# conv epilogues removed the f32 activation round-trips — now also win
+# 4.4% on AlexNet (busy 128.2 f32 vs 122.6 bf16; the round-4 f32 win
+# was the cost of the inserted converts, which no longer exist).
+CONV_APPS = {"alexnet": "bfloat16", "inception": "bfloat16"}
 
 
 def build_conv_app(app: str, batch: int, nb: int,
