@@ -30,6 +30,7 @@ task-per-op translation.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -1123,15 +1124,40 @@ class FFModel:
             implementation-defined on TPU (review r4)."""
             fl = parent.reshape(-1, parent.shape[-1])
             if pack > 1:
-                view = fl.reshape(fl.shape[0] // pack,
-                                  fl.shape[1] * pack)
-                out = view.at[rowof].set(
-                    cache_final.reshape(-1, fl.shape[1] * pack),
-                    mode="drop", indices_are_sorted=sorted_rowof)
-                return out.reshape(parent.shape)
-            return fl.at[rowof].set(
-                cache_final, mode="drop",
-                indices_are_sorted=sorted_rowof).reshape(parent.shape)
+                target = fl.reshape(fl.shape[0] // pack,
+                                    fl.shape[1] * pack)
+                vals = cache_final.reshape(-1, fl.shape[1] * pack)
+            else:
+                target, vals = fl, cache_final
+            # low-density writebacks take the per-row-DMA SET kernel:
+            # the scatter emitter RMW-sweeps the PARENT, so setting a
+            # few thousand rows of a GB-scale table costs the sweep
+            # (6.1 ms measured at the dlrm_hybrid epilogue) where row
+            # DMAs cost ~64 ns/row.  The static cost-model gate keeps
+            # the emitter everywhere else (ladder levels, dense
+            # epilogues); kernels don't partition under SPMD, so mesh
+            # compiles always use the emitter.  rowof rows are DISTINCT in
+            # every caller (dense-rank/region plans), which the kernel
+            # requires.  FF_ROW_SET_IMPL=emitter|kernel overrides.
+            from .ops.pallas_scatter import _row_set_pallas, row_set_wins
+            impl = os.environ.get("FF_ROW_SET_IMPL", "auto")
+            # eligibility is MANDATORY (the override only bypasses the
+            # cost model, review r5): no mesh (SPMD cannot partition a
+            # pallas_call), TPU backend, and Mosaic-lane-compatible
+            # rows (the kernel DMAs (1, d) row slices)
+            eligible = (mesh_ is None and backend == "tpu"
+                        and target.shape[1] % 128 == 0)
+            use_kernel = eligible and impl != "emitter" and (
+                impl == "kernel"
+                or row_set_wins(target.shape[0], target.shape[1],
+                                int(rowof.shape[0]),
+                                target.dtype.itemsize))
+            if use_kernel:
+                out = _row_set_pallas(target, rowof, vals)
+            else:
+                out = target.at[rowof].set(
+                    vals, mode="drop", indices_are_sorted=sorted_rowof)
+            return out.reshape(parent.shape)
 
         def _seg_fetch(parent, rowof, k, P, m):
             """Top-level block fetch against FIRST-TOUCH-SEGMENTED epoch

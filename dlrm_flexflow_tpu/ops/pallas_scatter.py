@@ -450,6 +450,93 @@ def packed_scatter_add(table, ids_flat, upd_flat):
     return view.at[q].add(packed).reshape(r, d)
 
 
+def _row_set_kernel(ids_ref, table_hbm, src_ref, out_hbm, sems,
+                    *, block: int, num_rows: int):
+    """Per-row SET: out[ids[k]] = src[k] for DISTINCT ids; sentinel
+    ids (>= num_rows) are dropped.  No fetch, no run accumulation —
+    the source block arrives in VMEM via the BlockSpec pipeline and
+    each live row leaves as one async DMA.  Distinctness is the
+    caller's contract (duplicate ids would race)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    base = blk * block
+
+    def wb(k):
+        return pltpu.make_async_copy(
+            src_ref.at[pl.ds(k, 1)],
+            out_hbm.at[pl.ds(ids_ref[base + k], 1)],
+            sems.at[k])
+
+    for k in range(block):
+        @pl.when(ids_ref[base + k] < num_rows)
+        def _():
+            wb(k).start()
+    for k in range(block):
+        @pl.when(ids_ref[base + k] < num_rows)
+        def _():
+            wb(k).wait()
+
+
+def _row_set_pallas(table, ids, rows, interpret=False):
+    """``table[ids[k]] = rows[k]`` for DISTINCT int32 ids (sentinel
+    >= R entries dropped), aliased in place — the low-density epilogue
+    writeback (round 5).  XLA's scatter emitter RMW-SWEEPS the parent
+    at a density-scaled useful rate, so setting 8k rows of a 2 GB
+    table costs ~6.1 ms (measured, dlrm_hybrid epilogue); per-row DMAs
+    pay ~64 ns/row instead and win whenever the touched rows are a
+    small fraction of the parent (the dispatch gate lives in
+    model.py's _cache_writeback)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, d = table.shape
+    n = ids.shape[0]
+    pad = (-n) % _BLOCK
+    if pad:
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad,), R, jnp.int32)])  # sentinel: dropped
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((pad, d), rows.dtype)])
+        n += pad
+    nblocks = n // _BLOCK
+    kern = functools.partial(_row_set_kernel, block=_BLOCK, num_rows=R)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # ids
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
+            pl.BlockSpec((_BLOCK, d), lambda b, ids: (b, 0)),  # rows
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased table
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_BLOCK,))],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={1: 0},  # table input -> output, in place
+        interpret=interpret,
+    )(ids.astype(jnp.int32), table, rows.astype(table.dtype))
+
+
+def row_set_wins(parent_rows: int, dim: int, n: int,
+                 itemsize: int) -> bool:
+    """Static dispatch gate for the set kernel vs the scatter emitter,
+    from the measured cost model (round 5): the emitter's scatter-set
+    costs ~max(parent RMW sweep at ~650 GB/s, ~15 ns/row issue) while
+    the kernel pays ~64 ns/row.  The kernel therefore wins only in the
+    sweep-bound low-density regime; a 2x margin keeps the emitter
+    wherever the call is close.  Checked against three measured points:
+    dlrm_hybrid epilogue (8.2k rows / 2 GB parent: kernel, measured
+    emitter 6.1 ms vs model 6.3), kaggle (26.6k / 411 MB: emitter) and
+    the headline (1M / 2 GB: emitter)."""
+    kernel_ns = n * 64.0 * 2.0
+    sweep_ns = parent_rows * dim * itemsize * 2.0 / 650.0
+    return kernel_ns < sweep_ns
+
+
 def supports_pallas_row_update(num_rows: int, dim: int, n: int) -> bool:
     """Static eligibility of the kernel for a (num_rows, dim) table with
     ``n`` updates per step (Mosaic needs 128-lane rows; narrower dims are

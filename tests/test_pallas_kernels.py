@@ -232,3 +232,40 @@ class TestPackedViewOnCPU:
         readded = packed_scatter_add(zeroed, ids, rows)
         np.testing.assert_allclose(np.asarray(readded), np.asarray(table),
                                    rtol=1e-6, atol=1e-6)
+
+
+class TestRowSetKernel:
+    """The low-density epilogue SET kernel (round 5): out[ids] = rows
+    for distinct ids, sentinel entries dropped, aliased in place —
+    must be BIT-identical to the emitter scatter-set it replaces."""
+
+    @pytest.mark.parametrize("n,rows_n,seed", [
+        (32, 4096, 0),       # _BLOCK-multiple, sparse touch
+        (40, 4096, 1),       # needs sentinel padding to a block multiple
+        (16, 64, 2),         # dense-ish touch
+        (48, 4096, 3),       # sentinel holes interleaved at the tail
+    ])
+    def test_matches_emitter_set(self, n, rows_n, seed):
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import _row_set_pallas
+
+        rng = np.random.default_rng(seed)
+        table = jnp.asarray(
+            rng.standard_normal((rows_n, 128)).astype(np.float32))
+        live = rng.choice(rows_n, size=n - n // 4, replace=False)
+        ids = np.full((n,), rows_n, np.int32)      # sentinel-padded tail
+        ids[:live.size] = np.sort(live)
+        vals = jnp.asarray(rng.standard_normal((n, 128)).astype(np.float32))
+        got = _row_set_pallas(table, jnp.asarray(ids), vals,
+                              interpret=True)
+        want = table.at[jnp.asarray(ids)].set(vals, mode="drop")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_dispatch_gate_cost_model(self):
+        """row_set_wins reproduces the three measured round-5 points:
+        hybrid epilogue -> kernel, kaggle and headline -> emitter."""
+        from dlrm_flexflow_tpu.ops.pallas_scatter import row_set_wins
+        assert row_set_wins(4_000_000, 128, 8_192, 4)        # hybrid
+        assert not row_set_wins(804_024, 128, 26_624, 4)     # kaggle
+        assert not row_set_wins(4_000_000, 128, 1_048_576, 4)  # headline
