@@ -92,6 +92,72 @@ def _emit(metric, thpt, key, extra=None):
     }))
 
 
+def _telemetry_ctx(app):
+    """Scoped EventLog for one bench run, written next to
+    bench_history.json as ``telemetry_<app>.jsonl`` (mode="w": one file
+    per run — the BENCH json's sibling).  ``BENCH_TELEMETRY`` overrides
+    the path ("0"/"off"/"none"/"false"/"no" disables and yields a null
+    context; "1"/"on"/"true"/"yes" just enables the default path —
+    switches, not filenames)."""
+    import contextlib
+
+    p = os.environ.get("BENCH_TELEMETRY", "")
+    if p.strip().lower() in ("0", "off", "none", "false", "no"):
+        return contextlib.nullcontext()
+    if p.strip().lower() in ("1", "on", "true", "yes"):
+        p = ""
+    if not p:
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f"telemetry_{app}.jsonl")
+    from dlrm_flexflow_tpu.telemetry import event_log
+
+    return event_log(path=p, mode="w")
+
+
+def _telemetry_tail(model, state, inputs, thpt, probe_us,
+                    batch, nb, epochs):
+    """Post-timing telemetry: the best fenced window as one ``step``
+    event, per-op measured-vs-analytic times (``op_time`` via OpTimer),
+    and one simulator calibration fit against the measured per-step
+    time — the report CLI's per-op table and sim-vs-measured summary.
+    Everything runs AFTER the timed windows (it cannot perturb the
+    measurement) and no-ops when telemetry is off."""
+    from dlrm_flexflow_tpu.telemetry import active_log, sample_memory
+
+    log = active_log()
+    if log is None:
+        return
+    best_t = epochs * nb * batch / float(thpt)
+    try:  # ALL telemetry is best-effort provenance: a sink I/O failure
+        # must never discard the completed measurement (the history
+        # append + JSON line print happen after this function returns)
+        log.emit("step", wall_s=best_t, samples=epochs * nb * batch,
+                 samples_per_s=float(thpt), steps=nb, epochs=epochs,
+                 fenced=True, phase="bench_window",
+                 probe_us=round(float(probe_us), 1))
+        sample_memory(phase="bench")
+    except Exception as e:
+        print(f"# window/memory telemetry failed: {e!r}", file=sys.stderr)
+    try:  # per-op isolated timing is best-effort provenance
+        from dlrm_flexflow_tpu.profiling import OpTimer
+
+        OpTimer(model, iters=int(os.environ.get("BENCH_OPTIMER_ITERS",
+                                                3))).profile(state, inputs)
+    except Exception as e:
+        print(f"# op-time telemetry failed: {e!r}", file=sys.stderr)
+    try:  # one calibration fit: simulated step vs the measured one
+        import jax
+
+        from dlrm_flexflow_tpu.sim.search import data_parallel_strategy
+        from dlrm_flexflow_tpu.sim.simulator import Simulator
+
+        n = jax.device_count()
+        Simulator(model, n).calibrate(data_parallel_strategy(model, n),
+                                      best_t / float(epochs * nb))
+    except Exception as e:
+        print(f"# sim-calibration telemetry failed: {e!r}", file=sys.stderr)
+
+
 def _probe_us():
     """Fenced 1024^3 bf16 matmul time in us — ~15us on a quiet v5e chip;
     >~200us means a noisy neighbor is degrading the shared chip and any
@@ -185,36 +251,48 @@ def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
             state, _ = model.train_epoch(state, inputs, labels)
         return state
 
-    state = window(state)  # warmup/compile
+    # warmup/compile runs with the log ACTIVE: this is where the window
+    # program's XLA compiles happen — the dominant compile events the
+    # telemetry JSONL exists to record ("every compile the run paid")
+    state = window(state)
     device_fence(state.step)
+
+    # producers silent INSIDE the timed windows: the train_epoch(s)
+    # wrappers would otherwise emit+flush step/memory events between t0
+    # and the fence, perturbing the measurement the telemetry exists to
+    # record (the window summary is emitted by _telemetry_tail; compiles
+    # already happened in the unsuppressed warmup above)
+    from dlrm_flexflow_tpu.telemetry import suppressed
 
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 600.0))
     deadline = time.monotonic() + budget
     best_any = (float("inf"), float("inf"))    # (dt, probe)
     best_quiet = None                          # best among CLEAN windows
     n_windows = 0
-    while True:
-        pre = _probe_us()
-        t0 = time.perf_counter()
-        state = window(state)
-        device_fence(state.step)
-        dt = time.perf_counter() - t0
-        post = _probe_us()
-        probe = max(pre, post)  # window is clean only if quiet on both ends
-        n_windows += 1
-        if dt < best_any[0]:
-            best_any = (dt, probe)
-        if probe <= _QUIET_US and (best_quiet is None or dt < best_quiet[0]):
-            best_quiet = (dt, probe)
-        if n_windows >= reps:
-            # one clean window is enough — a clean measurement can only be
-            # beaten by jitter, never by contention
-            if best_quiet is not None or time.monotonic() >= deadline:
-                break
-            # contended so far: wait out the noisy neighbor, then resample
-            time.sleep(min(20.0, max(deadline - time.monotonic(), 0)))
-            if time.monotonic() >= deadline:
-                break
+    with suppressed():
+        while True:
+            pre = _probe_us()
+            t0 = time.perf_counter()
+            state = window(state)
+            device_fence(state.step)
+            dt = time.perf_counter() - t0
+            post = _probe_us()
+            probe = max(pre, post)  # clean only if quiet on both ends
+            n_windows += 1
+            if dt < best_any[0]:
+                best_any = (dt, probe)
+            if probe <= _QUIET_US and (best_quiet is None
+                                       or dt < best_quiet[0]):
+                best_quiet = (dt, probe)
+            if n_windows >= reps:
+                # one clean window is enough — a clean measurement can
+                # only be beaten by jitter, never by contention
+                if best_quiet is not None or time.monotonic() >= deadline:
+                    break
+                # contended so far: wait out the noisy neighbor, resample
+                time.sleep(min(20.0, max(deadline - time.monotonic(), 0)))
+                if time.monotonic() >= deadline:
+                    break
     best_t, best_probe = best_quiet if best_quiet is not None else best_any
     # Trace-derived device-busy time for ONE window (judge r3 item 6):
     # the wall-clock above is a queue lottery on the shared tunneled chip
@@ -229,10 +307,11 @@ def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
         def _traced():
             device_fence(window(state).step)
 
-        try:
-            busy_ms = round(traced_device_busy_ms(_traced), 3)
-        except Exception as e:  # tracing is best-effort provenance
-            print(f"# device-busy trace failed: {e!r}", file=sys.stderr)
+        with suppressed():  # profiling rerun, not a train window
+            try:
+                busy_ms = round(traced_device_busy_ms(_traced), 3)
+            except Exception as e:  # tracing is best-effort provenance
+                print(f"# device-busy trace failed: {e!r}", file=sys.stderr)
     prov = {"device_busy_ms": busy_ms}
     # XLA cost-analysis bytes of the window program (feeds hbm_util_pct;
     # judge r4 item 5).  Lowering does not execute, so donated buffers
@@ -308,6 +387,8 @@ def main():
     thpt, probe_us, prov = _windows(
         model, state, inputs, labels, batch, num_batches, epochs, reps,
         place=not os.environ.get("BENCH_HOST_INPUTS"))
+    _telemetry_tail(model, state, inputs, thpt, probe_us,
+                    batch, num_batches, epochs)
     # vs_baseline: FIRST fenced history entry of the same config is the
     # anchor, so improvements accumulate instead of drifting with the
     # previous run's noise (the reference publishes no numbers,
@@ -523,6 +604,8 @@ def bench_app(app: str):
     state = model.init(seed=0)
     thpt, probe_us, prov = _windows(model, state, inputs, labels, batch,
                                     nb, epochs, reps)
+    _telemetry_tail(model, state, inputs, thpt, probe_us,
+                    batch, nb, epochs)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1), **prov,
              **_mfu_extras(model, batch, epochs * nb, prov)}
@@ -562,4 +645,7 @@ def bench_app(app: str):
 
 if __name__ == "__main__":
     app = os.environ.get("BENCH_APP", "dlrm")
-    sys.exit(main() if app == "dlrm" else bench_app(app))
+    # the EventLog scopes the WHOLE run so the jax.monitoring hooks see
+    # every compile (warmup, AOT window builds, OpTimer's isolated jits)
+    with _telemetry_ctx(app):
+        sys.exit(main() if app == "dlrm" else bench_app(app))

@@ -108,6 +108,15 @@ class MetricsAccumulator:
             parts.append(f"mae_loss: {self.totals['mae'] / n:.3f}")
         return "[Metrics] " + " ".join(parts) if parts else "[Metrics] (none)"
 
+    def finalized_means(self) -> Dict[str, float]:
+        """Host-synced per-sample means of the accumulated sums, plus the
+        raw ``train_all`` count — the ``metrics`` payload of telemetry
+        ``step`` events (docs/telemetry.md).  Call only after the step's
+        device work is fenced: finalizing syncs the scalar totals."""
+        totals, n = self._finalized()
+        return {k: (v if k == "train_all" else v / n)
+                for k, v in totals.items()}
+
     def get_accuracy(self) -> float:
         """Training accuracy in percent (reference
         PerfMetrics::get_accuracy used by VerifyMetrics callbacks)."""

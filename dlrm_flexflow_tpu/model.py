@@ -51,6 +51,7 @@ from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
 from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
                             param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
+from .telemetry import active_log, sample_memory
 from .tensor import Tensor, as_dtype
 
 
@@ -618,11 +619,13 @@ class FFModel:
         # the mid level saves no HBM gather issues (the fetch row count
         # per epoch is the occurrence count either way) while adding
         # its own S(1) rebuild gather + dus layer — measured busy
-        # 185.0 -> 171.6 ms at the headline, bench-recorded 171.5 (round 5).  cache_prologue
-        # sets the flag before any ladder_sizes consumer runs; mixed
-        # eligibility keeps the two-level shape so non-region ops
-        # never rebuild straight from the table every 8 steps.
-        ladder_ctx = {"region_single": False}
+        # 185.0 -> 171.6 ms at the headline, bench-recorded 171.5
+        # (round 5).  cache_prologue decides the flag once per trace and
+        # THREADS IT EXPLICITLY through every ladder_sizes consumer
+        # (advisor r5: the previous mutable-closure read relied on trace
+        # ordering); mixed eligibility keeps the two-level shape so
+        # non-region ops never rebuild straight from the table every 8
+        # steps.
         if not hasattr(self, "_orig_out_dtypes"):
             self._orig_out_dtypes = {}
         for op in self.layers:
@@ -1147,6 +1150,14 @@ class FFModel:
             # rows (the kernel DMAs (1, d) row slices)
             eligible = (mesh_ is None and backend == "tpu"
                         and target.shape[1] % 128 == 0)
+            # rowof.shape[0] is the PADDED plan length (sentinel holes
+            # included: lane-pack pad, segmented interleave) — the live
+            # distinct-row count is data-dependent and not static here,
+            # so the gate sees an upper bound on the kernel's row DMAs.
+            # The slack only overstates kernel cost (sentinel rows issue
+            # no DMA at runtime), so near the threshold the dispatch
+            # errs toward the proven emitter path — conservative by
+            # construction (advisor r5; see row_set_wins).
             use_kernel = eligible and impl != "emitter" and (
                 impl == "kernel"
                 or row_set_wins(target.shape[0], target.shape[1],
@@ -1234,9 +1245,12 @@ class FFModel:
             and pull the touched rows in with one table sweep (plus, in
             lazy mode, the optimizer slot tables — same rowof, same
             slots).  Returns (state-with-caches, slots, writebacks,
-            originals, region_src); ``writebacks`` entries are
-            (name, tb_shape, rowof, wpack, sorted_ok, final_src) with
-            final_src None outside region mode."""
+            originals, region_src, region_single); ``writebacks`` entries
+            are (name, tb_shape, rowof, wpack, sorted_ok, final_src) with
+            final_src None outside region mode.  ``region_single`` (every
+            cache op engaged the region layout — the ladder-collapse
+            flag) is decided HERE, once per trace, and threaded
+            explicitly into every ``ladder_sizes`` consumer."""
             from .ops.pallas_scatter import use_packed_view
             view_mode = _validated_epoch_cache_view(self.config)
             # "on" still requires no mesh (under SPMD the view fights
@@ -1261,14 +1275,13 @@ class FFModel:
                     op, inputs[id_name[op.name]].astype(jnp.int32),
                     int(np.prod(params[op.name]["embedding"].shape[:-1])))
                 for op in cache_ops}
-            ladder_ctx["region_single"] = bool(region_ok) and all(
-                region_ok.values())
+            region_single = bool(region_ok) and all(region_ok.values())
             for op in cache_ops:
                 ids = inputs[id_name[op.name]].astype(jnp.int32)
                 tb = params[op.name]["embedding"]
                 flat = tb.reshape(-1, tb.shape[-1])
                 nb = ids.shape[0]
-                reg = (_region_layout(op, flat, ids, nb)
+                reg = (_region_layout(op, flat, ids, nb, region_single)
                        if region_ok[op.name] else None)
                 if reg is not None:
                     cache, slots, rinfo, final_rowof, final_src, \
@@ -1291,7 +1304,7 @@ class FFModel:
                                     op_pack[op.name], view_ok,
                                     storage=op.storage_pack,
                                     seg_blocks=_seg_blocks_for(
-                                        ids.shape[0]))
+                                        ids.shape[0], region_single))
                 if built is None:
                     # cache would be as big as the table — no win; keep
                     # this op on the direct per-step path
@@ -1312,7 +1325,8 @@ class FFModel:
                             fl, r, p))
             state = TrainState(params, opt_state, state.bn_state,
                                state.rng, state.step)
-            return state, slots_ep, writebacks, originals, region_src
+            return (state, slots_ep, writebacks, originals, region_src,
+                    region_single)
 
         def _region_engages(op, ids, parent_rows):
             """Size/flag gate of the region layout — everything that
@@ -1353,15 +1367,16 @@ class FFModel:
                 return False
             return True
 
-        def _region_layout(op, flat, ids, nb):
+        def _region_layout(op, flat, ids, nb, region_single):
             """Block-major region layout for the epoch cache
             (FFConfig.epoch_cache_regions; ops/slotting.py::region_plan
             for the design), or None when the ladder shape does not
             support it (the size/flag gate is the caller's region_ok —
-            computed ONCE per op in cache_prologue).  Returns
+            computed ONCE per op in cache_prologue, which also decides
+            ``region_single``).  Returns
             (cache, slots, src, final_rowof, final_src, rowof_all)."""
             sp = op.storage_pack
-            sizes = ladder_sizes(nb)
+            sizes = ladder_sizes(nb, region_single)
             top = sizes[0] if sizes else 0
             if not (0 < top < nb and nb % top == 0):
                 return None
@@ -1424,7 +1439,7 @@ class FFModel:
                     "base": jnp.arange(nblk, dtype=jnp.int32) * m_occ}
             return cache, slots, info, final_rowof, final_src, rowof_all
 
-        def ladder_sizes(nb):
+        def ladder_sizes(nb, region_single):
             """Static block sizes of the in-graph cache ladder for an
             nb-step scan, outermost first.  "auto" is the shallow
             two-level shape [8*inner, inner] (round-4 measurement — see
@@ -1434,7 +1449,13 @@ class FFModel:
             divide nb, auto falls back to [geometric mid, inner], and
             when ``epoch_cache_inner`` <= 1 to a chunk-sized single
             level.  ``epoch_cache_levels`` overrides: "off" disables the
-            ladder, a comma list (or tuple) names explicit sizes."""
+            ladder, a comma list (or tuple) names explicit sizes.
+
+            ``region_single`` is cache_prologue's every-cache-op-engaged-
+            regions decision, passed EXPLICITLY (advisor r5: this used to
+            be a mutable closure flag set mid-trace, so a consumer that
+            ran before the prologue would silently read a stale value and
+            pick a ladder shape inconsistent with the region plans)."""
             cfg_levels = getattr(self.config, "epoch_cache_levels", "auto")
             if cfg_levels in ("off", "", None):
                 return []
@@ -1463,7 +1484,7 @@ class FFModel:
             # only adds its own S(1) rebuild + dus layer: the ladder
             # collapses to [inner] (busy 185.0 -> 171.6 ms, bench-recorded 171.5, round 5).
             if 0 < inner < nb:
-                if ladder_ctx["region_single"] and nb % inner == 0:
+                if region_single and nb % inner == 0:
                     return [inner]
                 top = inner * 8
                 if top < nb and nb % top == 0:
@@ -1490,14 +1511,14 @@ class FFModel:
                 return [chunk]
             return []
 
-        def _seg_blocks_for(nb):
+        def _seg_blocks_for(nb, region_single):
             """K for first-touch-segmented epoch slots: the top ladder
             level's block count, or 1 when no level engages (then
             nothing exploits segmentation, so plain dense-rank slotting
             keeps the prologue cheapest)."""
             if not seg_enabled:
                 return 1
-            sizes = ladder_sizes(nb)
+            sizes = ladder_sizes(nb, region_single)
             if not sizes:
                 return 1
             top = sizes[0]
@@ -1505,7 +1526,7 @@ class FFModel:
                 return nb // top
             return 1
 
-        def ladder_meta(nb, slots_ep, rows0):
+        def ladder_meta(nb, slots_ep, rows0, region_single):
             """Static ladder plan [(size, {op: cache rows}), ...]: at
             each level every op whose padded block cache would be
             smaller than its current parent cache participates; a level
@@ -1515,7 +1536,7 @@ class FFModel:
             packed-storage ops, logical rows otherwise — matching the
             actual cache arrays' shape[0] at every level."""
             meta, rows, cur = [], dict(rows0), nb
-            for size in ladder_sizes(nb):
+            for size in ladder_sizes(nb, region_single):
                 if not (0 < size < cur and cur % size == 0):
                     continue
                 part = {}
@@ -1534,7 +1555,8 @@ class FFModel:
                     cur = size
             return meta
 
-        def ladder_arrays(slots, meta, rows, top=True, region_src=None):
+        def ladder_arrays(slots, meta, rows, top=True, region_src=None,
+                          region_single=False):
             """The ladder's slot plans, precomputed OUTSIDE the scans
             (the slot math — ops/slotting.py sorts — depends only on the
             epoch's ids, so under ``train_epochs`` it runs once for ALL
@@ -1605,7 +1627,7 @@ class FFModel:
                 for name in part:
                     n_occ = int(np.prod(slots[name].shape))
                     if (op_storage[name] > 1
-                            and nblk == _seg_blocks_for(nb)
+                            and nblk == _seg_blocks_for(nb, region_single)
                             and part[name] * nblk == n_occ):
                         ro = arrs["rowof"][name]  # (nblk, m)
                         base = (jnp.arange(nblk, dtype=jnp.int32)
@@ -1721,13 +1743,14 @@ class FFModel:
                       for k, v in mets.items()}
             return state, folded
 
-        def ladder_plan(state, slots_ep, nb, region_src=None):
+        def ladder_plan(state, slots_ep, nb, region_src=None,
+                        region_single=False):
             """(meta, arrays) of the in-graph ladder, or ({}, None)."""
             if not slots_ep:
                 return [], None
             rows0 = {name: state.params[name]["embedding"].shape[0]
                      for name in slots_ep}
-            meta = ladder_meta(nb, slots_ep, rows0)
+            meta = ladder_meta(nb, slots_ep, rows0, region_single)
             if not meta:
                 return [], None
             if region_src:
@@ -1749,7 +1772,8 @@ class FFModel:
                                 == top // meta[1][0]), \
                             (name, info["inner"]["src"].shape, meta)
             return meta, ladder_arrays(slots_ep, meta, rows0,
-                                       region_src=region_src)
+                                       region_src=region_src,
+                                       region_single=region_single)
 
         def cache_epilogue(state, writebacks, originals):
             """Write the final rows back, each live slot exactly once
@@ -1792,10 +1816,10 @@ class FFModel:
             dispatch.  ``inputs``: dict name -> (nb, batch, ...) stacked
             batches resident on device; ``labels``: (nb, batch, ...).
             """
-            state, slots_ep, writebacks, orig, rsrc = cache_prologue(
-                state, inputs)
+            state, slots_ep, writebacks, orig, rsrc, rsingle = \
+                cache_prologue(state, inputs)
             meta, arrs = ladder_plan(state, slots_ep, labels.shape[0],
-                                     rsrc)
+                                     rsrc, rsingle)
             state, folded = epoch_scan(state, inputs, labels, slots_ep,
                                        meta, arrs)
             return cache_epilogue(state, writebacks, orig), folded
@@ -1810,10 +1834,10 @@ class FFModel:
             across epochs performs the same adds on the same values.
             Returns per-epoch folded metrics stacked on a leading
             (n_epochs,) axis."""
-            state, slots_ep, writebacks, orig, rsrc = cache_prologue(
-                state, inputs)
+            state, slots_ep, writebacks, orig, rsrc, rsingle = \
+                cache_prologue(state, inputs)
             meta, arrs = ladder_plan(state, slots_ep, labels.shape[0],
-                                     rsrc)
+                                     rsrc, rsingle)
 
             def ep_body(st, _):
                 return epoch_scan(st, inputs, labels, slots_ep, meta, arrs)
@@ -1823,6 +1847,7 @@ class FFModel:
             return cache_epilogue(state, writebacks, orig), stacked
 
         donate = (0,) if donate_state else ()
+        self._donate_argnums = donate  # telemetry: compile-event stats
         self._train_step = jax.jit(train_step, donate_argnums=donate)
         self._train_epoch = jax.jit(train_epoch, donate_argnums=donate)
         self._train_epochs = jax.jit(train_epochs, donate_argnums=donate,
@@ -2004,10 +2029,24 @@ class FFModel:
         ``_run_epoch_chunks``).
         """
         inputs, labels = self.place_dataset(inputs, labels)
+        log = active_log()
+        t0 = time.perf_counter()
         bounds = self._epoch_chunk_bounds(labels.shape[0])
         if bounds is None:
-            return self._train_epoch(state, inputs, labels)
-        return self._run_epoch_chunks(state, inputs, labels, bounds)
+            out = self._train_epoch(state, inputs, labels)
+        else:
+            out = self._run_epoch_chunks(state, inputs, labels, bounds)
+        if log is not None:
+            # dispatch-only wall (fenced=False): the scan returns before
+            # the device finishes; fenced walls come from fit/bench which
+            # own the device_fence.  No device values are read here — a
+            # host sync per epoch would serialize dispatch.
+            nb = int(labels.shape[0])
+            log.emit("step", wall_s=time.perf_counter() - t0,
+                     samples=nb * int(labels.shape[1]), steps=nb,
+                     fenced=False, phase="train_epoch")
+            sample_memory(phase="train_epoch", log=log)
+        return out
 
     def train_epochs(self, state: TrainState, inputs: Dict[str, Any],
                      labels, epochs: int):
@@ -2019,16 +2058,29 @@ class FFModel:
         per-epoch dispatches for chunked epochs.  Returns per-epoch
         folded metrics stacked on a leading (epochs,) axis."""
         inputs, labels = self.place_dataset(inputs, labels)
+        log = active_log()
+        t0 = time.perf_counter()
         bounds = self._epoch_chunk_bounds(labels.shape[0])
         if bounds is None:
-            return self._train_epochs(state, inputs, labels, int(epochs))
-        mets = []
-        for _ in range(int(epochs)):
-            state, m = self._run_epoch_chunks(state, inputs, labels, bounds)
-            mets.append(m)
-        stacked = {k: np.stack([np.asarray(m[k]) for m in mets])
-                   for k in mets[0]}
-        return state, stacked
+            out = self._train_epochs(state, inputs, labels, int(epochs))
+        else:
+            mets = []
+            for _ in range(int(epochs)):
+                state, m = self._run_epoch_chunks(state, inputs, labels,
+                                                  bounds)
+                mets.append(m)
+            stacked = {k: np.stack([np.asarray(m[k]) for m in mets])
+                       for k in (mets[0] if mets else ())}
+            out = (state, stacked)
+        if log is not None:
+            # dispatch-only wall — see train_epoch's emission
+            nb = int(labels.shape[0])
+            log.emit("step", wall_s=time.perf_counter() - t0,
+                     samples=int(epochs) * nb * int(labels.shape[1]),
+                     steps=nb, epochs=int(epochs), fenced=False,
+                     phase="train_epochs")
+            sample_memory(phase="train_epochs", log=log)
+        return out
 
     def _epoch_chunk_bounds(self, nb: int):
         """(lo, hi) chunk slices for a chunked epoch dispatch, or None
@@ -2218,6 +2270,22 @@ class FFModel:
             first = dataloader.peek()
             state, _ = self.train_step(state, first[0], first[1])
             device_fence(state.step)
+        def aot_compile(fn_name, build):
+            """One explicit lower().compile() with its wall time and
+            donated-argument count recorded as a ``compile`` telemetry
+            event (the jax.monitoring hook sees the same compile as a
+            bare backend_compile; this event adds the attribution)."""
+            tc = time.perf_counter()
+            exe = build()
+            log = active_log()
+            if log is not None:
+                log.emit("compile", kind="aot", fn=fn_name,
+                         duration_s=time.perf_counter() - tc,
+                         donated_args=len(getattr(self, "_donate_argnums",
+                                                  ())),
+                         backend=jax.default_backend())
+            return exe
+
         scan_fn, chunk_bounds, chunk_aot, fused_fn = None, None, None, None
         if scan_data is not None:
             # AOT-compile the scanned epoch outside the timed window (the
@@ -2228,10 +2296,15 @@ class FFModel:
                 # no per-epoch host work pending: fuse ALL epochs into ONE
                 # dispatch (train_epochs) — launch overhead + row-cache
                 # sweeps amortize over the whole run
-                fused_fn = self._train_epochs.lower(
-                    state, *scan_data, epochs).compile()
+                fused_fn = aot_compile(
+                    "train_epochs",
+                    lambda: self._train_epochs.lower(
+                        state, *scan_data, epochs).compile())
             elif chunk_bounds is None:
-                scan_fn = self._train_epoch.lower(state, *scan_data).compile()
+                scan_fn = aot_compile(
+                    "train_epoch",
+                    lambda: self._train_epoch.lower(state,
+                                                    *scan_data).compile())
             else:
                 # chunked epoch (epoch row-cache): precompile each
                 # distinct chunk shape
@@ -2239,14 +2312,21 @@ class FFModel:
                 chunk_aot = {}
                 for lo, hi in chunk_bounds:
                     if hi - lo not in chunk_aot:
-                        chunk_aot[hi - lo] = self._train_epoch.lower(
-                            state, {k: v[lo:hi] for k, v in sin.items()},
-                            slab[lo:hi]).compile()
+                        chunk_aot[hi - lo] = aot_compile(
+                            f"train_epoch[chunk={hi - lo}]",
+                            lambda lo=lo, hi=hi: self._train_epoch.lower(
+                                state,
+                                {k: v[lo:hi] for k, v in sin.items()},
+                                slab[lo:hi]).compile())
         t0 = time.perf_counter()
         samples = 0
+        epochs_run = int(epochs)  # early stop shortens the per-epoch loop
+        last_loss = None          # final epoch's folded loss (step event)
         if fused_fn is not None:
             # single-dispatch multi-epoch run (no callbacks to honor)
             state, stacked = fused_fn(state, *scan_data)
+            if "loss" in stacked and epochs > 0:
+                last_loss = stacked["loss"][-1]
             samples = epochs * dataloader.num_batches * dataloader.batch_size
             for epoch in range(epochs):
                 acc.reset()
@@ -2270,6 +2350,7 @@ class FFModel:
                     state, mets = scan_fn(state, *scan_data)
                 samples += dataloader.num_batches * dataloader.batch_size
                 acc.update({k: v for k, v in mets.items() if k != "loss"})
+                last_loss = mets.get("loss", last_loss)
             else:
                 for it, (inputs, labels) in enumerate(dataloader):
                     for cb in cbs:
@@ -2278,6 +2359,7 @@ class FFModel:
                     samples += int(labels.shape[0])
                     acc.update({k: v for k, v in mets.items()
                                 if k != "loss"})
+                    last_loss = mets.get("loss", last_loss)
                     for cb in cbs:
                         cb.on_batch_end(it)
             self._fit_state = state
@@ -2289,10 +2371,25 @@ class FFModel:
                     early_stop = True
             if early_stop:
                 print(f"Accuracy reached, early stop, epoch: {epoch}")
+                epochs_run = epoch + 1
                 break
         device_fence(state.step)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
+        log = active_log()
+        if log is not None:
+            # fenced=True: the device_fence above guarantees this wall
+            # covers real device-complete work (PERF.md timing protocol).
+            # metrics are the FINAL epoch's per-sample means (acc resets
+            # each epoch), while wall_s/samples span the whole run —
+            # documented in docs/telemetry.md; finalized_means() performs
+            # the host sync (safe: the fence above already drained)
+            log.emit("step", wall_s=elapsed, samples=int(samples),
+                     samples_per_s=thpt, epochs=epochs_run, fenced=True,
+                     phase="fit", metrics=acc.finalized_means(),
+                     loss=(float(np.asarray(last_loss))
+                           if last_loss is not None else None))
+            sample_memory(phase="fit", log=log)
         if verbose and show_throughput:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thpt:.2f} samples/s")
         # trained state is recoverable even if a verify callback raises

@@ -15,11 +15,30 @@ equivalent of the reference's per-op strategy map consumed by the FFMapper
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 
 from ..tensor import ParameterSpec, Tensor
+
+
+def _named_scope_forward(fwd):
+    """Wrap a subclass ``forward`` in ``jax.named_scope(self.name)`` so
+    XLA op metadata (and therefore jax.profiler XPlane traces viewed in
+    TensorBoard/Perfetto) attributes device time back to the FRAMEWORK
+    op name — the analogue of the reference's per-op Legion profiler
+    attribution (telemetry tentpole; docs/telemetry.md).  Trace-time
+    only: the scope shapes HLO metadata and adds zero runtime work."""
+    @functools.wraps(fwd)
+    def wrapper(self, *args, **kwargs):
+        import jax
+
+        with jax.named_scope(self.name):
+            return fwd(self, *args, **kwargs)
+
+    wrapper.__named_scope_wrapped__ = True
+    return wrapper
 
 
 def part_coords(pc, ndim: int, idx: int):
@@ -59,6 +78,19 @@ class Op:
 
     #: class-level default op-type string (reference uses OperatorType enum)
     op_type: str = "op"
+
+    def __init_subclass__(cls, **kwargs):
+        # every subclass's forward runs under jax.named_scope(op.name)
+        # (trace attribution — see _named_scope_forward); wrapping here
+        # covers EVERY forward call site (model._apply, the compat
+        # bindings' imperative verbs, OpTimer's isolated jits) without
+        # each having to remember the scope.  Subclasses that inherit
+        # forward unchanged are already covered by their parent's wrap.
+        super().__init_subclass__(**kwargs)
+        fwd = cls.__dict__.get("forward")
+        if fwd is not None and not getattr(fwd, "__named_scope_wrapped__",
+                                           False):
+            cls.forward = _named_scope_forward(fwd)
 
     def __init__(self, name: str, inputs: Sequence[Tensor]):
         self.name = name

@@ -452,11 +452,17 @@ def packed_scatter_add(table, ids_flat, upd_flat):
 
 def _row_set_kernel(ids_ref, table_hbm, src_ref, out_hbm, sems,
                     *, block: int, num_rows: int):
-    """Per-row SET: out[ids[k]] = src[k] for DISTINCT ids; sentinel
-    ids (>= num_rows) are dropped.  No fetch, no run accumulation —
-    the source block arrives in VMEM via the BlockSpec pipeline and
-    each live row leaves as one async DMA.  Distinctness is the
-    caller's contract (duplicate ids would race)."""
+    """Per-row SET: out[ids[k]] = src[k] for DISTINCT ids; out-of-range
+    ids (< 0 or >= num_rows) are dropped (advisor r5: the previous
+    >= num_rows-only predicate would have issued an out-of-bounds HBM
+    DMA for a negative id).  Callers never produce negative ids — the
+    writeback plans pad with sentinel R — so bit-identity with the
+    emitter path holds on all real inputs; the lower bound is the
+    defensive guard (note jnp's ``mode="drop"`` python-WRAPS -1 to the
+    last row, which a corrupt id must not silently do either).  No
+    fetch, no run accumulation — the source block arrives in VMEM via
+    the BlockSpec pipeline and each live row leaves as one async DMA.
+    Distinctness is the caller's contract (duplicate ids would race)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -469,12 +475,15 @@ def _row_set_kernel(ids_ref, table_hbm, src_ref, out_hbm, sems,
             out_hbm.at[pl.ds(ids_ref[base + k], 1)],
             sems.at[k])
 
+    def live(k):
+        return (ids_ref[base + k] >= 0) & (ids_ref[base + k] < num_rows)
+
     for k in range(block):
-        @pl.when(ids_ref[base + k] < num_rows)
+        @pl.when(live(k))
         def _():
             wb(k).start()
     for k in range(block):
-        @pl.when(ids_ref[base + k] < num_rows)
+        @pl.when(live(k))
         def _():
             wb(k).wait()
 
@@ -497,6 +506,7 @@ def _row_set_pallas(table, ids, rows, interpret=False):
     if pad:
         ids = jnp.concatenate(
             [ids, jnp.full((pad,), R, jnp.int32)])  # sentinel: dropped
+        # (negative ids are dropped too — same mode="drop" semantics)
         rows = jnp.concatenate(
             [rows, jnp.zeros((pad, d), rows.dtype)])
         n += pad
@@ -531,7 +541,12 @@ def row_set_wins(parent_rows: int, dim: int, n: int,
     wherever the call is close.  Checked against three measured points:
     dlrm_hybrid epilogue (8.2k rows / 2 GB parent: kernel, measured
     emitter 6.1 ms vs model 6.3), kaggle (26.6k / 411 MB: emitter) and
-    the headline (1M / 2 GB: emitter)."""
+    the headline (1M / 2 GB: emitter).
+
+    ``n`` from the epilogue caller is the PADDED rowof length (sentinel
+    holes included — the live distinct count is data-dependent), so the
+    kernel's cost is an upper bound: near the threshold the slack tips
+    the dispatch toward the emitter, never the kernel (advisor r5)."""
     kernel_ns = n * 64.0 * 2.0
     sweep_ns = parent_rows * dim * itemsize * 2.0 / 650.0
     return kernel_ns < sweep_ns

@@ -219,7 +219,14 @@ class Timer:
 class OpTimer:
     """Per-op forward timing (reference --profiling flag wrapping kernels
     with cudaEvents, linear.cu:499-531).  Times each op's jitted forward
-    in isolation — useful for cost-model calibration and hot-spot lists."""
+    in isolation — useful for cost-model calibration and hot-spot lists.
+
+    When a telemetry EventLog is active, each op also lands as one
+    ``op_time`` event carrying the measured times NEXT TO the analytic
+    simulator's prediction for the same op — the pairing the report
+    CLI's sim-vs-measured calibration table reads (docs/telemetry.md;
+    the way FlexFlow validates its simulator against measured per-op
+    cost, MLSys'19 §5)."""
 
     def __init__(self, model, iters: int = 10):
         self.model = model
@@ -227,12 +234,21 @@ class OpTimer:
 
     def profile(self, state, inputs) -> Dict[str, float]:
         from .sim.cost_model import CostModel
+        from .telemetry import active_log
 
         cm = CostModel(measure=True, measure_iters=self.iters)
+        sim_cm = CostModel()  # analytic roofline — the simulator's view
+        log = active_log()
         out = {}
         for op in self.model.layers:
             fwd, bwd = cm.op_times(op, 1)
-            out[op.name] = {"forward_s": fwd, "backward_s": bwd}
+            sf, sb = sim_cm.op_times(op, 1)
+            out[op.name] = {"forward_s": fwd, "backward_s": bwd,
+                            "sim_forward_s": sf, "sim_backward_s": sb}
+            if log is not None:
+                log.emit("op_time", op=op.name, forward_s=fwd,
+                         backward_s=bwd, sim_forward_s=sf,
+                         sim_backward_s=sb)
         return out
 
     def report(self, times: Dict[str, dict]) -> str:

@@ -17,6 +17,7 @@ import random
 from typing import Callable, List, Optional
 
 from ..parallel.parallel_config import ParallelConfig, Strategy
+from ..telemetry import active_log
 from .simulator import Simulator
 
 
@@ -161,6 +162,12 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
             if verbose:
                 print(f"[search] native backend: best "
                       f"{best_time*1e3:.3f} ms over {budget} iters")
+            log = active_log()
+            if log is not None:
+                # the native chain reports only the final best — one
+                # summary event records what the search did
+                log.emit("search", phase="summary", iterations=budget,
+                         best_s=best_time, backend="native")
             return best
 
     sim = simulator or Simulator(model, num_devices, cost_model=cost_model)
@@ -173,9 +180,12 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
 
     current_time = sim.simulate(current)
     best, best_time = copy_strategy(current), current_time
+    start_time = current_time
     if verbose:
         print(f"[search] start (data-parallel): {current_time*1e3:.3f} ms")
 
+    log = active_log()
+    iterations = accepted_count = 0
     for it in range(budget):
         if not ops:
             break
@@ -186,8 +196,10 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
         current.configs[op.name] = new_pc
         t = sim.simulate(current)
         delta = t - current_time
-        if delta <= 0 or rng.random() < math.exp(-alpha * delta * 1e3):
+        accepted = delta <= 0 or rng.random() < math.exp(-alpha * delta * 1e3)
+        if accepted:
             current_time = t  # accept
+            accepted_count += 1
             if t < best_time:
                 best, best_time = copy_strategy(current), t
                 if verbose:
@@ -195,8 +207,22 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
                           f"({op.name} -> {new_pc.dims})")
         else:
             current.configs[op.name] = prev_pc  # reject
+        iterations += 1
+        if log is not None:
+            # one trajectory event per proposal (the persisted view of
+            # what the simulator-guided search actually did — reference
+            # FFModel::optimize only prints; docs/telemetry.md)
+            log.emit("search", phase="iteration", it=it, op=op.name,
+                     dims=list(new_pc.dims), accepted=bool(accepted),
+                     current_s=current_time, best_s=best_time)
         if on_iteration is not None:
             on_iteration(it, current_time, best_time)
 
+    if log is not None:
+        log.emit("search", phase="summary", iterations=iterations,
+                 best_s=best_time, start_s=start_time,
+                 accepted_count=accepted_count,
+                 acceptance_rate=accepted_count / max(iterations, 1),
+                 backend="python")
     best.best_simulated_time = best_time
     return best

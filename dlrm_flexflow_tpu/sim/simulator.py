@@ -97,9 +97,16 @@ class Simulator:
     def calibrate(self, strategy: Strategy, real_step_time: float) -> float:
         """Fit ``scale`` so simulate(strategy) == real_step_time; returns
         the factor.  Use one config to calibrate, others to validate —
-        relative comparisons (what the search needs) are unaffected."""
+        relative comparisons (what the search needs) are unaffected.
+        Each fit is recorded as one ``search`` phase=calibrate telemetry
+        event (sim-vs-measured — the report CLI's calibration summary)."""
         raw = self.simulate(strategy) / self.scale
         self.scale = real_step_time / raw if raw > 0 else 1.0
+        from ..telemetry import active_log
+        log = active_log()
+        if log is not None:
+            log.emit("search", phase="calibrate", simulated_s=raw,
+                     measured_s=real_step_time, scale=self.scale)
         return self.scale
 
     # ------------------------------------------------------------------ build

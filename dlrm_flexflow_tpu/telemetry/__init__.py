@@ -1,0 +1,33 @@
+"""Unified training telemetry (docs/telemetry.md).
+
+One process-wide ``EventLog`` (JSONL sink + in-memory ring) records
+typed, schema-checked events from every layer of the framework:
+
+  * ``step``    — epoch/window wall time, samples/s, loss, metric means
+                  (FFModel.fit / train_epoch / bench.py windows)
+  * ``compile`` — XLA compiles (jit cache misses) observed through
+                  jax.monitoring hooks, plus fit's AOT compiles with
+                  their donated-argument counts
+  * ``memory``  — per-device live-bytes watermarks sampled around steps
+  * ``search``  — MCMC strategy-search trajectory and simulator
+                  calibration fits (sim/search.py, sim/simulator.py)
+  * ``op_time`` — per-op measured forward/backward next to the analytic
+                  simulator's prediction (profiling.OpTimer)
+
+Activate with ``set_event_log(EventLog(path=...))`` or the scoped
+``event_log(...)`` context manager; producers no-op when telemetry is
+off.  ``python -m dlrm_flexflow_tpu.telemetry report run.jsonl`` prints
+the per-op time table, compile timeline, throughput summary, and
+sim-vs-measured calibration error.
+"""
+
+from .events import (EventLog, active_log, emit, event_log,
+                     sample_memory, set_event_log, suppressed)
+from .jax_hooks import compile_stats, install_compile_hooks
+from .schema import SCHEMA, SCHEMA_VERSION, validate_event
+
+__all__ = [
+    "EventLog", "active_log", "emit", "event_log",
+    "sample_memory", "set_event_log", "suppressed", "compile_stats",
+    "install_compile_hooks", "SCHEMA", "SCHEMA_VERSION", "validate_event",
+]

@@ -1,0 +1,7 @@
+"""CLI entry: ``python -m dlrm_flexflow_tpu.telemetry report <run.jsonl>``."""
+
+import sys
+
+from .report import main
+
+sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,258 @@
+"""Telemetry report CLI: summarize one run's event JSONL.
+
+    python -m dlrm_flexflow_tpu.telemetry report <run.jsonl>
+
+Prints (sections appear only when the run emitted the matching events):
+  * throughput summary        — from ``step`` events (fenced vs dispatch)
+  * per-op time table         — from ``op_time`` events (OpTimer)
+  * sim-vs-measured calibration — op_time events carrying both the
+    measured and the analytic-simulator times (how FlexFlow validates
+    its simulator against per-op measured cost, MLSys'19 §5)
+  * compile-event timeline    — from ``compile`` events (jit cache
+    misses observed by the jax.monitoring hooks + fit's AOT compiles)
+  * memory watermarks         — from ``memory`` events, per device
+  * search trajectory         — from ``search`` events (MCMC proposals,
+    acceptance rate, best-cost trajectory, calibration fits)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .schema import validate_event
+
+
+def load_events(path: str, strict: bool = False) -> List[dict]:
+    """Parse a telemetry JSONL.  Malformed/invalid lines are skipped
+    (``strict=True`` raises instead) so a report still renders from a
+    partially-written file of a crashed run."""
+    out: List[dict] = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+                errs = validate_event(ev)
+                if errs:
+                    raise ValueError("; ".join(errs))
+            except ValueError as e:
+                if strict:
+                    raise ValueError(f"{path}:{i + 1}: {e}") from e
+                continue
+            out.append(ev)
+    return out
+
+
+def _by_type(events: List[dict]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for e in events:
+        out.setdefault(e.get("type", "?"), []).append(e)
+    return out
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def throughput_summary(events: List[dict]) -> List[str]:
+    steps = [e for e in events if e.get("type") == "step"]
+    if not steps:
+        return []
+    lines = ["== throughput =="]
+    fenced = [e for e in steps if e.get("fenced")]
+    total = sum(int(e.get("samples", 0)) for e in steps)
+    lines.append(f"step events: {len(steps)} ({len(fenced)} fenced), "
+                 f"{total} samples total")
+    if fenced:
+        best = max(fenced,
+                   key=lambda e: e.get("samples_per_s",
+                                       e["samples"] / max(e["wall_s"],
+                                                          1e-12)))
+        bsps = best.get("samples_per_s",
+                        best["samples"] / max(best["wall_s"], 1e-12))
+        lines.append(f"best fenced window: {bsps:,.0f} samples/s "
+                     f"({best.get('phase', '?')}, "
+                     f"wall {best['wall_s'] * 1e3:.2f} ms)")
+    losses = [e["loss"] for e in steps if "loss" in e]
+    if losses:
+        lines.append(f"loss: first {losses[0]:.6f} -> last {losses[-1]:.6f} "
+                     f"over {len(losses)} recorded steps")
+    return lines
+
+
+def per_op_table(events: List[dict]) -> List[str]:
+    ops = [e for e in events if e.get("type") == "op_time"]
+    if not ops:
+        return []
+    # last emission per op wins (a rerun within one log supersedes)
+    latest: Dict[str, dict] = {}
+    for e in ops:
+        latest[e["op"]] = e
+    rows = sorted(latest.values(), key=lambda e: -e["forward_s"])
+    has_sim = any("sim_forward_s" in e for e in rows)
+    head = f"{'op':28s} {'fwd(us)':>10s} {'bwd(us)':>10s}"
+    if has_sim:
+        head += f" {'sim fwd(us)':>12s} {'sim/meas':>9s}"
+    lines = ["== per-op time table ==", head]
+    for e in rows:
+        line = (f"{e['op']:28s} {e['forward_s'] * 1e6:10.1f} "
+                f"{e.get('backward_s', 0.0) * 1e6:10.1f}")
+        if has_sim:
+            sf = e.get("sim_forward_s")
+            if sf is not None:
+                ratio = sf / max(e["forward_s"], 1e-12)
+                line += f" {sf * 1e6:12.1f} {ratio:9.2f}"
+            else:
+                line += f" {'-':>12s} {'-':>9s}"
+        lines.append(line)
+    return lines
+
+
+def calibration_summary(events: List[dict]) -> List[str]:
+    """Sim-vs-measured calibration error over the ops that carry both
+    numbers (op_time events), plus any simulator calibration fits
+    (search phase=calibrate events)."""
+    latest: Dict[str, dict] = {}
+    for e in events:
+        if e.get("type") == "op_time" and "sim_forward_s" in e:
+            latest[e["op"]] = e
+    cal = [e for e in events
+           if e.get("type") == "search" and e.get("phase") == "calibrate"]
+    if not latest and not cal:
+        return []
+    lines = ["== sim-vs-measured calibration =="]
+    if latest:
+        errs = [abs(e["sim_forward_s"] - e["forward_s"])
+                / max(e["forward_s"], 1e-12) for e in latest.values()]
+        lines.append(f"per-op forward: {len(errs)} ops, mean abs relative "
+                     f"error {100.0 * sum(errs) / len(errs):.1f}%, "
+                     f"worst {100.0 * max(errs):.1f}%")
+    for e in cal:
+        lines.append(f"simulator fit: simulated {e['simulated_s'] * 1e3:.3f} "
+                     f"ms vs measured {e['measured_s'] * 1e3:.3f} ms "
+                     f"-> scale {e['scale']:.3f}")
+    return lines
+
+
+def compile_timeline(events: List[dict]) -> List[str]:
+    comps = [e for e in events if e.get("type") == "compile"]
+    if not comps:
+        return []
+    t0 = min(e["ts"] for e in events)
+    # an AOT lower().compile() ALSO fires the monitoring hook's
+    # backend_compile event for the same XLA compile, so the headline
+    # counts only the hook events (the actual misses) — summing both
+    # would double-count every AOT build's compile wall
+    misses = [e for e in comps if e["kind"] == "backend_compile"]
+    aots = [e for e in comps if e["kind"] == "aot"]
+    head = (f"{len(misses)} backend compiles (jit cache misses), "
+            f"{sum(e['duration_s'] for e in misses):.2f}s total compile "
+            f"wall")
+    if aots:
+        head += (f"; {len(aots)} AOT builds "
+                 f"({sum(e['duration_s'] for e in aots):.2f}s "
+                 f"lower+compile, overlaps the misses above)")
+    lines = ["== compile events ==", head]
+    for e in comps:
+        extra = ""
+        if "fn" in e:
+            extra += f" fn={e['fn']}"
+        if "donated_args" in e:
+            extra += f" donated_args={e['donated_args']}"
+        lines.append(f"  t+{e['ts'] - t0:8.2f}s  {e['kind']:16s} "
+                     f"{e['duration_s'] * 1e3:10.1f} ms{extra}")
+    return lines
+
+
+def memory_summary(events: List[dict]) -> List[str]:
+    mems = [e for e in events if e.get("type") == "memory"]
+    if not mems:
+        return []
+    lines = ["== memory watermarks =="]
+    per_dev: Dict[str, List[dict]] = {}
+    for e in mems:
+        per_dev.setdefault(e["device"], []).append(e)
+    for dev, evs in sorted(per_dev.items()):
+        hi = max(int(e["bytes_in_use"]) for e in evs)
+        peak = max((int(e["peak_bytes"]) for e in evs if "peak_bytes" in e),
+                   default=None)
+        line = (f"  {dev}: max live {_fmt_bytes(hi)} "
+                f"over {len(evs)} samples ({evs[0].get('source', '?')})")
+        if peak is not None:
+            line += f", allocator peak {_fmt_bytes(peak)}"
+        lines.append(line)
+    return lines
+
+
+def search_summary(events: List[dict]) -> List[str]:
+    its = [e for e in events
+           if e.get("type") == "search" and e.get("phase") == "iteration"]
+    sums = [e for e in events
+            if e.get("type") == "search" and e.get("phase") == "summary"]
+    if not its and not sums:
+        return []
+    lines = ["== strategy search =="]
+    if its:
+        acc = sum(1 for e in its if e.get("accepted"))
+        best0, bestN = its[0]["best_s"], its[-1]["best_s"]
+        lines.append(f"{len(its)} recorded iterations, {acc} accepted "
+                     f"({100.0 * acc / len(its):.0f}%)")
+        lines.append(f"best simulated cost: {best0 * 1e3:.3f} ms -> "
+                     f"{bestN * 1e3:.3f} ms")
+    for e in sums:
+        line = (f"summary: {e['iterations']} iterations, best "
+                f"{e['best_s'] * 1e3:.3f} ms")
+        if "acceptance_rate" in e:
+            line += f", acceptance {100.0 * e['acceptance_rate']:.0f}%"
+        if "start_s" in e:
+            line += f" (start {e['start_s'] * 1e3:.3f} ms)"
+        if "backend" in e:
+            line += f" [{e['backend']}]"
+        lines.append(line)
+    return lines
+
+
+def format_report(events: List[dict]) -> str:
+    if not events:
+        return "(no events)"
+    by = _by_type(events)
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] for e in events)
+    lines = ["== run summary ==",
+             f"{len(events)} events over {t1 - t0:.1f}s: "
+             + ", ".join(f"{len(v)} {k}" for k, v in sorted(by.items()))]
+    for section in (throughput_summary, per_op_table, calibration_summary,
+                    compile_timeline, memory_summary, search_summary):
+        part = section(events)
+        if part:
+            lines.append("")
+            lines.extend(part)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dlrm_flexflow_tpu.telemetry",
+        description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd")
+    rep = sub.add_parser("report", help="summarize a telemetry JSONL")
+    rep.add_argument("path")
+    rep.add_argument("--strict", action="store_true",
+                     help="fail on malformed/invalid lines instead of "
+                          "skipping them")
+    args = p.parse_args(argv)
+    if args.cmd != "report":
+        p.print_help()
+        return 2
+    events = load_events(args.path, strict=args.strict)
+    print(format_report(events))
+    return 0

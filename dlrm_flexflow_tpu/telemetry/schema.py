@@ -1,0 +1,148 @@
+"""The telemetry event schema — the single contract between event
+producers (model.py, bench.py, sim/search.py, profiling.OpTimer, the
+jax.monitoring compile hooks) and the report CLI.
+
+Every emitted event is a flat JSON object with two common fields
+(``type``, ``ts``) plus per-type fields listed here.  ``EventLog.emit``
+validates against this table at emission time and
+``scripts/check_telemetry_schema.py`` lints it in tier-1 tests, so a
+producer cannot add or rename a field without the schema (and therefore
+the report CLI) seeing it — the drift this module exists to prevent.
+
+The documented form of this schema lives in ``docs/telemetry.md``; keep
+the two in sync (the lint checks the doc names every type).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+SCHEMA_VERSION = 1
+
+#: declared type -> accepted runtime types.  ``float`` fields accept ints
+#: (JSON round-trips 1.0 as 1) but never bools; ``int`` fields reject
+#: bools too (bool subclasses int in Python).
+_ACCEPT = {
+    float: (int, float),
+    int: (int,),
+    str: (str,),
+    bool: (bool,),
+    dict: (dict,),
+    list: (list, tuple),
+}
+
+COMMON_REQUIRED = {"type": str, "ts": float}
+
+SCHEMA: Dict[str, dict] = {
+    # one timed stretch of training: an epoch, a fused multi-epoch
+    # dispatch, or a fenced bench window.  ``fenced`` distinguishes real
+    # device-complete walls from dispatch-only walls (PERF.md: on the
+    # tunneled platform only fenced walls are trustworthy).
+    "step": {
+        "required": {"wall_s": float, "samples": int},
+        "optional": {"samples_per_s": float, "steps": int,
+                     "epochs": int, "loss": float, "metrics": dict,
+                     "fenced": bool, "phase": str, "probe_us": float},
+    },
+    # one XLA compilation (jit cache miss).  ``kind`` is
+    # "backend_compile" for hook-observed compiles and "aot" for
+    # FFModel.fit's explicit lower().compile() calls (which also know
+    # the donated-argument count).
+    "compile": {
+        "required": {"kind": str, "duration_s": float},
+        "optional": {"fn": str, "donated_args": int, "backend": str},
+    },
+    # per-device live-bytes watermark sampled around a step.  ``source``
+    # is "memory_stats" on backends that expose allocator stats (TPU) or
+    # "live_arrays" for the host-side fallback (CPU test meshes).
+    "memory": {
+        "required": {"device": str, "bytes_in_use": int},
+        "optional": {"peak_bytes": int, "source": str, "phase": str},
+    },
+    # MCMC strategy-search trajectory (sim/search.py) and simulator
+    # calibration (sim/simulator.py).  ``phase`` selects the sub-shape:
+    # per-iteration proposals, the end-of-search summary, or one
+    # sim-vs-measured calibration fit.
+    "search": {
+        "required": {"phase": str},
+        "optional": {"it": int, "op": str, "dims": list, "accepted": bool,
+                     "current_s": float, "best_s": float, "start_s": float,
+                     "iterations": int, "accepted_count": int,
+                     "acceptance_rate": float, "backend": str,
+                     "simulated_s": float, "measured_s": float,
+                     "scale": float},
+        "phases": {
+            "iteration": ("it", "accepted", "current_s", "best_s"),
+            "summary": ("iterations", "best_s"),
+            "calibrate": ("simulated_s", "measured_s", "scale"),
+        },
+    },
+    # one op's isolated forward/backward wall time (profiling.OpTimer)
+    # next to the analytic simulator's prediction for the same op — the
+    # report's sim-vs-measured calibration table reads these.
+    "op_time": {
+        "required": {"op": str, "forward_s": float},
+        "optional": {"backward_s": float, "sim_forward_s": float,
+                     "sim_backward_s": float},
+    },
+}
+
+
+def _type_ok(val, declared) -> bool:
+    ok = _ACCEPT[declared]
+    if isinstance(val, bool):
+        return declared is bool
+    return isinstance(val, ok)
+
+
+def validate_event(ev: dict) -> List[str]:
+    """Errors for one event dict against the schema (empty list = valid).
+
+    Checks: common fields, known type, required fields present with the
+    right runtime types, NO unknown fields (an unknown field means a
+    producer drifted from the schema — exactly what the lint catches),
+    and the per-phase required fields of ``search`` events.
+    """
+    errs: List[str] = []
+    if not isinstance(ev, dict):
+        return [f"event is not a dict: {type(ev).__name__}"]
+    for name, decl in COMMON_REQUIRED.items():
+        if name not in ev:
+            errs.append(f"missing common field {name!r}")
+        elif not _type_ok(ev[name], decl):
+            errs.append(f"common field {name!r} has type "
+                        f"{type(ev[name]).__name__}, want {decl.__name__}")
+    etype = ev.get("type")
+    if etype not in SCHEMA:
+        errs.append(f"unknown event type {etype!r} "
+                    f"(known: {sorted(SCHEMA)})")
+        return errs
+    spec = SCHEMA[etype]
+    known = {**spec["required"], **spec["optional"]}
+    for name, decl in spec["required"].items():
+        if name not in ev:
+            errs.append(f"{etype}: missing required field {name!r}")
+        elif not _type_ok(ev[name], decl):
+            errs.append(f"{etype}.{name}: type {type(ev[name]).__name__}, "
+                        f"want {decl.__name__}")
+    for name, val in ev.items():
+        if name in COMMON_REQUIRED:
+            continue
+        if name not in known:
+            errs.append(f"{etype}: unknown field {name!r} "
+                        f"(schema drift — update telemetry/schema.py "
+                        f"and docs/telemetry.md together)")
+        elif name in spec["optional"] and not _type_ok(val, known[name]):
+            errs.append(f"{etype}.{name}: type {type(val).__name__}, "
+                        f"want {known[name].__name__}")
+    phases = spec.get("phases")
+    if phases is not None and "phase" in ev:
+        ph = ev["phase"]
+        if ph not in phases:
+            errs.append(f"{etype}: unknown phase {ph!r} "
+                        f"(known: {sorted(phases)})")
+        else:
+            for name in phases[ph]:
+                if name not in ev:
+                    errs.append(f"{etype}[phase={ph}]: missing {name!r}")
+    return errs

@@ -1,0 +1,174 @@
+"""Telemetry schema lint (tier-1: tests/test_telemetry.py runs it).
+
+Guards the three-way contract between the event producers (model.py,
+bench.py, sim/search.py, sim/simulator.py, profiling.OpTimer, the
+jax.monitoring hooks), ``telemetry/schema.py``, and the documented
+schema in ``docs/telemetry.md`` — so a producer cannot add, rename, or
+retype a field without the schema and the report CLI seeing it:
+
+  1. self-consistency — a maximal example event of every type (all
+     required + optional fields) must pass ``validate_event`` through
+     the real ``EventLog.emit`` path;
+  2. doc sync — every event type and every field named in the schema
+     must appear in docs/telemetry.md, and every ```` `type` ````-headed
+     event section in the doc must exist in the schema;
+  3. producer scan — every ``*.emit("<type>", field=...)`` call in the
+     package (AST walk, no regex guessing) must name a known event type
+     and only known fields for it.
+
+Exit 0 when clean; prints one line per violation and exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dlrm_flexflow_tpu.telemetry.events import EventLog  # noqa: E402
+from dlrm_flexflow_tpu.telemetry.schema import (COMMON_REQUIRED,  # noqa: E402
+                                                SCHEMA)
+
+#: example value per declared type, rich enough to satisfy validation
+_EXAMPLE = {float: 0.5, int: 3, str: "x", bool: True,
+            dict: {"k": 1.0}, list: [1, 2]}
+
+#: files whose ``emit(...)`` calls the producer scan covers
+_SCAN = ["bench.py", "dlrm_flexflow_tpu"]
+
+
+def _example_event(etype: str, spec: dict) -> dict:
+    ev = {}
+    for name, decl in {**spec["required"], **spec["optional"]}.items():
+        ev[name] = _EXAMPLE[decl]
+    phases = spec.get("phases")
+    if phases is not None:
+        # pick the phase whose extra requirements the example satisfies
+        # (all optional fields are present, so any phase works)
+        ev["phase"] = sorted(phases)[0]
+    return ev
+
+
+def check_self_consistency() -> list:
+    errs = []
+    log = EventLog()  # ring only, no sink
+    for etype, spec in sorted(SCHEMA.items()):
+        for field in ("required", "optional"):
+            if not isinstance(spec.get(field), dict):
+                errs.append(f"schema[{etype}].{field} is not a dict")
+                return errs
+        overlap = set(spec["required"]) & set(spec["optional"])
+        if overlap:
+            errs.append(f"schema[{etype}]: fields both required and "
+                        f"optional: {sorted(overlap)}")
+        clash = (set(spec["required"]) | set(spec["optional"])) \
+            & set(COMMON_REQUIRED)
+        if clash:
+            errs.append(f"schema[{etype}]: redefines common fields "
+                        f"{sorted(clash)}")
+        try:
+            log.emit(etype, **_example_event(etype, spec))
+        except ValueError as e:
+            errs.append(f"schema[{etype}]: maximal example rejected by "
+                        f"EventLog.emit: {e}")
+    return errs
+
+
+def check_doc_sync(doc_path: str) -> list:
+    if not os.path.exists(doc_path):
+        return [f"missing {doc_path} (the documented schema)"]
+    with open(doc_path) as f:
+        doc = f.read()
+    errs = []
+    for etype, spec in sorted(SCHEMA.items()):
+        if f"`{etype}`" not in doc:
+            errs.append(f"docs/telemetry.md does not document event "
+                        f"type `{etype}`")
+            continue
+        for name in {**spec["required"], **spec["optional"]}:
+            if f"`{name}`" not in doc:
+                errs.append(f"docs/telemetry.md does not document "
+                            f"{etype} field `{name}`")
+        for ph in spec.get("phases") or ():
+            if f'"{ph}"' not in doc and f"`{ph}`" not in doc:
+                errs.append(f"docs/telemetry.md does not document "
+                            f"{etype} phase {ph!r}")
+    return errs
+
+
+def _emit_calls(tree: ast.AST):
+    """(lineno, type_literal, keyword_names, has_starstar) for every
+    ``emit("...")`` / ``<x>.emit("...")`` call with a literal type."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else None
+        if name != "emit" or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        kws = [k.arg for k in node.keywords if k.arg is not None]
+        starstar = any(k.arg is None for k in node.keywords)
+        yield node.lineno, first.value, kws, starstar
+
+
+def check_producers() -> list:
+    errs = []
+    paths = []
+    for root in _SCAN:
+        full = os.path.join(REPO, root)
+        if os.path.isfile(full):
+            paths.append(full)
+        else:
+            for dirpath, _dirs, files in os.walk(full):
+                paths.extend(os.path.join(dirpath, f) for f in files
+                             if f.endswith(".py"))
+    for path in sorted(paths):
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            errs.append(f"{rel}: unparseable: {e}")
+            continue
+        for lineno, etype, kws, starstar in _emit_calls(tree):
+            if etype not in SCHEMA:
+                errs.append(f"{rel}:{lineno}: emit of unknown event "
+                            f"type {etype!r}")
+                continue
+            spec = SCHEMA[etype]
+            known = set(spec["required"]) | set(spec["optional"])
+            for kw in kws:
+                if kw not in known:
+                    errs.append(f"{rel}:{lineno}: emit(\"{etype}\") "
+                                f"passes unknown field {kw!r}")
+            if not starstar:
+                missing = set(spec["required"]) - set(kws)
+                if missing:
+                    errs.append(f"{rel}:{lineno}: emit(\"{etype}\") "
+                                f"misses required {sorted(missing)}")
+    return errs
+
+
+def main() -> int:
+    errs = (check_self_consistency()
+            + check_doc_sync(os.path.join(REPO, "docs", "telemetry.md"))
+            + check_producers())
+    for e in errs:
+        print(f"check_telemetry_schema: {e}")
+    if errs:
+        return 1
+    print(f"check_telemetry_schema: OK ({len(SCHEMA)} event types)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

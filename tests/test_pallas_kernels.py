@@ -262,6 +262,35 @@ class TestRowSetKernel:
         want = table.at[jnp.asarray(ids)].set(vals, mode="drop")
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_negative_ids_dropped(self):
+        """Negative ids must be DROPPED like >= num_rows sentinels —
+        never written, never an out-of-bounds HBM DMA (the advisor-r5
+        predicate fix).  Note jnp's ``.at[...].set(mode="drop")``
+        python-WRAPS -1 to the last row before its bounds check, so the
+        expected result is built by explicit masking: callers never
+        produce negative ids (sentinels are R by construction), the
+        kernel predicate is the defensive bound."""
+        import numpy as np
+        import jax.numpy as jnp
+        from dlrm_flexflow_tpu.ops.pallas_scatter import _row_set_pallas
+
+        rng = np.random.default_rng(7)
+        rows_n, n = 256, 32
+        table = jnp.asarray(
+            rng.standard_normal((rows_n, 128)).astype(np.float32))
+        ids = np.full((n,), rows_n, np.int32)
+        ids[:8] = np.sort(rng.choice(rows_n, size=8, replace=False))
+        ids[8:16] = -1                       # negative: must be dropped
+        ids[16] = np.iinfo(np.int32).min     # extreme negative
+        vals = jnp.asarray(rng.standard_normal((n, 128)).astype(np.float32))
+        got = _row_set_pallas(table, jnp.asarray(ids), vals,
+                              interpret=True)
+        want = np.asarray(table).copy()
+        for k, i in enumerate(ids):
+            if 0 <= i < rows_n:              # both directions dropped
+                want[i] = np.asarray(vals)[k]
+        np.testing.assert_array_equal(np.asarray(got), want)
+
     def test_dispatch_gate_cost_model(self):
         """row_set_wins reproduces the three measured round-5 points:
         hybrid epilogue -> kernel, kaggle and headline -> emitter."""
