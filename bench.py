@@ -158,6 +158,26 @@ def _telemetry_tail(model, state, inputs, thpt, probe_us,
         print(f"# sim-calibration telemetry failed: {e!r}", file=sys.stderr)
 
 
+def _checkpoint_tail(model, state, app):
+    """Optional provenance checkpoint: ``BENCH_CHECKPOINT=<dir>`` commits
+    the benched final state atomically (resilience.CheckpointManager —
+    SHA-256 manifest, tmp+rename) under ``<dir>/<app>/`` after the timed
+    windows, so a measured configuration is restorable for later
+    regression hunts.  The save's ``checkpoint`` telemetry events land
+    in the run's JSONL.  Best-effort like all bench telemetry — and the
+    manager itself never raises on I/O failure."""
+    d = os.environ.get("BENCH_CHECKPOINT", "").strip()
+    if not d or d.lower() in ("0", "off", "none", "false", "no"):
+        return
+    try:
+        from dlrm_flexflow_tpu.resilience import CheckpointManager
+
+        CheckpointManager(os.path.join(d, app), keep_n=2).save(
+            state, model=model)
+    except Exception as e:
+        print(f"# bench checkpoint failed: {e!r}", file=sys.stderr)
+
+
 def _probe_us():
     """Fenced 1024^3 bf16 matmul time in us — ~15us on a quiet v5e chip;
     >~200us means a noisy neighbor is degrading the shared chip and any
@@ -389,6 +409,7 @@ def main():
         place=not os.environ.get("BENCH_HOST_INPUTS"))
     _telemetry_tail(model, state, inputs, thpt, probe_us,
                     batch, num_batches, epochs)
+    _checkpoint_tail(model, state, "dlrm")
     # vs_baseline: FIRST fenced history entry of the same config is the
     # anchor, so improvements accumulate instead of drifting with the
     # previous run's noise (the reference publishes no numbers,
@@ -606,6 +627,7 @@ def bench_app(app: str):
                                     nb, epochs, reps)
     _telemetry_tail(model, state, inputs, thpt, probe_us,
                     batch, nb, epochs)
+    _checkpoint_tail(model, state, app)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "probe_us": round(probe_us, 1), **prov,
              **_mfu_extras(model, batch, epochs * nb, prov)}

@@ -20,11 +20,30 @@ import jax.numpy as jnp
 from .model import TrainState
 
 
+class CheckpointError(Exception):
+    """A checkpoint directory that cannot be restored: missing, partially
+    written, truncated, or failing manifest verification.  Raised with
+    the offending path and what exactly is wrong — instead of the bare
+    FileNotFoundError/JSONDecodeError a half-written directory used to
+    produce."""
+
+
+def _esc(k) -> str:
+    """Escape one tree key for the ``/``-joined flat form.  Keys are
+    user-controlled op/param names; an unescaped ``/`` would silently
+    re-split into a different tree on restore (corruption)."""
+    return str(k).replace("%", "%25").replace("/", "%2F")
+
+
+def _unesc(k: str) -> str:
+    return k.replace("%2F", "/").replace("%25", "%")
+
+
 def _flatten(tree, prefix=""):
     out = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
-            out.update(_flatten(v, f"{prefix}{k}/"))
+            out.update(_flatten(v, f"{prefix}{_esc(k)}/"))
     else:
         out[prefix[:-1]] = tree
     return out
@@ -33,7 +52,7 @@ def _flatten(tree, prefix=""):
 def _unflatten(flat):
     tree: dict = {}
     for key, v in flat.items():
-        parts = key.split("/")
+        parts = [_unesc(p) for p in key.split("/")]
         d = tree
         for p in parts[:-1]:
             d = d.setdefault(p, {})
@@ -139,7 +158,7 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
                      _flatten(state.opt_state).items()})
         flat.update({f"bn_state/{k}": v for k, v in
                      _flatten(state.bn_state).items()})
-        flat.update({f"host_tables/{k}": v
+        flat.update({f"host_tables/{_esc(k)}": v
                      for k, v in host_tables.items()})
         flat["rng"] = state.rng
         flat["step"] = state.step
@@ -152,9 +171,27 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
 
 def restore_checkpoint(path: str, model=None) -> TrainState:
     """Read a checkpoint back into a TrainState; if ``model`` has an active
-    mesh, parameters are re-placed with their strategy shardings."""
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+    mesh, parameters are re-placed with their strategy shardings.
+
+    Raises :class:`CheckpointError` (naming the path and what is
+    missing/corrupt) for a nonexistent directory, an absent or truncated
+    ``meta.json``, or a missing/unreadable state payload."""
+    if not os.path.isdir(path):
+        raise CheckpointError(
+            f"checkpoint directory {path!r} does not exist")
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"{path!r} has no meta.json — not a checkpoint directory, "
+            f"or the save was killed before its metadata was written"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise CheckpointError(
+            f"{meta_path!r} is truncated or corrupt ({e}) — the save "
+            f"was likely killed mid-write") from e
     host_tables = {}
     if meta["format"] == "orbax":
         import orbax.checkpoint as ocp
@@ -166,7 +203,19 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
                            jnp.asarray(ckpt["step"]))
         host_tables = ckpt.get("host_tables", {}) or {}
     else:
-        data = np.load(os.path.join(path, "state.npz"))
+        import zipfile
+        npz_path = os.path.join(path, "state.npz")
+        try:
+            data = np.load(npz_path)
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"{path!r} has no state.npz (meta.json says format="
+                f"'npz') — the save was killed before the state was "
+                f"written") from None
+        except (ValueError, OSError, zipfile.BadZipFile) as e:
+            raise CheckpointError(
+                f"{npz_path!r} is unreadable ({e}) — truncated or "
+                f"corrupt state payload") from e
         groups: dict = {"params": {}, "opt_state": {}, "bn_state": {},
                         "host_tables": {}}
         rng = step = None
@@ -181,7 +230,7 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
         state = TrainState(_unflatten(groups["params"]),
                            _unflatten(groups["opt_state"]),
                            _unflatten(groups["bn_state"]), rng, step)
-        host_tables = {k: np.asarray(v)
+        host_tables = {_unesc(k): np.asarray(v)
                        for k, v in groups["host_tables"].items()}
     if model is not None:
         # re-form parameters for the restoring model's storage mode

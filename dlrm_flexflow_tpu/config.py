@@ -164,6 +164,11 @@ class FFConfig:
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)
     fit_scan_max_bytes: int = 2 * 1024 * 1024 * 1024
+    # Fault-injection spec (resilience/faultinject.py), e.g.
+    # "nan_grads@step=3,preempt@step=7" — testing knob proving the
+    # recovery paths end-to-end; also settable via the FF_FAULTS env
+    # var.  Empty = no injected faults.
+    faults: str = ""
     seed: int = 0
 
     @staticmethod
@@ -208,6 +213,8 @@ class FFConfig:
                 cfg.compute_dtype = nxt()
             elif a == "--embedding-dtype":
                 cfg.embedding_dtype = nxt()
+            elif a == "--faults":
+                cfg.faults = nxt()
             elif a in ("-d", "--devices", "-ll:gpu"):
                 # reference -ll:gpu N => N workers; here: device count
                 cfg.num_devices = int(nxt())

@@ -14,7 +14,8 @@ Legion index tasks.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, Tuple
+import copy
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +41,13 @@ class ArrayDataLoader:
         self.drop_last = drop_last
         self.shuffle = shuffle
         self._rng = np.random.default_rng(seed)
+        # resume bookkeeping (state_dict/load_state_dict): the shuffle
+        # RNG state at the CURRENT epoch's start (re-shuffling from it
+        # regenerates the same order), the batches-yielded cursor, and
+        # the batch to start from after a restore
+        self._epoch_start_rng: Optional[dict] = None
+        self._cursor = 0
+        self._resume_batch = 0
 
     @property
     def num_batches(self) -> int:
@@ -52,13 +60,43 @@ class ArrayDataLoader:
         return ({k: v[idx] for k, v in self.inputs.items()}, self.labels[idx])
 
     def __iter__(self) -> Iterator[Tuple[Dict[str, np.ndarray], np.ndarray]]:
+        start, self._resume_batch = self._resume_batch, 0
+        # entering an epoch (fresh or restored mid-epoch), the RNG holds
+        # the epoch-start state: remember it so a checkpoint taken at
+        # any batch can replay this epoch's exact order
+        self._epoch_start_rng = copy.deepcopy(self._rng.bit_generator.state)
         order = np.arange(self.num_samples)
         if self.shuffle:
             self._rng.shuffle(order)
-        for b in range(self.num_batches):
+        for b in range(start, self.num_batches):
+            self._cursor = b + 1
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             yield ({k: v[idx] for k, v in self.inputs.items()},
                    self.labels[idx])
+        self._cursor = 0
+
+    # ------------------------------------------------- resume (checkpointing)
+    def state_dict(self) -> dict:
+        """Shuffle RNG state + epoch/batch cursor, JSON-serializable —
+        enough for a restored loader to REPLAY the exact remaining batch
+        sequence (docs/resilience.md).  Mid-epoch, the captured RNG
+        state is the epoch-START state and ``batch`` the next batch to
+        yield; between epochs it is the current state with ``batch`` 0.
+        The EPOCH position is deliberately not here — the fit loop owns
+        it (the checkpoint's ``extra.json``); one source of truth."""
+        mid = 0 < self._cursor < self.num_batches
+        rng_state = (self._epoch_start_rng if mid
+                     else self._rng.bit_generator.state)
+        return {"rng_state": copy.deepcopy(rng_state),
+                "batch": self._cursor if mid else 0}
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore :meth:`state_dict`: the next ``__iter__`` re-shuffles
+        with the restored RNG (regenerating the interrupted epoch's
+        order) and resumes from the saved batch cursor."""
+        self._rng.bit_generator.state = sd["rng_state"]
+        self._resume_batch = int(sd.get("batch", 0))
+        self._cursor = self._resume_batch
 
     def __len__(self):
         return self.num_batches

@@ -1849,6 +1849,11 @@ class FFModel:
         donate = (0,) if donate_state else ()
         self._donate_argnums = donate  # telemetry: compile-event stats
         self._train_step = jax.jit(train_step, donate_argnums=donate)
+        # non-donating twin for the resilient loop: a NaN sentinel must
+        # keep the PRE-dispatch state alive to reject a blown-up update
+        # (donation would invalidate its buffers).  jit is lazy — this
+        # compiles only if a sentinel is actually armed.
+        self._train_step_nodonate = jax.jit(train_step)
         self._train_epoch = jax.jit(train_epoch, donate_argnums=donate)
         self._train_epochs = jax.jit(train_epochs, donate_argnums=donate,
                                      static_argnums=(3,))
@@ -1972,13 +1977,17 @@ class FFModel:
         return jax.device_put(arr, sharding(self.mesh, spec))
 
     # ------------------------------------------------------------- train loop
-    def train_step(self, state: TrainState, inputs: Dict[str, Any], labels):
+    def train_step(self, state: TrainState, inputs: Dict[str, Any], labels,
+                   donate: bool = True):
         """One fused forward/backward/update — the body the reference
         executes as forward(); zero_gradients(); backward(); update()
-        (dlrm.cc:166-187)."""
+        (dlrm.cc:166-187).  ``donate=False`` keeps the input state's
+        buffers alive after the call (the resilient loop's sentinel
+        rejects anomalous updates by simply not adopting the result)."""
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
         labels = self.shard_batch(labels)
-        out = self._train_step(state, inputs, labels)
+        step_fn = self._train_step if donate else self._train_step_nodonate
+        out = step_fn(state, inputs, labels)
         if self._hetero_ops:
             # host-side optimizer step for CPU-placed tables (their grads
             # were deposited by the backward callback this step)
@@ -2225,7 +2234,11 @@ class FFModel:
 
     def fit(self, state: TrainState, dataloader, epochs: Optional[int] = None,
             verbose: bool = True, callbacks=None, warmup: bool = True,
-            show_throughput: bool = True) -> Tuple[TrainState, float]:
+            show_throughput: bool = True, checkpoint_manager=None,
+            checkpoint_every_n_steps: Optional[int] = None,
+            checkpoint_every_n_epochs: Optional[int] = None,
+            resume: bool = False,
+            sentinel=None) -> Tuple[TrainState, float]:
         """Epoch loop with the reference's timing protocol: fence, warmup
         epoch outside timing, throughput print (dlrm.cc:154-198).
 
@@ -2233,9 +2246,50 @@ class FFModel:
         the hook protocol of reference base_model.py:367-420, including
         early stop when on_epoch_end returns True.
 
+        Resilience (docs/resilience.md): ``checkpoint_manager`` (a
+        ``resilience.CheckpointManager`` or a directory path) plus a
+        ``checkpoint_every_n_steps`` / ``checkpoint_every_n_epochs``
+        cadence enables atomic periodic checkpoints; ``resume=True``
+        auto-restores from the newest valid one (params + optimizer
+        slots + PRNG + step + hetero host tables + dataloader shuffle
+        state); ``sentinel`` (a ``resilience.NaNSentinel``) checks every
+        dispatch's folded loss and rolls back anomalous updates.  Any of
+        these — or installed faults (``FF_FAULTS`` / ``config.faults``)
+        — routes training through the per-batch resilient loop: every
+        step becomes a host decision point, trading the scanned-epoch
+        fusion for survivability.  ``warmup`` is skipped there (resume
+        parity needs exact step counts).
+
         Returns (state, samples_per_second).
         """
         epochs = epochs or self.config.epochs
+        from .resilience import faultinject
+        faultinject.install_from_env()
+        resilient = (checkpoint_manager is not None
+                     or checkpoint_every_n_steps
+                     or checkpoint_every_n_epochs or resume
+                     or sentinel is not None or faultinject.active()
+                     or getattr(self.config, "faults", ""))
+        if resilient:
+            from .resilience.loop import resilient_fit
+            from .resilience.manager import CheckpointManager
+            if isinstance(checkpoint_manager, str):
+                checkpoint_manager = CheckpointManager(checkpoint_manager)
+            if resume and checkpoint_manager is None:
+                raise ValueError(
+                    "fit(resume=True) needs a checkpoint_manager "
+                    "(instance or directory path) to restore from")
+            if (checkpoint_every_n_steps or checkpoint_every_n_epochs) \
+                    and checkpoint_manager is None:
+                raise ValueError(
+                    "a checkpoint cadence needs a checkpoint_manager "
+                    "(instance or directory path)")
+            return resilient_fit(
+                self, state, dataloader, epochs=epochs, verbose=verbose,
+                callbacks=callbacks, manager=checkpoint_manager,
+                every_n_steps=checkpoint_every_n_steps,
+                every_n_epochs=checkpoint_every_n_epochs, resume=resume,
+                sentinel=sentinel, show_throughput=show_throughput)
         acc = MetricsAccumulator(self.metrics)
         self._last_metrics = acc
         self._pending_lr = None

@@ -1,0 +1,36 @@
+"""Fault-tolerant training (docs/resilience.md).
+
+The survival layer over ``checkpoint.py`` and ``FFModel.fit``: a run
+killed at step k restarts from its last atomic checkpoint and converges
+to the same place, and a NaN batch can never silently destroy hours of
+training.
+
+* :class:`CheckpointManager` — atomic commits (tmp dir + fsync + one
+  rename), per-file SHA-256 manifests verified on restore, ``keep_n``
+  retention + GC of killed-save debris, retry-with-backoff on transient
+  I/O errors; a failed save logs telemetry and never aborts the run.
+* :func:`latest_checkpoint` / :func:`verify_checkpoint` — discovery
+  that skips partial/corrupt entries.
+* :class:`NaNSentinel` — per-dispatch NaN/Inf detection with rollback +
+  skip or lr-backoff policies, bounded by ``max_rollbacks``
+  (:class:`TrainingDiverged` past it).
+* :mod:`.faultinject` — deterministic fault injection
+  (``nan_grads@step=K``, ``io_error@save=N``, ``preempt@step=K``,
+  ``preempt@save``) so every recovery path is provable end-to-end;
+  :class:`Preemption` is the injected kill.
+
+Wired through ``FFModel.fit(checkpoint_manager=..., resume=True,
+checkpoint_every_n_steps=..., sentinel=NaNSentinel(...))``; all
+recovery actions emit ``checkpoint`` / ``anomaly`` / ``fault``
+telemetry events visible in ``python -m dlrm_flexflow_tpu.telemetry
+report``.
+"""
+
+from .faultinject import Preemption
+from .manager import CheckpointManager, latest_checkpoint, verify_checkpoint
+from .sentinel import NaNSentinel, TrainingDiverged
+
+__all__ = [
+    "CheckpointManager", "latest_checkpoint", "verify_checkpoint",
+    "NaNSentinel", "TrainingDiverged", "Preemption",
+]

@@ -1,0 +1,192 @@
+"""Deterministic fault injection for resilience testing.
+
+Large-scale training failures are rare in small tests, so each recovery
+path (atomic checkpoint commit, retry-on-I/O-error, NaN rollback,
+preemption resume) gets a *deterministic* injection point it can be
+driven through end-to-end.  Faults are declared as a spec string —
+programmatically via :func:`install`, through ``FFConfig.faults``, or
+the ``FF_FAULTS`` environment variable — and consumed at fixed sites:
+
+    nan_grads@step=K    poison the step-K batch with NaN — float labels
+                        when possible (NaN loss + NaN grads at every
+                        parameter), else float inputs (the sentinel's
+                        rollback path; see poison_batch)
+    preempt@step=K      raise :class:`Preemption` at the top of global
+                        step K (a mid-epoch kill — the resume path)
+    preempt@save        raise :class:`Preemption` between the state
+                        write and the manifest/rename commit (a kill
+                        mid-save — the crash-consistency path)
+    io_error@save=N     raise OSError on the next N checkpoint write
+                        attempts (the retry-with-backoff path)
+
+Entries are separated by ``,`` or ``;``.  Every firing decrements the
+fault's remaining count (specs without ``=N`` fire once) and emits a
+``fault`` telemetry event, so injected faults are visible in
+``telemetry report`` next to the recovery actions they triggered.
+Injection is deterministic by construction — a spec names the exact
+step/site, never a probability — so recovery tests replay bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Preemption(BaseException):
+    """An injected kill (TPU slice preemption, SIGKILL mid-save).
+
+    Subclasses BaseException — like KeyboardInterrupt — so generic
+    ``except Exception`` recovery code (e.g. the checkpoint manager's
+    never-abort save) cannot swallow a simulated death: it must
+    propagate out of the run exactly as a real kill would end it.
+    """
+
+
+_KINDS = ("nan_grads", "io_error", "preempt")
+_POINTS = ("step", "save", "restore")
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str                  # one of _KINDS
+    point: str                 # one of _POINTS
+    value: Optional[int]       # step number (point="step"), else None
+    remaining: int             # firings left
+
+    def spec(self) -> str:
+        tail = f"={self.value}" if self.value is not None else ""
+        return f"{self.kind}@{self.point}{tail}"
+
+
+_faults: List[_Fault] = []
+_env_consumed = False
+
+
+def parse(spec: str) -> List[_Fault]:
+    """Parse a fault spec string into fault entries (see module doc)."""
+    out: List[_Fault] = []
+    for entry in spec.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise ValueError(
+                f"bad fault spec {entry!r}: want kind@point[=value]")
+        kind, _, rest = entry.partition("@")
+        kind = kind.strip()
+        value: Optional[int] = None
+        point, _, val = rest.partition("=")
+        point = point.strip()
+        if val:
+            value = int(val)
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(known: {_KINDS})")
+        if point not in _POINTS:
+            raise ValueError(f"unknown fault point {point!r} "
+                             f"(known: {_POINTS})")
+        if point == "step":
+            if value is None:
+                raise ValueError(
+                    f"{entry!r}: step faults need a step number "
+                    f"(kind@step=K)")
+            out.append(_Fault(kind, point, value, 1))
+        else:
+            # value at a site point is a firing count (io_error@save=2)
+            out.append(_Fault(kind, point, None,
+                              value if value is not None else 1))
+    return out
+
+
+def install(spec: str) -> None:
+    """Activate the faults in ``spec`` (additive; see module doc)."""
+    _faults.extend(parse(spec))
+
+
+def install_from_env() -> None:
+    """Install ``FF_FAULTS`` once per process (idempotent until
+    :func:`clear`)."""
+    global _env_consumed
+    if _env_consumed:
+        return
+    _env_consumed = True
+    spec = os.environ.get("FF_FAULTS", "").strip()
+    if spec:
+        install(spec)
+
+
+def clear() -> None:
+    """Remove all installed faults and re-arm env loading (tests)."""
+    global _env_consumed
+    _faults.clear()
+    _env_consumed = False
+
+
+def active() -> bool:
+    return any(f.remaining > 0 for f in _faults)
+
+
+def _fire(f: _Fault, step: Optional[int] = None) -> None:
+    f.remaining -= 1
+    from ..telemetry import emit
+    emit("fault", kind=f.kind, point=f.point, step=step,
+         remaining=f.remaining)
+
+
+def _match(kind: str, point: str, step: Optional[int]) -> Optional[_Fault]:
+    for f in _faults:
+        if f.remaining <= 0 or f.kind != kind or f.point != point:
+            continue
+        if f.point == "step" and f.value != step:
+            continue
+        return f
+    return None
+
+
+def poison_batch(inputs: Dict[str, np.ndarray], labels, step: int):
+    """``nan_grads@step=K``: return a ``(inputs, labels)`` pair that
+    produces a NaN loss AND NaN gradients when the fault fires at this
+    step — COPIES; the caller's originals stay clean so a retry after
+    rollback trains on the real batch.
+
+    Float LABELS are the poison of choice: activations stay finite, so
+    the NaN enters only through the loss cotangent and reaches EVERY
+    parameter's gradient (including host-side hetero tables).
+    Poisoning the float INPUTS instead — the fallback for integer
+    class-id labels — still yields a NaN loss, but relu-family
+    backwards (``where(x > 0, g, 0)``) evaluate ``NaN > 0`` as False
+    and ZERO the cotangent, so downstream grads may come out finite."""
+    f = _match("nan_grads", "step", step)
+    if f is None:
+        return inputs, labels
+    _fire(f, step=step)
+    lab = np.asarray(labels)
+    if np.issubdtype(lab.dtype, np.floating):
+        return inputs, np.full_like(lab, np.nan)
+    out = dict(inputs)
+    for k, v in out.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[k] = np.full_like(arr, np.nan)
+    return out, labels
+
+
+def maybe_preempt(point: str, step: Optional[int] = None) -> None:
+    """Raise :class:`Preemption` when a ``preempt@<point>`` fault fires."""
+    f = _match("preempt", point, step)
+    if f is not None:
+        _fire(f, step=step)
+        raise Preemption(f"injected preemption at {point}"
+                         + (f" step {step}" if step is not None else ""))
+
+
+def maybe_io_error(point: str, step: Optional[int] = None) -> None:
+    """Raise OSError when an ``io_error@<point>`` fault fires."""
+    f = _match("io_error", point, step)
+    if f is not None:
+        _fire(f, step=step)
+        raise OSError(f"injected I/O error at {point}")

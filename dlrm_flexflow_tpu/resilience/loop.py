@@ -1,0 +1,204 @@
+"""The fault-tolerant training loop behind ``FFModel.fit(...)``'s
+resilience options (checkpoint cadence / resume / NaN sentinel).
+
+``fit``'s default path optimizes dispatch count (whole-epoch scans,
+multi-epoch fusion); survival needs the opposite trade — a host
+decision point around every dispatch, so a step can be checkpointed,
+rejected, or resumed mid-epoch.  When any resilience option is active,
+``fit`` delegates here: a per-batch loop that
+
+* checkpoints through a :class:`..resilience.CheckpointManager` every
+  ``every_n_steps`` global steps and/or ``every_n_epochs`` epochs, with
+  the dataloader's shuffle/cursor state and the epoch position riding
+  in the checkpoint's ``extra.json``;
+* auto-resumes (``resume=True``) from the newest VALID checkpoint:
+  params + optimizer slots + PRNG + step come from the TrainState,
+  hetero host tables land back in their ops, and the dataloader replays
+  the exact batch sequence from its restored cursor — a killed run
+  continues bit-identically to the run that never died (npz/CPU);
+* arms a :class:`..resilience.NaNSentinel`: each dispatch's folded loss
+  is checked on host; an anomalous dispatch is rejected (the
+  pre-dispatch state stays current — the step runs non-donating while a
+  sentinel is armed, so no snapshot copies are needed) and the batch is
+  skipped or retried at a backed-off learning rate;
+* honors the fault-injection harness (``FF_FAULTS`` /
+  ``FFConfig.faults`` / ``faultinject.install``) at its step boundary.
+
+The loop records ``model._fit_loss_trace`` / ``model._fit_loss_steps``
+(the per-adopted-dispatch folded losses and their global step numbers)
+— the observable the recovery tests compare bitwise against an
+uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..metrics import MetricsAccumulator
+from ..telemetry import active_log, sample_memory
+from . import faultinject
+from .manager import CheckpointManager
+from .sentinel import NaNSentinel
+
+
+def _loader_state(dataloader) -> Optional[dict]:
+    sd = getattr(dataloader, "state_dict", None)
+    return sd() if callable(sd) else None
+
+
+def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
+                  callbacks, manager: Optional[CheckpointManager],
+                  every_n_steps: Optional[int],
+                  every_n_epochs: Optional[int], resume: bool,
+                  sentinel: Optional[NaNSentinel],
+                  show_throughput: bool = True):
+    """See module docstring.  Returns ``(state, samples_per_second)`` —
+    the same contract as ``FFModel.fit``."""
+    faultinject.install_from_env()
+    cfg_faults = getattr(model.config, "faults", "") or ""
+    if cfg_faults and not getattr(model, "_cfg_faults_installed", False):
+        faultinject.install(cfg_faults)
+        model._cfg_faults_installed = True
+
+    acc = MetricsAccumulator(model.metrics)
+    model._last_metrics = acc
+    model._pending_lr = None
+    model._last_fit_used_scan = False  # survival trades the scan fusion
+    cbs = list(callbacks or [])
+    for cb in cbs:
+        if getattr(cb, "model", None) is None:
+            cb.set_model(model)
+        cb.on_train_begin()
+
+    start_epoch = 0
+    if resume and manager is not None and manager.latest() is not None:
+        state, extra, _path = manager.restore_latest(model=model)
+        if extra.get("loader") is not None \
+                and hasattr(dataloader, "load_state_dict"):
+            dataloader.load_state_dict(extra["loader"])
+        start_epoch = int(extra.get("epoch", 0))
+
+    global_step = int(np.asarray(state.step))
+    donate = sentinel is None  # rejection needs the pre-dispatch state live
+    # hetero CPU tables are updated IN the dispatch (host-side SGD after
+    # the backward callback) — a rejection must roll them back too.
+    # apply_host_sgd REBINDS table.array, so the pre-dispatch snapshot
+    # is a dict of references, not copies.
+    hetero_ops = [op for op in getattr(model, "_hetero_ops", [])
+                  if hasattr(op, "host_table")] if sentinel else []
+    losses, loss_steps = [], []
+    samples = 0
+    last_loss = None
+    epochs_run = 0
+    t0 = time.perf_counter()
+
+    def save(extra_epoch: int):
+        if manager is None:
+            return
+        manager.save(state, model=model, step=global_step,
+                     extra={"epoch": extra_epoch,
+                            "loader": _loader_state(dataloader),
+                            "epochs_requested": int(epochs)})
+
+    ep = start_epoch
+    while ep < epochs:
+        for cb in cbs:
+            cb.on_epoch_begin(ep)
+        if model._pending_lr is not None:
+            state = model.set_learning_rate(state, model._pending_lr)
+            model._pending_lr = None
+        acc.reset()
+        for it, (inputs, labels) in enumerate(dataloader):
+            for cb in cbs:
+                cb.on_batch_begin(it)
+            while True:  # lr_backoff retries the same batch
+                faultinject.maybe_preempt("step", step=global_step)
+                binputs, blabels = faultinject.poison_batch(
+                    inputs, labels, step=global_step)
+                host_snap = {op.name: op.host_table.array
+                             for op in hetero_ops}
+                new_state, mets = model.train_step(state, binputs, blabels,
+                                                   donate=donate)
+                if sentinel is None:
+                    state = new_state
+                    break
+                lr = float(getattr(model.optimizer, "lr", 0.0))
+                if sentinel.observe(mets["loss"], new_state,
+                                    step=global_step, lr=lr):
+                    state = new_state
+                    break
+                # REJECTED: `state` is still the pre-dispatch state (the
+                # non-donating step left its buffers alive); host-side
+                # hetero tables WERE updated in the dispatch — put the
+                # pre-dispatch arrays back
+                for op in hetero_ops:
+                    op.host_table.array = host_snap[op.name]
+                if sentinel.policy == "lr_backoff":
+                    state = model.set_learning_rate(
+                        state, lr * sentinel.lr_factor)
+                    continue   # retry the same batch
+                mets = None    # skip: drop the batch entirely
+                break
+            if mets is None:
+                for cb in cbs:
+                    cb.on_batch_end(it)
+                continue
+            global_step += 1
+            samples += int(labels.shape[0])
+            last_loss = float(np.asarray(mets["loss"]))
+            losses.append(last_loss)
+            loss_steps.append(global_step)
+            acc.update({k: v for k, v in mets.items() if k != "loss"})
+            model._fit_state = state
+            if every_n_steps and global_step % every_n_steps == 0:
+                # a save at the epoch's final batch marks the NEXT epoch
+                # (the loader cursor has wrapped to 0 already)
+                sd = _loader_state(dataloader)
+                mark = ep + 1 if (sd is not None
+                                  and sd.get("batch", 0) == 0) else ep
+                save(mark)
+            for cb in cbs:
+                cb.on_batch_end(it)
+        epochs_run += 1
+        if verbose:
+            print(f"epoch {ep}: {acc.report()}")
+        if every_n_epochs and (ep + 1) % every_n_epochs == 0:
+            save(ep + 1)
+        early_stop = False
+        for cb in cbs:
+            if cb.on_epoch_end(ep) is True:
+                early_stop = True
+        ep += 1
+        if early_stop:
+            print(f"Accuracy reached, early stop, epoch: {ep - 1}")
+            break
+
+    from ..profiling import device_fence
+    device_fence(state.step)
+    elapsed = time.perf_counter() - t0
+    thpt = samples / max(elapsed, 1e-9)
+    model._fit_state = state
+    model._fit_loss_trace = np.asarray(losses, dtype=np.float64)
+    model._fit_loss_steps = np.asarray(loss_steps, dtype=np.int64)
+    log = active_log()
+    if log is not None:
+        log.emit("step", wall_s=elapsed, samples=int(samples),
+                 samples_per_s=thpt, epochs=epochs_run, fenced=True,
+                 phase="resilient_fit", metrics=acc.finalized_means(),
+                 loss=last_loss)
+        sample_memory(phase="resilient_fit", log=log)
+    if verbose and show_throughput:
+        print(f"ELAPSED TIME = {elapsed:.4f}s, "
+              f"THROUGHPUT = {thpt:.2f} samples/s")
+    err = None
+    for cb in cbs:
+        try:
+            cb.on_train_end()
+        except Exception as e:  # run every hook, re-raise the first
+            err = err or e
+    if err is not None:
+        raise err
+    return state, thpt

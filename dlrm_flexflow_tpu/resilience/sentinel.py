@@ -1,0 +1,104 @@
+"""NaN/Inf sentinel: detect a blown-up dispatch, roll back, recover.
+
+A single NaN batch (bad record, fp overflow after an lr bump) poisons
+every parameter it touches; without a guard the run keeps training on
+garbage and hours of progress die silently.  The sentinel checks the
+folded loss of every dispatch on the host — and optionally the updated
+parameters themselves (``check_params=True``, catching finite-loss /
+NaN-grad corruption the loss cannot see) — and on anomaly tells the
+training loop to REJECT the dispatch: the pre-dispatch state (still
+live — the resilient loop runs a non-donating step while a sentinel is
+armed) is kept, and per ``policy`` the batch is skipped or the learning
+rate is backed off and the batch retried.  Total rollbacks are bounded
+by ``max_rollbacks``; exceeding it raises :class:`TrainingDiverged`
+(at that point the run is diverging, not glitching).
+
+Every rejection emits an ``anomaly`` telemetry event, so the report CLI
+shows what was rolled back, when, and under which policy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..telemetry import emit
+
+
+class TrainingDiverged(RuntimeError):
+    """More anomalous dispatches than ``max_rollbacks`` allows."""
+
+
+class NaNSentinel:
+    """``policy``: ``"skip"`` drops the offending batch and moves on;
+    ``"lr_backoff"`` multiplies the learning rate by ``lr_factor`` and
+    retries the same batch.  ``check_params=True`` additionally verifies
+    every float parameter of the post-dispatch state is finite (one
+    small jitted all-finite reduction per dispatch)."""
+
+    def __init__(self, policy: str = "skip", max_rollbacks: int = 3,
+                 lr_factor: float = 0.5, check_params: bool = False):
+        if policy not in ("skip", "lr_backoff"):
+            raise ValueError(
+                f"policy must be 'skip'|'lr_backoff', got {policy!r}")
+        self.policy = policy
+        self.max_rollbacks = int(max_rollbacks)
+        self.lr_factor = float(lr_factor)
+        self.check_params = bool(check_params)
+        self.rollbacks = 0
+        self._finite_fn = None
+
+    # --------------------------------------------------------------- checks
+    def _params_finite(self, state) -> bool:
+        if self._finite_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def all_finite(params):
+                leaves = [x for x in jax.tree_util.tree_leaves(params)
+                          if jnp.issubdtype(jnp.asarray(x).dtype,
+                                            jnp.floating)]
+                if not leaves:
+                    return jnp.asarray(True)
+                return jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(x)) for x in leaves]))
+
+            self._finite_fn = jax.jit(all_finite)
+        return bool(self._finite_fn(state.params))
+
+    def classify(self, loss, new_state=None) -> Optional[str]:
+        """The anomaly kind of one dispatch result, or None when clean."""
+        loss = float(np.asarray(loss))
+        if math.isnan(loss):
+            return "nan_loss"
+        if math.isinf(loss):
+            return "inf_loss"
+        if self.check_params and new_state is not None \
+                and not self._params_finite(new_state):
+            return "nonfinite_params"
+        return None
+
+    # -------------------------------------------------------------- verdict
+    def observe(self, loss, new_state=None, step: Optional[int] = None,
+                lr: Optional[float] = None) -> bool:
+        """True = adopt the dispatch.  False = REJECT: the caller keeps
+        its pre-dispatch state and applies :attr:`policy` (the sentinel
+        has already counted the rollback and emitted the ``anomaly``
+        event).  Raises :class:`TrainingDiverged` past the budget."""
+        kind = self.classify(loss, new_state)
+        if kind is None:
+            return True
+        self.rollbacks += 1
+        action = ("rollback_skip" if self.policy == "skip"
+                  else "rollback_lr_backoff")
+        emit("anomaly", kind=kind, step=step, action=action,
+             rollbacks=self.rollbacks, policy=self.policy,
+             loss=float(np.asarray(loss)), lr=lr)
+        if self.rollbacks > self.max_rollbacks:
+            raise TrainingDiverged(
+                f"{self.rollbacks} anomalous dispatches exceed "
+                f"max_rollbacks={self.max_rollbacks} (last: {kind} at "
+                f"step {step})")
+        return False

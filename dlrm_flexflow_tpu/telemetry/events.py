@@ -122,6 +122,13 @@ class EventLog:
             evs = [e for e in evs if e.get("type") == type]
         return evs
 
+    def last(self, type: str) -> Optional[Dict[str, Any]]:
+        """The newest event of ``type`` still in the ring, or None —
+        the one-liner recovery tests use to assert "this run emitted a
+        checkpoint/anomaly/fault event"."""
+        evs = self.events(type)
+        return evs[-1] if evs else None
+
     def close(self):
         with self._lock:
             if self._fh is not None:

@@ -219,6 +219,63 @@ def search_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+def resilience_summary(events: List[dict]) -> List[str]:
+    """Checkpoint actions, sentinel anomalies, and injected faults of
+    one run (resilience subsystem events — docs/resilience.md)."""
+    ckpts = [e for e in events if e.get("type") == "checkpoint"]
+    anoms = [e for e in events if e.get("type") == "anomaly"]
+    faults = [e for e in events if e.get("type") == "fault"]
+    if not ckpts and not anoms and not faults:
+        return []
+    lines = ["== resilience =="]
+    if ckpts:
+        by_act: Dict[str, int] = {}
+        for e in ckpts:
+            by_act[e["action"]] = by_act.get(e["action"], 0) + 1
+        saves = [e for e in ckpts if e["action"] == "save"]
+        parts = [f"{by_act.get('save', 0)} saves"]
+        if by_act.get("retry"):
+            parts.append(f"{by_act['retry']} retries")
+        if by_act.get("save_failed"):
+            parts.append(f"{by_act['save_failed']} FAILED saves "
+                         f"(run continued)")
+        if by_act.get("restore"):
+            parts.append(f"{by_act['restore']} restores")
+        gcs = [e for e in ckpts if e["action"] == "gc"]
+        if gcs:
+            parts.append(f"gc removed "
+                         f"{sum(e.get('removed_ckpts', 0) for e in gcs)} "
+                         f"ckpts + "
+                         f"{sum(e.get('removed_tmp', 0) for e in gcs)} tmp")
+        lines.append("checkpoints: " + ", ".join(parts))
+        if saves:
+            last = saves[-1]
+            line = f"last save: step {last.get('step', '?')}"
+            if "duration_s" in last:
+                line += f" ({last['duration_s'] * 1e3:.1f} ms)"
+            if "path" in last:
+                line += f" at {last['path']}"
+            lines.append(line)
+    if anoms:
+        by_kind: Dict[str, int] = {}
+        for e in anoms:
+            by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+        kinds = ", ".join(f"{n} {k}" for k, n in sorted(by_kind.items()))
+        pol = anoms[-1].get("policy", "?")
+        lines.append(f"anomalies: {kinds} — "
+                     f"{max(e.get('rollbacks', 0) for e in anoms)} "
+                     f"rollbacks (policy {pol})")
+    if faults:
+        by_f: Dict[str, int] = {}
+        for e in faults:
+            key = f"{e['kind']}@{e['point']}" + (
+                f"={e['step']}" if "step" in e else "")
+            by_f[key] = by_f.get(key, 0) + 1
+        lines.append("faults injected: " + "; ".join(
+            f"{k} x{n}" for k, n in sorted(by_f.items())))
+    return lines
+
+
 def format_report(events: List[dict]) -> str:
     if not events:
         return "(no events)"
@@ -229,7 +286,8 @@ def format_report(events: List[dict]) -> str:
              f"{len(events)} events over {t1 - t0:.1f}s: "
              + ", ".join(f"{len(v)} {k}" for k, v in sorted(by.items()))]
     for section in (throughput_summary, per_op_table, calibration_summary,
-                    compile_timeline, memory_summary, search_summary):
+                    compile_timeline, memory_summary, search_summary,
+                    resilience_summary):
         part = section(events)
         if part:
             lines.append("")

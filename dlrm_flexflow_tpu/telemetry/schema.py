@@ -85,6 +85,35 @@ SCHEMA: Dict[str, dict] = {
         "optional": {"backward_s": float, "sim_forward_s": float,
                      "sim_backward_s": float},
     },
+    # one checkpoint-manager action (resilience/manager.py).  ``action``
+    # is "save" (atomic commit), "retry" (transient I/O error, backed
+    # off), "save_failed" (all attempts exhausted — the run CONTINUES),
+    # "restore", or "gc" (retention sweep / killed-save debris).
+    "checkpoint": {
+        "required": {"action": str},
+        "optional": {"step": int, "path": str, "duration_s": float,
+                     "attempt": int, "error": str, "files": int,
+                     "kept": int, "removed_ckpts": int,
+                     "removed_tmp": int},
+    },
+    # one anomalous training dispatch the NaN sentinel rejected
+    # (resilience/sentinel.py).  ``kind``: "nan_loss" | "inf_loss" |
+    # "nonfinite_params"; ``action``: "rollback_skip" |
+    # "rollback_lr_backoff".  ``loss`` is absent for NaN (JSON cannot
+    # carry it); ``lr`` is the rate BEFORE any backoff.
+    "anomaly": {
+        "required": {"kind": str},
+        "optional": {"step": int, "action": str, "rollbacks": int,
+                     "policy": str, "loss": float, "lr": float},
+    },
+    # one injected fault firing (resilience/faultinject.py) — recovery
+    # tests read these next to the checkpoint/anomaly events the fault
+    # provoked.  ``point``: "step" | "save" | "restore"; ``remaining``:
+    # firings this fault has left.
+    "fault": {
+        "required": {"kind": str, "point": str},
+        "optional": {"step": int, "remaining": int},
+    },
 }
 
 

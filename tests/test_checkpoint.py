@@ -1,12 +1,17 @@
 """Checkpoint/resume tests (TPU-native superset of the reference's
 get/set_weights-only persistence, SURVEY §5.4)."""
 
+import json
+
 import numpy as np
 
 import jax
+import pytest
 
 import dlrm_flexflow_tpu as ff
-from dlrm_flexflow_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from dlrm_flexflow_tpu.checkpoint import (CheckpointError, _flatten,
+                                          _unflatten, restore_checkpoint,
+                                          save_checkpoint)
 from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
 from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader
 
@@ -253,3 +258,74 @@ def test_packed_storage_checkpoint_portability(tmp_path):
     assert rl2.params[emb.name]["embedding"].shape == (2, 512, 8)
     np.testing.assert_array_equal(
         np.asarray(rl2.params[emb.name]["embedding"]), w_logical)
+
+
+class TestSeparatorEscaping:
+    """Satellite regression: op/param names containing '/' used to be
+    silently re-split into a different tree on restore (the flat keys
+    are '/'-joined)."""
+
+    def test_flatten_roundtrips_slash_names(self):
+        tree = {"enc/dense": {"kernel": 1}, "enc": {"dense%2Fx": 2},
+                "plain": {"bias": 3}}
+        flat = _flatten(tree)
+        assert _unflatten(flat) == tree
+        # the two pathological names occupy DISTINCT flat keys
+        assert len(flat) == 3
+
+    def test_checkpoint_roundtrips_slash_op_name(self, tmp_path):
+        m = ff.FFModel(ff.FFConfig(batch_size=8))
+        x = m.create_tensor((8, 4), name="x")
+        m.dense(x, 2, name="tower/head")  # explicit name with separator
+        m.compile(optimizer=ff.AdamOptimizer(0.01),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        st = m.init(seed=0)
+        p = save_checkpoint(str(tmp_path / "c"), st, use_orbax=False)
+        r = restore_checkpoint(p)
+        assert "tower/head" in r.params  # not split into tower.head
+        np.testing.assert_array_equal(
+            np.asarray(st.params["tower/head"]["kernel"]),
+            np.asarray(r.params["tower/head"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(st.opt_state["m"]["tower/head"]["kernel"]),
+            np.asarray(r.opt_state["m"]["tower/head"]["kernel"]))
+
+
+class TestClearRestoreErrors:
+    """Satellite regression: missing/truncated checkpoint pieces raise
+    CheckpointError naming the path, not a bare FileNotFoundError or
+    JSONDecodeError."""
+
+    def test_missing_directory(self, tmp_path):
+        with pytest.raises(CheckpointError, match="does not exist"):
+            restore_checkpoint(str(tmp_path / "nope"))
+
+    def test_missing_meta(self, tmp_path):
+        d = tmp_path / "c"
+        d.mkdir()
+        with pytest.raises(CheckpointError, match="no meta.json"):
+            restore_checkpoint(str(d))
+
+    def test_truncated_meta(self, tmp_path):
+        d = tmp_path / "c"
+        d.mkdir()
+        (d / "meta.json").write_text('{"step": 3, "form')  # cut mid-write
+        with pytest.raises(CheckpointError, match="truncated or corrupt"):
+            restore_checkpoint(str(d))
+
+    def test_missing_state_npz(self, tmp_path):
+        d = tmp_path / "c"
+        d.mkdir()
+        (d / "meta.json").write_text(json.dumps({"step": 1,
+                                                 "format": "npz"}))
+        with pytest.raises(CheckpointError, match="no state.npz"):
+            restore_checkpoint(str(d))
+
+    def test_truncated_state_npz(self, tmp_path):
+        cfg, m = make_model()
+        st = m.init(seed=0)
+        p = save_checkpoint(str(tmp_path / "c"), st, use_orbax=False)
+        npz = tmp_path / "c" / "state.npz"
+        npz.write_bytes(npz.read_bytes()[:100])  # truncate the archive
+        with pytest.raises(CheckpointError, match="unreadable"):
+            restore_checkpoint(p)
