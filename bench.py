@@ -43,7 +43,7 @@ import time
 import numpy as np
 
 
-def _emit(metric, thpt, key, extra=None):
+def _emit(metric, thpt, key, extra=None, unit="samples/s"):
     """Shared tail of every benchmark: anchor ``thpt`` against the FIRST
     fenced history entry matching ``key`` (entries predating the "app"
     field count as app=="dlrm"), append this run (plus ``extra``
@@ -87,7 +87,7 @@ def _emit(metric, thpt, key, extra=None):
     print(json.dumps({
         "metric": metric,
         "value": round(thpt, 2),
-        "unit": "samples/s",
+        "unit": unit,
         "vs_baseline": round(vs, 4),
     }))
 
@@ -665,9 +665,70 @@ def bench_app(app: str):
     _emit(f"{app}_samples_per_sec", thpt, key, extra=extra)
 
 
+def bench_serving():
+    """Serving headline: the synthetic run_random.sh DLRM behind an
+    InferenceEngine + DynamicBatcher under closed-loop load
+    (docs/serving.md) — ``dlrm_serving_qps`` next to the training
+    samples/s metric.  BENCH_CLIENTS threads each fire BENCH_REQUESTS
+    requests of BENCH_REQ_ROWS rows back-to-back; buckets come from
+    BENCH_BUCKETS.  The engine AOT-compiles every bucket at warmup
+    (untimed, like the training windows' AOT epoch builds), so the
+    measured window never recompiles; its ``serve`` telemetry events
+    land in the run's JSONL for the report CLI's ``== serving ==``
+    section."""
+    import jax
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.serving import (DynamicBatcher, InferenceEngine,
+                                           parse_buckets)
+    from scripts.serve_bench import closed_loop
+
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    clients = int(os.environ.get("BENCH_CLIENTS", 8))
+    requests = int(os.environ.get("BENCH_REQUESTS", 64))
+    req_rows = int(os.environ.get("BENCH_REQ_ROWS", 1))
+    buckets = os.environ.get("BENCH_BUCKETS", "1,8,64,256")
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    cfg = DLRMConfig()  # run_random.sh architecture — same as main()
+    cfg.embedding_size = [rows] * 8
+    fc = ff.FFConfig(batch_size=parse_buckets(buckets)[-1],
+                     compute_dtype=dtype, serve_buckets=buckets)
+    model = build_dlrm(cfg, fc)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                  loss_type="mean_squared_error", metrics=(),
+                  mesh=False if jax.device_count() == 1 else None)
+    engine = InferenceEngine(model, model.init(seed=0))  # warmup: AOT all
+    rng = np.random.default_rng(0)
+    # request pool in main()'s input convention: uniform tables, one
+    # (rows, T, bag) id block — NOT the per-table ragged stacking the
+    # tiny serve_bench/check_serving models use
+    pool = [{"dense": rng.standard_normal(
+                 (req_rows, cfg.mlp_bot[0])).astype(np.float32),
+             "sparse": rng.integers(
+                 0, rows, size=(req_rows, 8, cfg.embedding_bag_size),
+                 dtype=np.int64)}
+            for _ in range(128)]
+    batcher = DynamicBatcher(engine)
+    wall, _rejected = closed_loop(batcher, pool, clients, requests)
+    summary = batcher.close()  # drains + emits the serve summary event
+    # SERVED requests only — shed (Rejected) submissions must not
+    # inflate the headline or its history anchor
+    qps = summary["requests"] / max(wall, 1e-9)
+    extra = {"dtype": dtype,
+             **{k: round(summary[k], 1) for k in
+                ("p50_us", "p95_us", "p99_us") if k in summary}}
+    _emit("dlrm_serving_qps", qps,
+          {"app": "dlrm_serving", "rows": rows, "clients": clients,
+           "req_rows": req_rows, "buckets": buckets},
+          extra=extra, unit="requests/s")
+
+
 if __name__ == "__main__":
     app = os.environ.get("BENCH_APP", "dlrm")
     # the EventLog scopes the WHOLE run so the jax.monitoring hooks see
     # every compile (warmup, AOT window builds, OpTimer's isolated jits)
     with _telemetry_ctx(app):
-        sys.exit(main() if app == "dlrm" else bench_app(app))
+        sys.exit(main() if app == "dlrm"
+                 else bench_serving() if app == "dlrm_serving"
+                 else bench_app(app))

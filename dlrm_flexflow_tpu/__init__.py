@@ -30,6 +30,8 @@ from .model import FFModel, TrainState
 from .optim import AdamOptimizer, SGDOptimizer
 from .parallel.mesh import make_mesh
 from .parallel.parallel_config import ParallelConfig, Strategy
+from .serving import (DeadlineExceeded, DynamicBatcher, InferenceEngine,
+                      LatencyStats, Rejected)
 from .tensor import Tensor
 
 __version__ = "0.1.0"
@@ -41,4 +43,6 @@ __all__ = [
     "GlorotUniform", "ZeroInitializer", "UniformInitializer",
     "NormInitializer", "ConstantInitializer",
     "get_loss", "compute_metrics", "MetricsAccumulator",
+    "InferenceEngine", "DynamicBatcher", "LatencyStats",
+    "Rejected", "DeadlineExceeded",
 ]

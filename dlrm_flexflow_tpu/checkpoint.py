@@ -169,9 +169,22 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
     return path
 
 
-def restore_checkpoint(path: str, model=None) -> TrainState:
+def restore_checkpoint(path: str, model=None,
+                       inference_only: bool = False) -> TrainState:
     """Read a checkpoint back into a TrainState; if ``model`` has an active
     mesh, parameters are re-placed with their strategy shardings.
+
+    ``inference_only=True`` is the serving mode (docs/serving.md): load
+    params (+ BN state + hetero host tables) WITHOUT requiring optimizer
+    slots in the archive — absent slots are fine and the returned state
+    carries ``opt_state={}``.  On the npz path present slots are skipped
+    UNREAD (never materialized); the orbax path restores the tree and
+    then drops them (a partial-restore spec would avoid even that —
+    acceptable until a serving host is memory-bound at restore time).
+    The default (training restore) instead REQUIRES the slots: resuming
+    on silently re-initialized optimizer state would corrupt the run,
+    so an archive without them raises :class:`CheckpointError` naming
+    the path and the fix.
 
     Raises :class:`CheckpointError` (naming the path and what is
     missing/corrupt) for a nonexistent directory, an absent or truncated
@@ -198,7 +211,10 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
 
         ckptr = ocp.PyTreeCheckpointer()
         ckpt = ckptr.restore(os.path.join(path, "state"))
-        state = TrainState(ckpt["params"], ckpt["opt_state"],
+        # inference-only drops the slots AFTER the tree restore (orbax
+        # reads the whole tree; the npz path below skips them unread)
+        opt_state = {} if inference_only else ckpt.get("opt_state") or {}
+        state = TrainState(ckpt["params"], opt_state,
                            ckpt["bn_state"], jnp.asarray(ckpt["rng"]),
                            jnp.asarray(ckpt["step"]))
         host_tables = ckpt.get("host_tables", {}) or {}
@@ -226,12 +242,20 @@ def restore_checkpoint(path: str, model=None) -> TrainState:
                 step = jnp.asarray(data[k])
             else:
                 head, rest = k.split("/", 1)
+                if inference_only and head == "opt_state":
+                    continue  # slots skipped UNREAD — never materialized
                 groups[head][rest] = jnp.asarray(data[k])
         state = TrainState(_unflatten(groups["params"]),
                            _unflatten(groups["opt_state"]),
                            _unflatten(groups["bn_state"]), rng, step)
         host_tables = {_unesc(k): np.asarray(v)
                        for k, v in groups["host_tables"].items()}
+    if not inference_only and not state.opt_state:
+        raise CheckpointError(
+            f"{path!r} holds no optimizer slots — it cannot seed a "
+            f"training resume (the optimizer would silently restart "
+            f"from scratch).  Pass inference_only=True to load params "
+            f"for serving (docs/serving.md)")
     if model is not None:
         # re-form parameters for the restoring model's storage mode
         # (logical checkpoints -> packed tables on single-chip TPU;

@@ -164,6 +164,21 @@ class FFConfig:
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)
     fit_scan_max_bytes: int = 2 * 1024 * 1024 * 1024
+    # --- Online serving (serving/, docs/serving.md) -------------------
+    # Batch-size buckets the InferenceEngine AOT-compiles; requests pad
+    # up to the enclosing bucket so steady-state serving never
+    # recompiles (comma-separated sizes, sorted/deduped at parse).
+    serve_buckets: str = "1,8,64,256"
+    # DynamicBatcher knobs: rows per micro-batch (0 = the top bucket),
+    # the max microseconds the oldest queued request waits before a
+    # partial batch dispatches, the bounded queue depth (a full queue
+    # SHEDS new requests with an explicit Rejected), and the default
+    # per-request deadline (0 = none; a request older than its deadline
+    # when popped completes with DeadlineExceeded).
+    serve_max_batch: int = 0
+    serve_max_wait_us: float = 2000.0
+    serve_queue_depth: int = 256
+    serve_timeout_us: float = 0.0
     # Fault-injection spec (resilience/faultinject.py), e.g.
     # "nan_grads@step=3,preempt@step=7" — testing knob proving the
     # recovery paths end-to-end; also settable via the FF_FAULTS env
@@ -215,6 +230,16 @@ class FFConfig:
                 cfg.embedding_dtype = nxt()
             elif a == "--faults":
                 cfg.faults = nxt()
+            elif a == "--serve-buckets":
+                cfg.serve_buckets = nxt()
+            elif a == "--serve-max-batch":
+                cfg.serve_max_batch = int(nxt())
+            elif a == "--serve-max-wait-us":
+                cfg.serve_max_wait_us = float(nxt())
+            elif a == "--serve-queue-depth":
+                cfg.serve_queue_depth = int(nxt())
+            elif a == "--serve-timeout-us":
+                cfg.serve_timeout_us = float(nxt())
             elif a in ("-d", "--devices", "-ll:gpu"):
                 # reference -ll:gpu N => N workers; here: device count
                 cfg.num_devices = int(nxt())

@@ -2175,8 +2175,35 @@ class FFModel:
         return self._eval_step(state, inputs, labels)
 
     def forward(self, state: TrainState, inputs):
+        return self.predict(state, inputs)
+
+    def predict(self, params_or_state, inputs):
+        """Labels-free inference: the public forward for serving.
+
+        ``params_or_state`` is a full :class:`TrainState` OR a bare
+        ``{op: {param: array}}`` params dict (optionally with no
+        optimizer slots anywhere in sight — an inference-only restore,
+        checkpoint.py) — the eval path without fabricating dummy labels
+        or optimizer state.  BatchNorm runs in eval mode (running
+        stats), so rows are independent and per-request outputs match
+        batched ones bit-for-bit (the serving engine's padding
+        contract, docs/serving.md)."""
+        if self._forward_fn is None:
+            raise ValueError("model must be compile()d before predict")
+        params = getattr(params_or_state, "params", params_or_state)
+        bn_state = getattr(params_or_state, "bn_state", None) or {}
+        if not bn_state and any(getattr(op, "has_state", False)
+                                for op in self.layers):
+            # a bare params dict on a BatchNorm model would silently
+            # fall back to BATCH statistics (conv.py eval path with
+            # state=None) — rows would leak into each other and padded
+            # serving outputs would differ from unpadded ones
+            raise ValueError(
+                "model has BatchNorm state; predict needs a TrainState "
+                "(or any object with .params/.bn_state) so eval runs on "
+                "running statistics, not a bare params dict")
         inputs = {k: self.shard_batch(v) for k, v in inputs.items()}
-        return self._forward_fn(state.params, inputs, state.bn_state)
+        return self._forward_fn(params, inputs, bn_state)
 
     def set_learning_rate(self, state: TrainState, lr: float) -> TrainState:
         """Return a state with the optimizer learning rate replaced (lr

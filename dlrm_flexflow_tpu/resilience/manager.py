@@ -264,16 +264,19 @@ class CheckpointManager:
     def latest(self) -> Optional[str]:
         return latest_checkpoint(self.directory)
 
-    def restore_latest(self, model=None
+    def restore_latest(self, model=None, inference_only: bool = False
                        ) -> Tuple[Any, Dict[str, Any], str]:
         """(state, extra, path) from the newest VALID checkpoint.
-        Raises :class:`CheckpointError` when the directory holds none."""
+        ``inference_only=True`` loads params without optimizer slots
+        (the serving engine's restore — checkpoint.py).  Raises
+        :class:`CheckpointError` when the directory holds none."""
         path = self.latest()
         if path is None:
             raise CheckpointError(
                 f"no valid checkpoint under {self.directory!r}")
         t0 = time.perf_counter()
-        state = restore_checkpoint(path, model=model)
+        state = restore_checkpoint(path, model=model,
+                                   inference_only=inference_only)
         extra: Dict[str, Any] = {}
         epath = os.path.join(path, EXTRA)
         if os.path.isfile(epath):

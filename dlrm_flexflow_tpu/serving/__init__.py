@@ -1,0 +1,39 @@
+"""Online serving subsystem (docs/serving.md).
+
+Turns a training checkpoint (or a live ``TrainState``) into low-latency,
+high-QPS predictions with the same telemetry and resilience discipline
+as training:
+
+  * :class:`InferenceEngine` — loads params (optimizer slots stripped),
+    AOT-compiles a donation-free forward per batch-size **bucket**
+    (``FFConfig.serve_buckets``), pads partial batches to the next
+    bucket; steady-state serving never recompiles and padded outputs
+    are bit-identical to unpadded ones.
+  * :class:`DynamicBatcher` — bounded request queue with
+    ``max_batch_size`` / ``max_wait_us`` micro-batching, explicit
+    overload shedding (:class:`Rejected`), per-request deadlines
+    (:class:`DeadlineExceeded`), graceful drain on ``close()``.
+  * :class:`LatencyStats` — p50/p95/p99/QPS accumulation feeding the
+    ``serve`` telemetry events and the report CLI's ``== serving ==``
+    section.
+
+Quick start::
+
+    from dlrm_flexflow_tpu.serving import DynamicBatcher, InferenceEngine
+
+    engine = InferenceEngine.from_checkpoint(model, "ckpts/")
+    with DynamicBatcher(engine) as batcher:
+        fut = batcher.submit({"dense": x, "sparse": ids})
+        scores = fut.result()
+    # batcher.close() drained and emitted the serving summary
+"""
+
+from .batcher import (DeadlineExceeded, DynamicBatcher, Rejected,
+                      ServeFuture)
+from .engine import DEFAULT_BUCKETS, InferenceEngine, parse_buckets
+from .stats import LatencyStats
+
+__all__ = [
+    "InferenceEngine", "DynamicBatcher", "ServeFuture", "LatencyStats",
+    "Rejected", "DeadlineExceeded", "DEFAULT_BUCKETS", "parse_buckets",
+]

@@ -1,0 +1,260 @@
+"""Bucketed AOT-compiled inference engine (docs/serving.md).
+
+Training has ``fit``; this is the serving twin: an
+:class:`InferenceEngine` wraps a compiled :class:`~..model.FFModel`
+with parameters from a live ``TrainState`` or a training checkpoint
+(optimizer slots stripped — serving carries no update state), and runs
+the labels-free forward at a fixed set of batch-size **buckets**.  Each
+bucket's program is AOT-compiled once (``lower().compile()``, donation-
+free — request buffers stay valid for retries) and partial batches pad
+up to the next bucket, so steady-state serving NEVER hits the jit cache
+with a new shape and never recompiles mid-traffic.  Padding rows are
+zeros and are sliced off before returning; eval-mode forwards are
+row-independent (BatchNorm uses running stats), so the first ``n`` rows
+of a padded bucket are bit-identical to the unpadded forward — pinned
+by ``tests/test_serving.py`` and ``scripts/check_serving.py``.
+
+Every dispatch emits one ``serve`` ``phase="dispatch"`` telemetry event
+(queue wait / compute wall / batch fill); bucket builds emit ``compile``
+``kind="aot"`` events like ``fit``'s epoch programs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+
+from ..telemetry import emit
+from .stats import LatencyStats
+
+DEFAULT_BUCKETS = (1, 8, 64, 256)
+
+
+def parse_buckets(spec) -> List[int]:
+    """Sorted unique positive bucket sizes from a config spec: a
+    ``"1,8,64,256"`` string (FFConfig.serve_buckets), any int sequence,
+    or None/"" for the default ladder."""
+    if spec is None:
+        return list(DEFAULT_BUCKETS)
+    if isinstance(spec, str):
+        parts = [p for p in spec.replace(" ", "").split(",") if p]
+        if not parts:
+            return list(DEFAULT_BUCKETS)
+        sizes = [int(p) for p in parts]
+    else:
+        sizes = [int(s) for s in spec]
+        if not sizes:
+            return list(DEFAULT_BUCKETS)
+    if any(s <= 0 for s in sizes):
+        raise ValueError(f"bucket sizes must be positive, got {sizes}")
+    return sorted(set(sizes))
+
+
+class InferenceEngine:
+    """Checkpoint/params -> low-latency bucketed predictions.
+
+    ``params_or_state``: a ``TrainState`` (optimizer slots are ignored)
+    or a bare ``{op: {param: array}}`` params dict; use
+    :meth:`from_checkpoint` to load one straight from a
+    ``CheckpointManager`` directory or a single committed checkpoint.
+
+    ``buckets`` overrides ``model.config.serve_buckets``.  ``aot=True``
+    (default off-mesh) builds each bucket's executable explicitly at
+    :meth:`warmup`; under a mesh the engine uses the jitted forward
+    (shapes still bucket-stable, so the cache is hit after warmup).
+    """
+
+    def __init__(self, model, params_or_state=None,
+                 buckets: Optional[Union[str, Sequence[int]]] = None,
+                 aot: Optional[bool] = None, warmup: bool = True,
+                 stats: Optional[LatencyStats] = None):
+        if getattr(model, "_forward_fn", None) is None:
+            raise ValueError(
+                "model must be compile()d before building an "
+                "InferenceEngine (no forward program exists yet)")
+        if params_or_state is None:
+            raise ValueError(
+                "InferenceEngine needs parameters: pass a TrainState or "
+                "params dict, or use InferenceEngine.from_checkpoint()")
+        self.model = model
+        # strip optimizer state: serving carries params + BN stats only
+        self._params = getattr(params_or_state, "params", params_or_state)
+        self._bn = getattr(params_or_state, "bn_state", None) or {}
+        if not self._bn and any(getattr(op, "has_state", False)
+                                for op in model.layers):
+            raise ValueError(
+                "model has BatchNorm state but none was provided — pass "
+                "a TrainState (bare params would serve on BATCH "
+                "statistics, breaking the bit-exact padding contract)")
+        if buckets is None:
+            buckets = getattr(model.config, "serve_buckets", None)
+        self.buckets = parse_buckets(buckets)
+        # AOT executables want addressable single-program arrays; under a
+        # mesh the jitted forward (XLA SPMD placement) is the right path
+        self._aot = (model.mesh is None) if aot is None else bool(aot)
+        self.stats = stats or LatencyStats()
+        self._in_specs = {t.name: (tuple(t.shape[1:]), t.dtype)
+                          for t in model._inputs}
+        self._compiled: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        if warmup:
+            self.warmup()
+
+    # ----------------------------------------------------------- construction
+    @classmethod
+    def from_checkpoint(cls, model, path: str, **kwargs) -> "InferenceEngine":
+        """Build an engine from a training checkpoint WITHOUT optimizer
+        slots in memory: ``path`` is either a ``CheckpointManager``
+        directory (the newest valid ``ckpt-<step>`` is used) or one
+        committed checkpoint directory.  Restores with
+        ``inference_only=True`` — archives missing optimizer slots load
+        fine, present slots are skipped."""
+        import os
+
+        from ..checkpoint import CheckpointError, restore_checkpoint
+        from ..resilience.manager import latest_checkpoint
+
+        ckpt = latest_checkpoint(path)
+        if ckpt is None:
+            # not a manager directory -> treat as one committed
+            # checkpoint dir; but a manager dir whose every ckpt-* is
+            # corrupt must say SO, not "no meta.json" about the parent
+            try:
+                has_entries = any(n.startswith("ckpt-")
+                                  for n in os.listdir(path))
+            except OSError:
+                has_entries = False
+            if has_entries:
+                raise CheckpointError(
+                    f"{path!r} contains checkpoints but none verify "
+                    f"(all corrupt/partial) — nothing to serve from")
+            ckpt = path
+        state = restore_checkpoint(ckpt, model=model, inference_only=True)
+        return cls(model, state, **kwargs)
+
+    # ------------------------------------------------------------ compilation
+    def warmup(self) -> None:
+        """Compile every bucket's forward outside the serving path, so
+        steady-state traffic never waits on XLA."""
+        for b in self.buckets:
+            self._ensure(b)
+
+    def _abstract_inputs(self, b: int) -> Dict[str, jax.ShapeDtypeStruct]:
+        return {name: jax.ShapeDtypeStruct((b,) + shape, dtype)
+                for name, (shape, dtype) in self._in_specs.items()}
+
+    def _ensure(self, b: int):
+        fn = self._compiled.get(b)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._compiled.get(b)
+            if fn is not None:
+                return fn
+            t0 = time.perf_counter()
+            if self._aot:
+                # donation-free explicit build: forward is jitted with no
+                # donate_argnums, so params/request buffers survive the
+                # call (a shed/retried request can be re-run)
+                fn = self.model._forward_fn.lower(
+                    self._params, self._abstract_inputs(b),
+                    self._bn).compile()
+                emit("compile", kind="aot", fn=f"serve[bucket={b}]",
+                     duration_s=time.perf_counter() - t0,
+                     donated_args=0, backend=jax.default_backend())
+            else:
+                # jit path (mesh): run one padded dummy batch through the
+                # jitted forward so the cache entry for this bucket's
+                # shape exists before traffic arrives (the jax.monitoring
+                # hook records the compile when telemetry is on)
+                dummy = {name: np.zeros((b,) + shape, dtype)
+                         for name, (shape, dtype)
+                         in self._in_specs.items()}
+                jax.block_until_ready(self._jit_call(
+                    self._params, dummy, self._bn))
+                fn = self._jit_call
+            self._compiled[b] = fn
+            return fn
+
+    def _jit_call(self, params, inputs, bn):
+        # same signature as the AOT executables; routes through the ONE
+        # public forward path (predict: shard_batch + jitted forward)
+        from types import SimpleNamespace
+
+        return self.model.predict(
+            SimpleNamespace(params=params, bn_state=bn), inputs)
+
+    # --------------------------------------------------------------- serving
+    def bucket_for(self, n: int) -> Optional[int]:
+        """The smallest bucket holding ``n`` rows, or None when ``n``
+        exceeds the largest bucket (predict then chunks by it)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return None
+
+    @staticmethod
+    def _pad(arr: np.ndarray, n: int, b: int) -> np.ndarray:
+        if n == b:
+            return arr
+        pad = np.zeros((b - n,) + arr.shape[1:], dtype=arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    def predict(self, inputs: Dict[str, Any], queue_wait_us: float = 0.0):
+        """Run the labels-free forward on ``inputs`` (dict name ->
+        (n, ...) array), padding to the enclosing bucket and slicing the
+        padding back off; batches larger than the top bucket run as
+        top-bucket chunks.  Returns host numpy outputs (a pytree when
+        the model has multiple outputs)."""
+        arrs = {}
+        n = None
+        for name, (_shape, dtype) in self._in_specs.items():
+            if name not in inputs:
+                raise ValueError(f"predict inputs missing {name!r} "
+                                 f"(model inputs: "
+                                 f"{sorted(self._in_specs)})")
+            # coerce to the compiled dtype (same as batcher.submit): an
+            # off-dtype request must not crash the AOT executable or
+            # recompile the jit path
+            a = np.asarray(inputs[name], dtype=dtype)
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError(
+                    f"inconsistent request batch: {name!r} has "
+                    f"{a.shape[0]} rows, expected {n}")
+            arrs[name] = a
+        if not n:
+            raise ValueError("empty request (0 rows)")
+        top = self.buckets[-1]
+        chunks = []
+        for lo in range(0, n, top):
+            m = min(n - lo, top)
+            chunks.append(self._dispatch(
+                {k: v[lo:lo + m] for k, v in arrs.items()}, m,
+                queue_wait_us))
+        if len(chunks) == 1:
+            return chunks[0]
+        return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0),
+                            *chunks)
+
+    def _dispatch(self, chunk: Dict[str, np.ndarray], m: int,
+                  queue_wait_us: float):
+        b = self.bucket_for(m)
+        padded = {k: self._pad(v, m, b) for k, v in chunk.items()}
+        fn = self._ensure(b)
+        t0 = time.perf_counter()
+        out = fn(self._params, padded, self._bn)
+        # host materialization IS the fence: results leave as numpy
+        out = jax.tree.map(lambda a: np.asarray(a)[:m], out)
+        compute_us = (time.perf_counter() - t0) * 1e6
+        self.stats.record_dispatch()
+        emit("serve", phase="dispatch", batch=m, bucket=b, padded=b - m,
+             fill=m / b, queue_wait_us=float(queue_wait_us),
+             compute_us=compute_us)
+        return out

@@ -1,0 +1,122 @@
+"""Serving latency statistics (docs/serving.md).
+
+One :class:`LatencyStats` per engine/batcher accumulates per-request
+end-to-end latencies plus the overload/deadline counters, and folds
+them into the ``serve`` ``phase="summary"`` telemetry event the report
+CLI's ``== serving ==`` section reads.  Percentiles use linear
+interpolation between closest ranks (numpy's default ``percentile``
+method) — the same convention every SRE dashboard assumes — and the
+math is pinned by ``tests/test_serving.py``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class LatencyStats:
+    """Thread-safe accumulator of per-request latencies (microseconds).
+
+    ``max_samples`` bounds memory for long-running servers: once full,
+    recording keeps COUNTING every request (``count`` / QPS stay exact)
+    and maintains a uniform RESERVOIR sample (Vitter's algorithm R) of
+    all latencies seen, so the percentile estimate keeps tracking live
+    traffic instead of freezing on the first ``max_samples``
+    (startup-era, compile-warm) requests.
+    """
+
+    def __init__(self, max_samples: int = 100_000):
+        self.max_samples = int(max_samples)
+        self._lat_us: List[float] = []
+        self._lock = threading.Lock()
+        self._rng = random.Random(0x5e41)  # reservoir replacement draws
+        self.count = 0
+        self.rejected = 0
+        self.deadline_misses = 0
+        self.dispatches = 0
+        self._t0 = time.perf_counter()
+
+    # ------------------------------------------------------------ recording
+    def record(self, lat_us: float) -> None:
+        with self._lock:
+            self.count += 1
+            if len(self._lat_us) < self.max_samples:
+                self._lat_us.append(float(lat_us))
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.max_samples:
+                    self._lat_us[j] = float(lat_us)
+
+    def record_many(self, lats_us) -> None:
+        for v in lats_us:
+            self.record(v)
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_deadline_miss(self) -> None:
+        with self._lock:
+            self.deadline_misses += 1
+
+    def record_dispatch(self) -> None:
+        with self._lock:
+            self.dispatches += 1
+
+    # ------------------------------------------------------------- reading
+    def percentile(self, p: float) -> Optional[float]:
+        """The p-th percentile (0..100) of recorded latencies in us, by
+        linear interpolation between closest ranks; None with no
+        samples."""
+        with self._lock:
+            if not self._lat_us:
+                return None
+            return float(np.percentile(np.asarray(self._lat_us), p))
+
+    @property
+    def mean_us(self) -> Optional[float]:
+        with self._lock:
+            if not self._lat_us:
+                return None
+            return float(np.mean(self._lat_us))
+
+    def summary(self, wall_s: Optional[float] = None) -> Dict[str, float]:
+        """The ``serve`` summary-event payload: request count, QPS over
+        ``wall_s`` (default: since construction), and the latency
+        percentiles.  ONE locked pass: counters and samples snapshot
+        together (a racing record() can't pair one instant's count with
+        another's percentiles) and the buffer converts once for all
+        three percentiles + the mean.  Fields with nothing to report
+        are absent — the telemetry layer drops None-valued fields the
+        same way."""
+        if wall_s is None:
+            wall_s = time.perf_counter() - self._t0
+        with self._lock:
+            out: Dict[str, float] = {
+                "requests": int(self.count),
+                "wall_s": float(wall_s),
+                "qps": float(self.count) / max(float(wall_s), 1e-9),
+                "dispatches": int(self.dispatches),
+                "rejected": int(self.rejected),
+                "deadline_misses": int(self.deadline_misses),
+            }
+            if self._lat_us:
+                a = np.asarray(self._lat_us)
+                p50, p95, p99 = np.percentile(a, [50, 95, 99])
+                out.update(p50_us=float(p50), p95_us=float(p95),
+                           p99_us=float(p99), mean_us=float(a.mean()))
+        return out
+
+    def emit_summary(self, wall_s: Optional[float] = None) -> Dict[str, float]:
+        """Emit the summary as one ``serve`` ``phase="summary"`` event
+        (no-op when telemetry is off) and return the payload."""
+        from ..telemetry import emit
+
+        s = self.summary(wall_s)
+        emit("serve", phase="summary", **s)
+        return s

@@ -13,6 +13,8 @@ typed, schema-checked events from every layer of the framework:
                   calibration fits (sim/search.py, sim/simulator.py)
   * ``op_time`` — per-op measured forward/backward next to the analytic
                   simulator's prediction (profiling.OpTimer)
+  * ``serve``   — online-serving dispatches, shed requests, and latency
+                  summaries (serving/, docs/serving.md)
 
 Activate with ``set_event_log(EventLog(path=...))`` or the scoped
 ``event_log(...)`` context manager; producers no-op when telemetry is

@@ -276,6 +276,59 @@ def resilience_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+def serving_summary(events: List[dict]) -> List[str]:
+    """Online-serving telemetry (serving/, docs/serving.md): dispatch
+    batching efficiency from per-dispatch events, p50/p95/p99 latency +
+    QPS from the summary event(s) a batcher drain or serve_bench run
+    emits."""
+    serves = [e for e in events if e.get("type") == "serve"]
+    if not serves:
+        return []
+    disp = [e for e in serves if e.get("phase") == "dispatch"]
+    rejects = [e for e in serves if e.get("phase") == "reject"]
+    sums = [e for e in serves if e.get("phase") == "summary"]
+    lines = ["== serving =="]
+    if disp:
+        rows = sum(int(e["batch"]) for e in disp)
+        fill = [e["fill"] for e in disp if "fill" in e]
+        line = (f"{len(disp)} dispatches, {rows} rows")
+        if fill:
+            line += f", mean batch fill {100.0 * sum(fill) / len(fill):.0f}%"
+        buckets = sorted({int(e["bucket"]) for e in disp})
+        line += f" (buckets hit: {buckets})"
+        lines.append(line)
+        qw = [e["queue_wait_us"] for e in disp]
+        cu = [e["compute_us"] for e in disp]
+        lines.append(f"per dispatch: queue wait mean "
+                     f"{sum(qw) / len(qw):.0f} us, compute mean "
+                     f"{sum(cu) / len(cu):.0f} us")
+    if rejects:
+        by_r: Dict[str, int] = {}
+        for e in rejects:
+            by_r[e.get("reason", "?")] = by_r.get(e.get("reason", "?"),
+                                                  0) + 1
+        lines.append("shed: " + ", ".join(f"{n} {r}"
+                                          for r, n in sorted(by_r.items())))
+    for e in sums:
+        line = (f"summary: {e['requests']} requests, "
+                f"{e['qps']:,.0f} QPS")
+        if "wall_s" in e:
+            line += f" over {e['wall_s']:.2f}s"
+        if "p50_us" in e:
+            line += (f"; latency p50 {e['p50_us']:.0f} us"
+                     f" / p95 {e.get('p95_us', float('nan')):.0f} us"
+                     f" / p99 {e.get('p99_us', float('nan')):.0f} us")
+        parts = []
+        if e.get("rejected"):
+            parts.append(f"{e['rejected']} rejected")
+        if e.get("deadline_misses"):
+            parts.append(f"{e['deadline_misses']} deadline misses")
+        if parts:
+            line += f" ({', '.join(parts)})"
+        lines.append(line)
+    return lines
+
+
 def format_report(events: List[dict]) -> str:
     if not events:
         return "(no events)"
@@ -287,7 +340,7 @@ def format_report(events: List[dict]) -> str:
              + ", ".join(f"{len(v)} {k}" for k, v in sorted(by.items()))]
     for section in (throughput_summary, per_op_table, calibration_summary,
                     compile_timeline, memory_summary, search_summary,
-                    resilience_summary):
+                    resilience_summary, serving_summary):
         part = section(events)
         if part:
             lines.append("")

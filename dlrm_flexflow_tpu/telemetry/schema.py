@@ -106,6 +106,26 @@ SCHEMA: Dict[str, dict] = {
         "optional": {"step": int, "action": str, "rollbacks": int,
                      "policy": str, "loss": float, "lr": float},
     },
+    # online serving (serving/, docs/serving.md).  ``phase`` selects the
+    # sub-shape: one engine dispatch (a padded bucket run), one shed or
+    # deadline-missed request, or the run's latency summary the report
+    # CLI's "== serving ==" section reads.
+    "serve": {
+        "required": {"phase": str},
+        "optional": {"batch": int, "bucket": int, "padded": int,
+                     "fill": float, "queue_wait_us": float,
+                     "compute_us": float, "reason": str,
+                     "requests": int, "dispatches": int,
+                     "rejected": int, "deadline_misses": int,
+                     "wall_s": float, "qps": float, "p50_us": float,
+                     "p95_us": float, "p99_us": float, "mean_us": float},
+        "phases": {
+            "dispatch": ("batch", "bucket", "queue_wait_us",
+                         "compute_us"),
+            "reject": ("reason",),
+            "summary": ("requests", "qps"),
+        },
+    },
     # one injected fault firing (resilience/faultinject.py) — recovery
     # tests read these next to the checkpoint/anomaly events the fault
     # provoked.  ``point``: "step" | "save" | "restore"; ``remaining``:

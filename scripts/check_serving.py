@@ -1,0 +1,193 @@
+"""Serving smoke matrix (tier-1: tests/test_serving.py runs it).
+
+End-to-end scenarios on a tiny DLRM, CPU backend — the serving analogue
+of ``check_resilience.py`` (docs/serving.md):
+
+  1. checkpoint -> engine — a training checkpoint (CheckpointManager,
+     optimizer slots present in the archive) loads inference-only and
+     the engine's padded bucketed outputs are bit-identical to direct
+     ``FFModel.predict`` on the restored params;
+  2. concurrent traffic — many client threads through the
+     DynamicBatcher; every response matches the single-request answer
+     bit-for-bit (micro-batching must never change results);
+  3. overload shed — a full bounded queue rejects new requests with an
+     explicit ``Rejected`` (and a ``serve`` reject event), it never
+     queues unbounded work;
+  4. graceful drain — ``close()`` delivers every in-flight response
+     before shutdown and emits the latency summary with percentiles.
+
+Exit 0 when every scenario passes; prints one line per scenario and
+exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.resilience import CheckpointManager  # noqa: E402
+from dlrm_flexflow_tpu.serving import (DynamicBatcher,  # noqa: E402
+                                       InferenceEngine, Rejected)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+
+BUCKETS = "2,4,8"
+
+
+def make_model():
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 48],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8, serve_buckets=BUCKETS))
+    m.compile(optimizer=ff.AdamOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return cfg, m
+
+
+def make_request(cfg, rng, n=1):
+    return {"dense": rng.standard_normal((n, cfg.mlp_bot[0])).astype(
+                np.float32),
+            "sparse": np.stack(
+                [rng.integers(0, r, size=(n, cfg.embedding_bag_size),
+                              dtype=np.int64)
+                 for r in cfg.embedding_size], axis=1)}
+
+
+def scenario_checkpoint_to_engine(cfg, m) -> str:
+    d = tempfile.mkdtemp(prefix="serve_ckpt_")
+    state = m.init(seed=0)
+    if CheckpointManager(d, keep_n=2).save(state, model=m, step=1) is None:
+        return "checkpoint save failed"
+    engine = InferenceEngine.from_checkpoint(m, d)
+    if engine._params is state.params:
+        return "engine took live params, not the checkpoint's"
+    rng = np.random.default_rng(1)
+    for n in (1, 3, 4, 7, 11):  # exercises padding AND top-bucket chunking
+        x = make_request(cfg, rng, n)
+        got = engine.predict(x)
+        want = np.asarray(m.predict(state, x))
+        if got.shape != want.shape:
+            return f"n={n}: shape {got.shape} != {want.shape}"
+        if not np.array_equal(got, want):
+            return (f"n={n}: padded bucket output differs from direct "
+                    f"predict by {np.abs(got - want).max()}")
+    return ""
+
+
+def scenario_concurrent_traffic(cfg, m) -> str:
+    state = m.init(seed=0)
+    engine = InferenceEngine(m, state)
+    rng = np.random.default_rng(2)
+    reqs = [make_request(cfg, rng, 1 + (i % 3)) for i in range(24)]
+    want = [np.asarray(m.predict(state, r)) for r in reqs]
+    got = [None] * len(reqs)
+    errs = []
+    with DynamicBatcher(engine, max_wait_us=500) as batcher:
+        def client(i):
+            try:
+                got[i] = batcher.predict(reqs[i], result_timeout_s=30)
+            except Exception as e:  # noqa: BLE001 — collected, reported
+                errs.append(f"request {i}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errs:
+        return "; ".join(errs[:3])
+    for i, (g, w) in enumerate(zip(got, want)):
+        if g is None or not np.array_equal(g, w):
+            return f"request {i}: batched answer differs from direct"
+    return ""
+
+
+def scenario_overload_shed(cfg, m) -> str:
+    engine = InferenceEngine(m, m.init(seed=0))
+    rng = np.random.default_rng(3)
+    with event_log() as log:
+        # dispatcher NOT started: the bounded queue must fill and shed
+        batcher = DynamicBatcher(engine, queue_depth=4, autostart=False)
+        for _ in range(4):
+            batcher.submit(make_request(cfg, rng))
+        try:
+            batcher.submit(make_request(cfg, rng))
+            return "5th request on a depth-4 queue was not rejected"
+        except Rejected:
+            pass
+        ev = log.last("serve")
+        if ev is None or ev.get("phase") != "reject" \
+                or ev.get("reason") != "queue_full":
+            return f"no queue_full reject event ({ev!r})"
+        batcher.close()  # drains the 4 queued requests
+    if batcher.stats.count != 4:
+        return f"drain served {batcher.stats.count} of 4 queued"
+    return ""
+
+
+def scenario_graceful_drain(cfg, m) -> str:
+    engine = InferenceEngine(m, m.init(seed=0))
+    rng = np.random.default_rng(4)
+    with event_log() as log:
+        batcher = DynamicBatcher(engine, queue_depth=64, autostart=False)
+        futs = [batcher.submit(make_request(cfg, rng)) for _ in range(12)]
+        summary = batcher.close()  # graceful: starts, drains, delivers
+        for i, f in enumerate(futs):
+            if not f.done():
+                return f"future {i} undelivered after drain"
+            f.result(0)  # raises if it was cancelled instead of served
+        if summary["requests"] != 12:
+            return f"summary counted {summary['requests']} of 12"
+        for k in ("p50_us", "p95_us", "p99_us", "qps"):
+            if k not in summary:
+                return f"summary missing {k}"
+        ev = log.last("serve")
+        if ev is None or ev.get("phase") != "summary":
+            return f"no serve summary event ({ev!r})"
+    try:
+        batcher.submit(make_request(cfg, rng))
+        return "submit after close was not rejected"
+    except Rejected:
+        pass
+    return ""
+
+
+SCENARIOS = [
+    ("checkpoint->engine bit-exact buckets", scenario_checkpoint_to_engine),
+    ("concurrent micro-batched traffic", scenario_concurrent_traffic),
+    ("overload shedding", scenario_overload_shed),
+    ("graceful drain", scenario_graceful_drain),
+]
+
+
+def main() -> int:
+    cfg, m = make_model()  # one compile shared by the whole matrix
+    failed = 0
+    for name, fn in SCENARIOS:
+        try:
+            err = fn(cfg, m)
+        except Exception as e:  # a scenario must fail loudly, not crash
+            err = f"raised {e!r}"
+        if err:
+            print(f"check_serving: {name}: FAIL — {err}")
+            failed += 1
+        else:
+            print(f"check_serving: {name}: OK")
+    if failed:
+        return 1
+    print(f"check_serving: OK ({len(SCENARIOS)} serving paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

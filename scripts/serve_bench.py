@@ -1,0 +1,216 @@
+"""Serving load generator: open/closed-loop QPS + latency measurement.
+
+Drives a DLRM :class:`InferenceEngine` + :class:`DynamicBatcher`
+(docs/serving.md) with synthetic request traffic and reports
+p50/p95/p99 latency and QPS — the serving twin of the training
+``bench.py`` windows:
+
+  * **closed loop** (default): ``--clients`` threads each fire
+    ``--requests`` back-to-back requests (each waits for its response
+    before sending the next) — measures sustainable throughput at a
+    fixed concurrency;
+  * **open loop**: requests arrive at a fixed ``--qps`` schedule for
+    ``--duration`` seconds regardless of completions (the
+    coordinated-omission-free arrival model) — measures behavior under
+    offered load, including explicit `Rejected` shedding when the
+    bounded queue fills.
+
+Telemetry lands in a JSONL (default ``telemetry_serving.jsonl`` next to
+this script's repo root; ``--telemetry`` overrides) whose ``serve``
+events feed::
+
+    python -m dlrm_flexflow_tpu.telemetry report telemetry_serving.jsonl
+
+which prints the ``== serving ==`` section this run produced.  With
+``--checkpoint DIR`` the engine loads params from a training
+checkpoint (optimizer slots skipped — checkpoint.py inference-only
+restore) instead of a fresh init.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+if __name__ == "__main__":
+    # standalone default; NOT set when bench.py imports closed_loop on
+    # a real accelerator (backend init is lazy, so this is early enough)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.serving import (DynamicBatcher,  # noqa: E402
+                                       InferenceEngine, Rejected)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+
+
+def build_model(args):
+    cfg = DLRMConfig(sparse_feature_size=args.emb_dim,
+                     embedding_size=[args.table_rows] * args.tables,
+                     embedding_bag_size=args.bag,
+                     mlp_bot=[args.dense, 32, args.emb_dim],
+                     mlp_top=[args.emb_dim * args.tables + args.emb_dim,
+                              32, 1])
+    fc = ff.FFConfig(batch_size=max_bucket(args),
+                     serve_buckets=args.buckets,
+                     serve_max_wait_us=args.max_wait_us,
+                     serve_queue_depth=args.queue_depth,
+                     serve_timeout_us=args.timeout_us)
+    m = build_dlrm(cfg, fc)
+    m.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return cfg, m
+
+
+def max_bucket(args) -> int:
+    from dlrm_flexflow_tpu.serving import parse_buckets
+
+    return parse_buckets(args.buckets)[-1]
+
+
+def request_pool(cfg, args, n_pool: int = 256):
+    """Pre-generate a pool of requests so the load loop measures
+    serving, not numpy RNG."""
+    rng = np.random.default_rng(args.seed)
+    pool = []
+    for _ in range(n_pool):
+        n = args.rows
+        pool.append({
+            "dense": rng.standard_normal(
+                (n, cfg.mlp_bot[0])).astype(np.float32),
+            "sparse": np.stack(
+                [rng.integers(0, r, size=(n, cfg.embedding_bag_size),
+                              dtype=np.int64)
+                 for r in cfg.embedding_size], axis=1),
+        })
+    return pool
+
+
+def closed_loop(batcher, pool, clients: int, requests: int):
+    """``clients`` threads, each ``requests`` sequential requests
+    (every client waits for its response before sending the next).
+    Returns (wall_s, rejected).  THE closed-loop harness — bench.py's
+    ``BENCH_APP=dlrm_serving`` headline drives the same code."""
+    rejected = [0] * clients
+
+    def client(i):
+        for k in range(requests):
+            try:
+                batcher.predict(pool[(i * requests + k) % len(pool)])
+            except Rejected:
+                rejected[i] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, sum(rejected)
+
+
+def open_loop(batcher, pool, qps: float, duration: float):
+    """Fixed-rate arrivals for ``duration`` seconds; responses are
+    collected after the offered-load window closes (submit never
+    blocks on a result).  Returns (wall_s, rejected)."""
+    futures = []
+    rejected = 0
+    period = 1.0 / max(qps, 1e-9)
+    t0 = time.perf_counter()
+    k = 0
+    while True:
+        now = time.perf_counter()
+        if now - t0 >= duration:
+            break
+        target = t0 + k * period
+        if target > now:
+            time.sleep(target - now)
+        try:
+            futures.append(batcher.submit(pool[k % len(pool)]))
+        except Rejected:
+            rejected += 1
+        k += 1
+    for f in futures:
+        try:
+            f.result(timeout=30.0)
+        except Exception:
+            pass  # deadline misses / cancelled drains counted in stats
+    # wall spans submit THROUGH completion of everything offered, so
+    # served/wall is sustainable throughput — stopping the clock at the
+    # window edge would credit the post-window backlog drain as free
+    return time.perf_counter() - t0, rejected
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--clients", type=int, default=4,
+                   help="closed-loop concurrent clients")
+    p.add_argument("--requests", type=int, default=64,
+                   help="closed-loop requests per client")
+    p.add_argument("--qps", type=float, default=500.0,
+                   help="open-loop offered arrival rate")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="open-loop window seconds")
+    p.add_argument("--rows", type=int, default=1,
+                   help="rows per request")
+    p.add_argument("--buckets", default="1,8,32")
+    p.add_argument("--max-wait-us", type=float, default=1000.0)
+    p.add_argument("--queue-depth", type=int, default=256)
+    p.add_argument("--timeout-us", type=float, default=0.0)
+    p.add_argument("--tables", type=int, default=4)
+    p.add_argument("--table-rows", type=int, default=1000)
+    p.add_argument("--emb-dim", type=int, default=8)
+    p.add_argument("--bag", type=int, default=2)
+    p.add_argument("--dense", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint", default="",
+                   help="CheckpointManager dir (or one ckpt dir) to "
+                        "load params from (inference-only restore)")
+    p.add_argument("--telemetry",
+                   default=os.path.join(REPO, "telemetry_serving.jsonl"))
+    args = p.parse_args(argv)
+
+    cfg, model = build_model(args)
+    with event_log(args.telemetry, mode="w"):
+        if args.checkpoint:
+            engine = InferenceEngine.from_checkpoint(model, args.checkpoint)
+        else:
+            engine = InferenceEngine(model, model.init(seed=args.seed))
+        pool = request_pool(cfg, args)
+        batcher = DynamicBatcher(engine)
+        if args.mode == "closed":
+            wall, rejected = closed_loop(batcher, pool, args.clients,
+                                         args.requests)
+        else:
+            wall, rejected = open_loop(batcher, pool, args.qps,
+                                       args.duration)
+        summary = batcher.close()  # drains + emits the serve summary
+    served = summary["requests"]
+    qps = served / max(wall, 1e-9)
+    line = (f"serve_bench[{args.mode}]: {served} requests in "
+            f"{wall:.2f}s = {qps:,.0f} QPS")
+    if "p50_us" in summary:
+        line += (f"; latency p50 {summary['p50_us']:.0f} us / "
+                 f"p95 {summary['p95_us']:.0f} us / "
+                 f"p99 {summary['p99_us']:.0f} us")
+    if rejected or summary.get("deadline_misses"):
+        line += (f" ({rejected} rejected, "
+                 f"{summary.get('deadline_misses', 0)} deadline misses)")
+    print(line)
+    print(f"serve_bench: telemetry -> {args.telemetry} "
+          f"(python -m dlrm_flexflow_tpu.telemetry report "
+          f"{os.path.relpath(args.telemetry, os.getcwd())})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,393 @@
+"""Online serving tests (serving/, docs/serving.md): bucket selection +
+padding bit-exactness vs direct ``FFModel.predict``, inference-only
+checkpoint restore, queue shedding under overload, per-request deadline
+timeouts, graceful drain, latency-stat math, serve telemetry + report
+section, and the tier-1 smoke matrix."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.checkpoint import (CheckpointError,
+                                          restore_checkpoint,
+                                          save_checkpoint)
+from dlrm_flexflow_tpu.model import TrainState
+from dlrm_flexflow_tpu.resilience import CheckpointManager
+from dlrm_flexflow_tpu.serving import (DeadlineExceeded, DynamicBatcher,
+                                       InferenceEngine, LatencyStats,
+                                       Rejected, parse_buckets)
+from dlrm_flexflow_tpu.telemetry import event_log
+from dlrm_flexflow_tpu.telemetry.report import format_report, load_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def served():
+    """(cfg, model, state, engine) — one compile for the whole module."""
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 48],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8, serve_buckets="2,4,8"))
+    m.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    state = m.init(seed=0)
+    engine = InferenceEngine(m, state)
+    return cfg, m, state, engine
+
+
+def make_request(cfg, rng, n=1):
+    return {"dense": rng.standard_normal((n, cfg.mlp_bot[0])).astype(
+                np.float32),
+            "sparse": np.stack(
+                [rng.integers(0, r, size=(n, cfg.embedding_bag_size),
+                              dtype=np.int64)
+                 for r in cfg.embedding_size], axis=1)}
+
+
+# ------------------------------------------------------------------ buckets
+
+class TestBuckets:
+    def test_parse_buckets(self):
+        assert parse_buckets("1,8,64,256") == [1, 8, 64, 256]
+        assert parse_buckets("8, 1,8") == [1, 8]  # sorted, deduped
+        assert parse_buckets([4, 2]) == [2, 4]
+        assert parse_buckets(None) == [1, 8, 64, 256]
+        assert parse_buckets("") == [1, 8, 64, 256]
+        with pytest.raises(ValueError):
+            parse_buckets("0,8")
+
+    def test_bucket_selection(self, served):
+        _, _, _, engine = served
+        assert engine.buckets == [2, 4, 8]
+        assert engine.bucket_for(1) == 2
+        assert engine.bucket_for(2) == 2
+        assert engine.bucket_for(3) == 4
+        assert engine.bucket_for(8) == 8
+        assert engine.bucket_for(9) is None  # predict chunks by 8
+
+    def test_steady_state_never_recompiles(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        before = dict(engine._compiled)
+        for n in (1, 2, 3, 5, 8):
+            engine.predict(make_request(cfg, rng, n))
+        assert engine._compiled == before  # warmup built everything
+
+
+# ---------------------------------------------------- padding bit-exactness
+
+class TestPaddingBitExact:
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8])
+    def test_padded_bucket_matches_direct_predict(self, served, n):
+        cfg, m, state, engine = served
+        x = make_request(cfg, np.random.default_rng(n), n)
+        got = engine.predict(x)
+        want = np.asarray(m.predict(state, x))
+        assert got.shape == want.shape
+        assert np.array_equal(got, want)
+
+    def test_top_bucket_chunking(self, served):
+        cfg, m, state, engine = served
+        x = make_request(cfg, np.random.default_rng(99), 19)  # 8+8+3
+        got = engine.predict(x)
+        want = np.asarray(m.predict(state, x))
+        assert got.shape == want.shape
+        assert np.array_equal(got, want)
+
+    def test_jit_fallback_engine_matches_aot(self, served):
+        # aot=False is the mesh path: the jitted forward serves instead
+        # of explicit executables — numerics must be identical
+        cfg, m, state, _ = served
+        engine = InferenceEngine(m, state, buckets=[2], aot=False)
+        x = make_request(cfg, np.random.default_rng(3), 1)
+        assert np.array_equal(engine.predict(x),
+                              np.asarray(m.predict(state, x)))
+
+    def test_predict_accepts_bare_params_dict(self, served):
+        cfg, m, state, _ = served
+        x = make_request(cfg, np.random.default_rng(5), 3)
+        a = np.asarray(m.predict(state, x))
+        b = np.asarray(m.predict(state.params, x))
+        assert np.array_equal(a, b)
+
+    def test_bare_params_on_bn_model_refused(self):
+        # a bare params dict on a BatchNorm model would silently serve
+        # on BATCH statistics — rows leaking into each other breaks the
+        # bit-exact padding contract, so predict/engine refuse loudly
+        m = ff.FFModel(ff.FFConfig(batch_size=8, serve_buckets="4"))
+        x = m.create_tensor((8, 4, 2, 2), name="x")
+        h = m.batch_norm(x)
+        m.dense(m.flat(h), 1)
+        m.compile(optimizer=ff.SGDOptimizer(0.01),
+                  loss_type="mean_squared_error", metrics=(), mesh=False)
+        state = m.init(seed=0)
+        req = {"x": np.zeros((2, 4, 2, 2), np.float32)}
+        with pytest.raises(ValueError, match="BatchNorm"):
+            m.predict(state.params, req)
+        with pytest.raises(ValueError, match="BatchNorm"):
+            InferenceEngine(m, state.params, warmup=False)
+        # the full state works, and padding stays bit-exact
+        engine = InferenceEngine(m, state)
+        assert np.array_equal(engine.predict(req),
+                              np.asarray(m.predict(state, req)))
+
+    def test_engine_rejects_bad_requests(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        with pytest.raises(ValueError, match="missing"):
+            engine.predict({"dense": np.zeros((2, 4), np.float32)})
+        bad = make_request(cfg, rng, 2)
+        bad["dense"] = bad["dense"][:1]
+        with pytest.raises(ValueError, match="inconsistent"):
+            engine.predict(bad)
+
+
+# --------------------------------------------- inference-only restore
+
+class TestInferenceOnlyRestore:
+    def test_full_ckpt_slots_skipped(self, served, tmp_path):
+        _, m, state, _ = served
+        p = str(tmp_path / "full")
+        save_checkpoint(p, state, use_orbax=False, model=m)
+        st = restore_checkpoint(p, model=m, inference_only=True)
+        assert st.opt_state == {}
+        for op, d in state.params.items():
+            for k, v in d.items():
+                assert np.array_equal(np.asarray(v),
+                                      np.asarray(st.params[op][k]))
+
+    def test_slotless_archive_needs_inference_only(self, served, tmp_path):
+        cfg, m, state, _ = served
+        p = str(tmp_path / "noslots")
+        bare = TrainState(state.params, {}, state.bn_state, state.rng,
+                          state.step)
+        save_checkpoint(p, bare, use_orbax=False, model=m)
+        with pytest.raises(CheckpointError, match="optimizer slots"):
+            restore_checkpoint(p, model=m)
+        st = restore_checkpoint(p, model=m, inference_only=True)
+        x = make_request(cfg, np.random.default_rng(1), 2)
+        engine = InferenceEngine(m, st, buckets=[2])
+        assert np.array_equal(engine.predict(x),
+                              np.asarray(m.predict(state, x)))
+
+    def test_manager_restore_latest_inference_only(self, served, tmp_path):
+        _, m, state, _ = served
+        mgr = CheckpointManager(str(tmp_path), keep_n=2, use_orbax=False)
+        assert mgr.save(state, model=m, step=3) is not None
+        st, _extra, path = mgr.restore_latest(model=m, inference_only=True)
+        assert path.endswith("ckpt-3")
+        assert st.opt_state == {}
+
+    def test_from_checkpoint_all_corrupt_names_the_problem(self, served,
+                                                           tmp_path):
+        _, m, state, _ = served
+        mgr = CheckpointManager(str(tmp_path), keep_n=2, use_orbax=False)
+        p = mgr.save(state, model=m, step=1)
+        with open(os.path.join(p, "manifest.json"), "w") as f:
+            f.write("{}")  # kills verification for the only checkpoint
+        with pytest.raises(CheckpointError, match="none verify"):
+            InferenceEngine.from_checkpoint(m, str(tmp_path))
+
+    def test_from_checkpoint_on_manager_dir(self, served, tmp_path):
+        cfg, m, state, _ = served
+        mgr = CheckpointManager(str(tmp_path), keep_n=2, use_orbax=False)
+        assert mgr.save(state, model=m, step=1) is not None
+        engine = InferenceEngine.from_checkpoint(m, str(tmp_path),
+                                                 buckets=[4])
+        x = make_request(cfg, np.random.default_rng(2), 3)
+        assert np.array_equal(engine.predict(x),
+                              np.asarray(m.predict(state, x)))
+
+
+# ------------------------------------------------------------- batcher
+
+class TestBatcher:
+    def test_queue_shedding_under_overload(self, served):
+        _, m, state, engine = served
+        cfg = served[0]
+        rng = np.random.default_rng(0)
+        with event_log() as log:
+            b = DynamicBatcher(engine, queue_depth=3, autostart=False)
+            futs = [b.submit(make_request(cfg, rng)) for _ in range(3)]
+            with pytest.raises(Rejected, match="full"):
+                b.submit(make_request(cfg, rng))
+            ev = log.last("serve")
+            assert ev["phase"] == "reject" and ev["reason"] == "queue_full"
+            b.close()  # graceful: the 3 queued still get answers
+        for f in futs:
+            assert f.done()
+            f.result(0)
+        assert b.stats.rejected == 1
+
+    def test_deadline_timeout(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        with event_log() as log:
+            b = DynamicBatcher(engine, autostart=False)
+            fut = b.submit(make_request(cfg, rng), timeout_us=1000.0)
+            time.sleep(0.02)  # 20 ms >> the 1 ms deadline
+            b.start()
+            with pytest.raises(DeadlineExceeded):
+                fut.result(10)
+            b.close()
+            evs = [e for e in log.events("serve")
+                   if e.get("phase") == "reject"]
+        assert any(e.get("reason") == "deadline" for e in evs)
+        assert b.stats.deadline_misses == 1
+
+    def test_graceful_drain_delivers_all(self, served):
+        cfg, m, state, engine = served
+        rng = np.random.default_rng(0)
+        reqs = [make_request(cfg, rng, 1 + i % 2) for i in range(9)]
+        want = [np.asarray(m.predict(state, r)) for r in reqs]
+        b = DynamicBatcher(engine, queue_depth=32, autostart=False)
+        futs = [b.submit(r) for r in reqs]
+        summary = b.close()  # starts the dispatcher, drains, delivers
+        for f, w in zip(futs, want):
+            assert f.done()
+            assert np.array_equal(f.result(0), w)
+        assert summary["requests"] == 9
+        with pytest.raises(Rejected, match="shut down"):
+            b.submit(reqs[0])
+
+    def test_close_without_drain_cancels(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        b = DynamicBatcher(engine, queue_depth=8, autostart=False)
+        futs = [b.submit(make_request(cfg, rng)) for _ in range(4)]
+        b.close(drain=False)
+        for f in futs:
+            with pytest.raises(Rejected):
+                f.result(1)
+
+    def test_oversized_request_refused(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        b = DynamicBatcher(engine, max_batch_size=4, autostart=False)
+        with pytest.raises(ValueError, match="max_batch_size"):
+            b.submit(make_request(cfg, rng, 5))
+        b.close()
+
+    def test_single_unbatched_sample(self, served):
+        cfg, m, state, engine = served
+        rng = np.random.default_rng(7)
+        x = make_request(cfg, rng, 1)
+        flat = {k: v[0] for k, v in x.items()}  # feature-shaped sample
+        with DynamicBatcher(engine, max_wait_us=200) as b:
+            out = b.predict(flat, result_timeout_s=30)
+        assert np.array_equal(out, np.asarray(m.predict(state, x)))
+
+
+# ------------------------------------------------------------ latency stats
+
+class TestLatencyStats:
+    def test_percentile_math(self):
+        s = LatencyStats()
+        for v in (100.0, 200.0, 300.0, 400.0, 500.0,
+                  600.0, 700.0, 800.0, 900.0, 1000.0):
+            s.record(v)
+        # numpy linear interpolation between closest ranks: rank
+        # p/100 * (n-1) = 8.55 for p95 -> 900 + 0.55 * 100
+        assert s.percentile(50) == pytest.approx(550.0)
+        assert s.percentile(95) == pytest.approx(955.0)
+        assert s.percentile(99) == pytest.approx(991.0)
+        assert s.percentile(0) == 100.0 and s.percentile(100) == 1000.0
+        assert s.mean_us == pytest.approx(550.0)
+
+    def test_summary_fields_and_qps(self):
+        s = LatencyStats()
+        s.record_many([1000.0] * 50)
+        s.record_reject()
+        s.record_deadline_miss()
+        s.record_dispatch()
+        out = s.summary(wall_s=2.0)
+        assert out["requests"] == 50
+        assert out["qps"] == pytest.approx(25.0)
+        assert out["rejected"] == 1 and out["deadline_misses"] == 1
+        assert out["dispatches"] == 1
+        assert out["p50_us"] == out["p99_us"] == 1000.0
+
+    def test_empty_stats(self):
+        s = LatencyStats()
+        assert s.percentile(50) is None and s.mean_us is None
+        out = s.summary(wall_s=1.0)
+        assert out["requests"] == 0 and "p50_us" not in out
+
+    def test_sample_cap_keeps_counting(self):
+        s = LatencyStats(max_samples=4)
+        s.record_many([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+        assert s.count == 6              # QPS math stays exact
+        assert len(s._lat_us) == 4       # reservoir stays bounded
+        assert 1.0 <= s.percentile(50) <= 6.0
+
+    def test_reservoir_tracks_late_traffic(self):
+        # a latency shift AFTER the reservoir fills must still move the
+        # percentiles (algorithm R replaces uniformly, never freezes)
+        s = LatencyStats(max_samples=100)
+        s.record_many([100.0] * 100)
+        s.record_many([10_000.0] * 900)
+        assert s.count == 1000
+        assert s.percentile(50) == 10_000.0  # ~90% of reservoir is new
+
+
+# --------------------------------------------------------- telemetry/report
+
+class TestServeTelemetry:
+    def test_serve_events_and_report_section(self, served, tmp_path):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        path = str(tmp_path / "serve.jsonl")
+        with event_log(path, mode="w"):
+            with DynamicBatcher(engine, max_wait_us=200) as b:
+                for _ in range(5):
+                    b.predict(make_request(cfg, rng), result_timeout_s=30)
+        rep = format_report(load_events(path))
+        assert "== serving ==" in rep
+        assert "dispatches" in rep
+        assert "p50" in rep and "p95" in rep and "p99" in rep
+        assert "QPS" in rep
+
+    def test_dispatch_event_shape(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        with event_log() as log:
+            engine.predict(make_request(cfg, rng, 3))
+            ev = log.last("serve")
+        assert ev["phase"] == "dispatch"
+        assert ev["batch"] == 3 and ev["bucket"] == 4 and ev["padded"] == 1
+        assert ev["queue_wait_us"] == 0.0 and ev["compute_us"] > 0
+        assert ev["fill"] == pytest.approx(0.75)
+
+
+# ------------------------------------------------------------------ tooling
+
+class TestServingTooling:
+    def test_smoke_matrix_passes(self):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_serving.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK (4 serving paths)" in r.stdout
+
+    def test_serve_bench_reports_latency(self, tmp_path):
+        tele = str(tmp_path / "tele.jsonl")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "serve_bench.py"),
+             "--clients", "2", "--requests", "4", "--table-rows", "64",
+             "--buckets", "1,4", "--telemetry", tele],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "QPS" in r.stdout and "p50" in r.stdout
+        rep = format_report(load_events(tele))
+        assert "== serving ==" in rep and "p50" in rep and "QPS" in rep
