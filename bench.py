@@ -93,12 +93,13 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
 
 
 def _telemetry_ctx(app):
-    """Scoped EventLog for one bench run, written next to
-    bench_history.json as ``telemetry_<app>.jsonl`` (mode="w": one file
-    per run — the BENCH json's sibling).  ``BENCH_TELEMETRY`` overrides
-    the path ("0"/"off"/"none"/"false"/"no" disables and yields a null
-    context; "1"/"on"/"true"/"yes" just enables the default path —
-    switches, not filenames)."""
+    """Scoped EventLog for one bench run, written under ``artifacts/``
+    as ``telemetry_<app>.jsonl`` (mode="w": one file per run — run
+    artifacts live in artifacts/, never at the repo root where they
+    dirty the tree).  ``BENCH_TELEMETRY`` overrides the path
+    ("0"/"off"/"none"/"false"/"no" disables and yields a null context;
+    "1"/"on"/"true"/"yes" just enables the default path — switches, not
+    filenames)."""
     import contextlib
 
     p = os.environ.get("BENCH_TELEMETRY", "")
@@ -107,8 +108,10 @@ def _telemetry_ctx(app):
     if p.strip().lower() in ("1", "on", "true", "yes"):
         p = ""
     if not p:
-        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         f"telemetry_{app}.jsonl")
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "artifacts")
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, f"telemetry_{app}.jsonl")
     from dlrm_flexflow_tpu.telemetry import event_log
 
     return event_log(path=p, mode="w")

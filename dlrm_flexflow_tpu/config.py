@@ -179,6 +179,12 @@ class FFConfig:
     serve_max_wait_us: float = 2000.0
     serve_queue_depth: int = 256
     serve_timeout_us: float = 0.0
+    # Live-metrics endpoint (telemetry/exporter.py, docs/telemetry.md):
+    # port for the process-wide Prometheus /metrics + /healthz HTTP
+    # server, started once at compile().  0 (default) = off — scrapes
+    # are pull-only and add no locks to the engine forward path beyond
+    # what LatencyStats already takes.
+    metrics_port: int = 0
     # Fault-injection spec (resilience/faultinject.py), e.g.
     # "nan_grads@step=3,preempt@step=7" — testing knob proving the
     # recovery paths end-to-end; also settable via the FF_FAULTS env
@@ -240,6 +246,8 @@ class FFConfig:
                 cfg.serve_queue_depth = int(nxt())
             elif a == "--serve-timeout-us":
                 cfg.serve_timeout_us = float(nxt())
+            elif a == "--metrics-port":
+                cfg.metrics_port = int(nxt())
             elif a in ("-d", "--devices", "-ll:gpu"):
                 # reference -ll:gpu N => N workers; here: device count
                 cfg.num_devices = int(nxt())

@@ -52,6 +52,8 @@ from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
                             param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
 from .telemetry import active_log, sample_memory
+from .telemetry import metrics as _tmetrics
+from .telemetry.trace import start_span
 from .tensor import Tensor, as_dtype
 
 
@@ -533,6 +535,14 @@ class FFModel:
                     f"per-device placement (reference mapper.cc:62-95) "
                     f"is narrowed to named-axis sharding on TPU.",
                     stacklevel=2)
+
+        # opt-in live-metrics endpoint (docs/telemetry.md): one
+        # process-wide /metrics + /healthz server, started at most once
+        # — compile is the one gate every training AND serving path
+        # passes through
+        if int(getattr(self.config, "metrics_port", 0) or 0):
+            from .telemetry.exporter import start_metrics_server
+            start_metrics_server(int(self.config.metrics_port))
 
         # label tensor (reference model.cc:1046-1060: dims copied from final
         # output; 1 class-dim entry for sparse CCE)
@@ -2399,13 +2409,25 @@ class FFModel:
                                 state,
                                 {k: v[lo:hi] for k, v in sin.items()},
                                 slab[lo:hi]).compile())
+        # span chain (telemetry/trace.py): train.fit covers the timed
+        # region (warmup/AOT builds excluded — same protocol as the
+        # step event's wall); each epoch and each dispatched program
+        # call gets a child.  Parenting is EXPLICIT (never the
+        # thread-local stack) so an exception mid-fit can abandon spans
+        # but can never corrupt another run's parenting.  Spans no-op
+        # when telemetry is off.
+        fit_span = start_span("train.fit", attrs={"epochs": int(epochs)})
         t0 = time.perf_counter()
         samples = 0
         epochs_run = int(epochs)  # early stop shortens the per-epoch loop
         last_loss = None          # final epoch's folded loss (step event)
         if fused_fn is not None:
             # single-dispatch multi-epoch run (no callbacks to honor)
+            dspan = start_span("train.dispatch", parent=fit_span,
+                               attrs={"epochs": int(epochs),
+                                      "fused": True})
             state, stacked = fused_fn(state, *scan_data)
+            dspan.end()
             if "loss" in stacked and epochs > 0:
                 last_loss = stacked["loss"][-1]
             samples = epochs * dataloader.num_batches * dataloader.batch_size
@@ -2417,18 +2439,23 @@ class FFModel:
                     print(f"epoch {epoch}: {acc.report()}")
             self._fit_state = state
         for epoch in range(epochs) if fused_fn is None else ():
+            ep_span = start_span("train.epoch", parent=fit_span,
+                                 attrs={"epoch": epoch})
             if epoch > 0:
                 for cb in cbs:
                     cb.on_epoch_begin(epoch)
                 state = apply_pending_lr(state)
             acc.reset()
             if scan_data is not None:
+                dspan = start_span("train.dispatch", parent=ep_span,
+                                   attrs={"epoch": epoch})
                 if chunk_bounds is not None:
                     state, mets = self._run_epoch_chunks(
                         state, scan_data[0], scan_data[1], chunk_bounds,
                         aot=chunk_aot)
                 else:
                     state, mets = scan_fn(state, *scan_data)
+                dspan.end()
                 samples += dataloader.num_batches * dataloader.batch_size
                 acc.update({k: v for k, v in mets.items() if k != "loss"})
                 last_loss = mets.get("loss", last_loss)
@@ -2436,7 +2463,10 @@ class FFModel:
                 for it, (inputs, labels) in enumerate(dataloader):
                     for cb in cbs:
                         cb.on_batch_begin(it)
+                    dspan = start_span("train.dispatch", parent=ep_span,
+                                       attrs={"epoch": epoch, "it": it})
                     state, mets = self.train_step(state, inputs, labels)
+                    dspan.end()
                     samples += int(labels.shape[0])
                     acc.update({k: v for k, v in mets.items()
                                 if k != "loss"})
@@ -2450,6 +2480,7 @@ class FFModel:
             for cb in cbs:
                 if cb.on_epoch_end(epoch) is True:
                     early_stop = True
+            ep_span.end()
             if early_stop:
                 print(f"Accuracy reached, early stop, epoch: {epoch}")
                 epochs_run = epoch + 1
@@ -2457,6 +2488,12 @@ class FFModel:
         device_fence(state.step)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
+        fit_span.set_attr("samples", int(samples))
+        fit_span.end()
+        _tmetrics.TRAIN_SAMPLES_PER_S.set(thpt)
+        nb = getattr(dataloader, "num_batches", None)
+        if nb:  # every path runs num_batches dispatches per epoch
+            _tmetrics.TRAIN_STEPS.inc(epochs_run * int(nb))
         log = active_log()
         if log is not None:
             # fenced=True: the device_fence above guarantees this wall

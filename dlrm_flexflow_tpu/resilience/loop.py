@@ -39,6 +39,8 @@ import numpy as np
 
 from ..metrics import MetricsAccumulator
 from ..telemetry import active_log, sample_memory
+from ..telemetry import metrics as _tmetrics
+from ..telemetry.trace import pop_span, push_span, start_span
 from . import faultinject
 from .manager import CheckpointManager
 from .sentinel import NaNSentinel
@@ -73,9 +75,23 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             cb.set_model(model)
         cb.on_train_begin()
 
+    # span chain (telemetry/trace.py): fit -> epoch -> dispatch, with
+    # ckpt.save/ckpt.restore spans emitted inside the manager under the
+    # ambient span.  Parenting is EXPLICIT (parent=...) except for the
+    # manager calls, which read the thread-local current span — those
+    # pushes are scoped by try/finally, so an abnormal exit (Preemption,
+    # TrainingDiverged) abandons open spans but can never leave a stale
+    # entry on the thread's span stack.
+    fit_span = start_span("train.fit", attrs={"epochs": int(epochs),
+                                              "resume": bool(resume)})
+
     start_epoch = 0
     if resume and manager is not None and manager.latest() is not None:
-        state, extra, _path = manager.restore_latest(model=model)
+        push_span(fit_span)  # parents the manager's ckpt.restore span
+        try:
+            state, extra, _path = manager.restore_latest(model=model)
+        finally:
+            pop_span(fit_span)
         if extra.get("loader") is not None \
                 and hasattr(dataloader, "load_state_dict"):
             dataloader.load_state_dict(extra["loader"])
@@ -95,16 +111,25 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     epochs_run = 0
     t0 = time.perf_counter()
 
+    cur_ep = [fit_span]  # the ambient parent for cadence saves
+
     def save(extra_epoch: int):
         if manager is None:
             return
-        manager.save(state, model=model, step=global_step,
-                     extra={"epoch": extra_epoch,
-                            "loader": _loader_state(dataloader),
-                            "epochs_requested": int(epochs)})
+        push_span(cur_ep[0])  # parents the manager's ckpt.save span
+        try:
+            manager.save(state, model=model, step=global_step,
+                         extra={"epoch": extra_epoch,
+                                "loader": _loader_state(dataloader),
+                                "epochs_requested": int(epochs)})
+        finally:
+            pop_span(cur_ep[0])
 
     ep = start_epoch
     while ep < epochs:
+        ep_span = start_span("train.epoch", parent=fit_span,
+                             attrs={"epoch": ep})
+        cur_ep[0] = ep_span
         for cb in cbs:
             cb.on_epoch_begin(ep)
         if model._pending_lr is not None:
@@ -115,6 +140,8 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             for cb in cbs:
                 cb.on_batch_begin(it)
             while True:  # lr_backoff retries the same batch
+                dspan = start_span("train.dispatch", parent=ep_span,
+                                   attrs={"step": global_step})
                 faultinject.maybe_preempt("step", step=global_step)
                 binputs, blabels = faultinject.poison_batch(
                     inputs, labels, step=global_step)
@@ -124,16 +151,20 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                                                    donate=donate)
                 if sentinel is None:
                     state = new_state
+                    dspan.end()
                     break
                 lr = float(getattr(model.optimizer, "lr", 0.0))
                 if sentinel.observe(mets["loss"], new_state,
                                     step=global_step, lr=lr):
                     state = new_state
+                    dspan.end()
                     break
                 # REJECTED: `state` is still the pre-dispatch state (the
                 # non-donating step left its buffers alive); host-side
                 # hetero tables WERE updated in the dispatch — put the
                 # pre-dispatch arrays back
+                dspan.set_attr("policy", sentinel.policy)
+                dspan.end(status="rejected")
                 for op in hetero_ops:
                     op.host_table.array = host_snap[op.name]
                 if sentinel.policy == "lr_backoff":
@@ -147,6 +178,7 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                     cb.on_batch_end(it)
                 continue
             global_step += 1
+            _tmetrics.TRAIN_STEPS.inc()
             samples += int(labels.shape[0])
             last_loss = float(np.asarray(mets["loss"]))
             losses.append(last_loss)
@@ -171,6 +203,8 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         for cb in cbs:
             if cb.on_epoch_end(ep) is True:
                 early_stop = True
+        ep_span.end()
+        cur_ep[0] = fit_span
         ep += 1
         if early_stop:
             print(f"Accuracy reached, early stop, epoch: {ep - 1}")
@@ -180,6 +214,9 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     device_fence(state.step)
     elapsed = time.perf_counter() - t0
     thpt = samples / max(elapsed, 1e-9)
+    fit_span.set_attr("samples", int(samples))
+    fit_span.end()
+    _tmetrics.TRAIN_SAMPLES_PER_S.set(thpt)
     model._fit_state = state
     model._fit_loss_trace = np.asarray(losses, dtype=np.float64)
     model._fit_loss_steps = np.asarray(loss_steps, dtype=np.int64)

@@ -39,6 +39,8 @@ import numpy as np
 
 from ..checkpoint import CheckpointError, restore_checkpoint, save_checkpoint
 from ..telemetry import emit
+from ..telemetry import metrics as _tmetrics
+from ..telemetry.trace import start_span
 from . import faultinject
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
@@ -185,6 +187,11 @@ class CheckpointManager:
         if step is None:
             step = int(np.asarray(state.step))
         t0 = time.perf_counter()
+        # ckpt.save span parents to the caller's ambient span (the
+        # resilient loop's epoch/fit span) — the training trace shows
+        # where checkpoint wall time lands.  A Preemption mid-save
+        # abandons it, like every other bookkeeping of a killed run.
+        sspan = start_span("ckpt.save", attrs={"step": step})
         last_err: Optional[BaseException] = None
         for attempt in range(self.retries + 1):
             if attempt:
@@ -204,10 +211,15 @@ class CheckpointManager:
             emit("checkpoint", action="save", step=step, path=final,
                  duration_s=time.perf_counter() - t0, attempt=attempt,
                  files=len(_walk_files(final)))
+            _tmetrics.note_checkpoint_save()
+            sspan.set_attr("attempt", attempt)
+            sspan.end()
             return final
         emit("checkpoint", action="save_failed", step=step,
              attempt=self.retries, error=repr(last_err),
              duration_s=time.perf_counter() - t0)
+        sspan.set_attr("error", repr(last_err))
+        sspan.end(status="error")
         import sys
         print(f"# checkpoint save failed after {self.retries + 1} "
               f"attempts, continuing without it: {last_err!r}",
@@ -275,13 +287,14 @@ class CheckpointManager:
             raise CheckpointError(
                 f"no valid checkpoint under {self.directory!r}")
         t0 = time.perf_counter()
-        state = restore_checkpoint(path, model=model,
-                                   inference_only=inference_only)
-        extra: Dict[str, Any] = {}
-        epath = os.path.join(path, EXTRA)
-        if os.path.isfile(epath):
-            with open(epath) as f:
-                extra = json.load(f)
+        with start_span("ckpt.restore", attrs={"path": path}):
+            state = restore_checkpoint(path, model=model,
+                                       inference_only=inference_only)
+            extra: Dict[str, Any] = {}
+            epath = os.path.join(path, EXTRA)
+            if os.path.isfile(epath):
+                with open(epath) as f:
+                    extra = json.load(f)
         emit("checkpoint", action="restore", path=path,
              step=int(np.asarray(state.step)),
              duration_s=time.perf_counter() - t0)

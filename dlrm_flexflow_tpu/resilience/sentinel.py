@@ -25,6 +25,7 @@ from typing import Optional
 import numpy as np
 
 from ..telemetry import emit
+from ..telemetry import metrics as _tmetrics
 
 
 class TrainingDiverged(RuntimeError):
@@ -91,6 +92,7 @@ class NaNSentinel:
         if kind is None:
             return True
         self.rollbacks += 1
+        _tmetrics.SENTINEL_ROLLBACKS.inc()
         action = ("rollback_skip" if self.policy == "skip"
                   else "rollback_lr_backoff")
         emit("anomaly", kind=kind, step=step, action=action,

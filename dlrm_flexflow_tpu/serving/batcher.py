@@ -27,6 +27,9 @@ import numpy as np
 import jax
 
 from ..telemetry import emit
+from ..telemetry import metrics as _metrics
+from ..telemetry.trace import (NULL_SPAN, pop_span, push_span, record_span,
+                               start_span)
 from .stats import LatencyStats
 
 
@@ -80,7 +83,8 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t_submit", "deadline_us")
+    __slots__ = ("inputs", "rows", "future", "t_submit", "deadline_us",
+                 "span", "qspan")
 
     def __init__(self, inputs: Dict[str, np.ndarray], rows: int,
                  deadline_us: float):
@@ -89,6 +93,13 @@ class _Request:
         self.future = ServeFuture()
         self.t_submit = time.perf_counter()
         self.deadline_us = deadline_us  # 0 = no deadline
+        # trace spans (telemetry/trace.py; NULL no-ops while tracing is
+        # off): the request's root (submit -> reply/reject/deadline) and
+        # its queue-wait child (submit -> joins a micro-batch).  Each
+        # ends EXACTLY once — Span.end is first-close-wins, so the
+        # dispatcher and a racing close() cannot double-report.
+        self.span = NULL_SPAN
+        self.qspan = NULL_SPAN
 
 
 _STOP = object()
@@ -143,6 +154,11 @@ class DynamicBatcher:
         self._carry: Optional[_Request] = None
         self._cancelling = False  # close(drain=False) in progress
         self._final_summary: Optional[Dict[str, float]] = None
+        # live-metrics visibility (telemetry/metrics.py): queue depth +
+        # served/shed counters scrape-able while this batcher lives;
+        # close() retires it (final counters fold so totals stay
+        # monotone)
+        _metrics.track_batcher(self)
         if autostart:
             self.start()
 
@@ -161,8 +177,13 @@ class DynamicBatcher:
         :class:`ServeFuture`.  Raises :class:`Rejected` immediately when
         the queue is full or the batcher is closed."""
         if self._closed:
-            self.stats.record_reject()
+            # the batcher may already be RETIRED from /metrics (its
+            # stats folded): record_shed_late routes the reject into
+            # the retained base so the Prometheus counter still sees it
+            _metrics.record_shed_late(self.stats)
             emit("serve", phase="reject", reason="shutdown")
+            start_span("serve.request").set_attr(
+                "reason", "shutdown").end(status="shed")
             raise Rejected("batcher is shut down")
         arrs = {}
         rows = None
@@ -191,6 +212,11 @@ class DynamicBatcher:
         req = _Request(arrs, rows,
                        self.timeout_us if timeout_us is None
                        else float(timeout_us))
+        # root span opens BEFORE the enqueue attempt so a shed request
+        # still leaves one closed span with status="shed"; the
+        # queue-wait child covers enqueue -> joins a micro-batch
+        req.span = start_span("serve.request", attrs={"rows": rows})
+        req.qspan = start_span("serve.queue_wait", parent=req.span)
         shed = None  # emit/raise OUTSIDE the lock: a flushed telemetry
         # write under _intake_lock would serialize the dispatcher's
         # carry swap behind sink I/O exactly when shedding peaks
@@ -205,8 +231,17 @@ class DynamicBatcher:
                 except queue.Full:
                     shed = "queue_full"
         if shed is not None:
-            self.stats.record_reject()
+            # BOTH reasons can race past the batcher's retire (submit
+            # runs on client threads unsynchronized with close(), which
+            # folds this stats object); record_shed_late routes a
+            # post-fold count into the retained base.  _miss/cancel
+            # paths need no such guard — they run on the dispatcher (or
+            # inside _close itself), strictly before the fold.
+            _metrics.record_shed_late(self.stats)
             emit("serve", phase="reject", reason=shed)
+            req.qspan.end(status="shed")
+            req.span.set_attr("reason", shed)
+            req.span.end(status="shed")
             raise Rejected(
                 "batcher is shut down" if shed == "shutdown" else
                 f"request queue full ({self._q.maxsize} waiting) — "
@@ -240,6 +275,7 @@ class DynamicBatcher:
             if self._expired(head, time.perf_counter()):
                 self._miss(head)
                 continue
+            head.qspan.end()  # queue wait ends when the batch forms
             batch, rows = [head], head.rows
             t0 = time.perf_counter()
             while rows < self.max_batch_size:
@@ -276,9 +312,13 @@ class DynamicBatcher:
                     if cancel:
                         self.stats.record_reject()
                         emit("serve", phase="reject", reason="shutdown")
+                        req.qspan.end(status="cancelled")
+                        req.span.set_attr("reason", "shutdown")
+                        req.span.end(status="cancelled")
                         req.future._set_exception(Rejected(
                             "batcher closed without drain"))
                     break
+                req.qspan.end()
                 batch.append(req)
                 rows += req.rows
             return batch
@@ -286,6 +326,8 @@ class DynamicBatcher:
     def _miss(self, req: "_Request") -> None:
         self.stats.record_deadline_miss()
         emit("serve", phase="reject", reason="deadline")
+        req.qspan.end(status="deadline")
+        req.span.end(status="deadline")
         req.future._set_exception(DeadlineExceeded(
             f"request waited past its {req.deadline_us:.0f} us deadline"))
 
@@ -300,13 +342,30 @@ class DynamicBatcher:
                 name: np.concatenate([r.inputs[name] for r in batch],
                                      axis=0)
                 for name in self.engine._in_specs}
+            # the micro-batch's dispatch span roots its own trace and
+            # becomes the dispatcher thread's CURRENT span, so the
+            # engine's pad/forward child spans nest under it; each
+            # request additionally gets a per-request serve.forward
+            # child (record_span below) sharing this one engine wall,
+            # completing every request's submit -> reply chain
+            dsp = start_span("serve.dispatch",
+                             attrs={"requests": len(batch),
+                                    "rows": sum(r.rows for r in batch)})
+            push_span(dsp)
+            fwd_start_s = time.time()
+            t_fwd = time.perf_counter()
             try:
                 out = self.engine.predict(joined,
                                           queue_wait_us=queue_wait_us)
             except Exception as e:  # deliver the failure, keep serving
+                pop_span(dsp)
+                dsp.end(status="error")
                 for r in batch:
+                    r.span.end(status="error")
                     r.future._set_exception(e)
                 continue
+            pop_span(dsp)
+            fwd_us = (time.perf_counter() - t_fwd) * 1e6
             self.stats.record_dispatch()
             done = time.perf_counter()
             lo = 0
@@ -314,7 +373,11 @@ class DynamicBatcher:
                 r.future._set(jax.tree.map(
                     lambda a, lo=lo, hi=lo + r.rows: a[lo:hi], out))
                 self.stats.record((done - r.t_submit) * 1e6)
+                record_span("serve.forward", fwd_start_s, fwd_us,
+                            parent=r.span, attrs={"rows": r.rows})
+                r.span.end()
                 lo += r.rows
+            dsp.end()
 
     # ------------------------------------------------------------- shutdown
     def close(self, drain: bool = True,
@@ -358,6 +421,9 @@ class DynamicBatcher:
             for req in cancelled:
                 self.stats.record_reject()
                 emit("serve", phase="reject", reason="shutdown")
+                req.qspan.end(status="cancelled")
+                req.span.set_attr("reason", "shutdown")
+                req.span.end(status="cancelled")
                 req.future._set_exception(
                     Rejected("batcher closed without drain"))
         if self._thread is None or not self._thread.is_alive():
@@ -373,6 +439,7 @@ class DynamicBatcher:
         summary = (self.stats.emit_summary() if emit_summary
                    else self.stats.summary())
         self._final_summary = summary
+        _metrics.retire_batcher(self)
         return summary
 
     def __enter__(self):

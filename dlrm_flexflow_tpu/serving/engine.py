@@ -30,6 +30,8 @@ import numpy as np
 import jax
 
 from ..telemetry import emit
+from ..telemetry import metrics as _metrics
+from ..telemetry.trace import span as trace_span
 from .stats import LatencyStats
 
 DEFAULT_BUCKETS = (1, 8, 64, 256)
@@ -102,6 +104,10 @@ class InferenceEngine:
                           for t in model._inputs}
         self._compiled: Dict[int, Any] = {}
         self._lock = threading.Lock()
+        # live-metrics visibility: per-bucket dispatch counts ride
+        # stats.record_dispatch's existing lock (telemetry/metrics.py
+        # scrapes them — no extra lock on this forward path)
+        _metrics.track_engine(self)
         if warmup:
             self.warmup()
 
@@ -245,15 +251,24 @@ class InferenceEngine:
 
     def _dispatch(self, chunk: Dict[str, np.ndarray], m: int,
                   queue_wait_us: float):
+        # spans nest under the caller's current span (the batcher's
+        # serve.dispatch) when tracing is on; off, each trace_span call
+        # is one active-log None-check.  _ensure stays OUTSIDE the pad
+        # span: a cold bucket's AOT/jit compile must not render as a
+        # giant "padding" bar (the build already emits its own compile
+        # event for attribution).
         b = self.bucket_for(m)
-        padded = {k: self._pad(v, m, b) for k, v in chunk.items()}
         fn = self._ensure(b)
+        with trace_span("serve.pad", attrs={"batch": m, "bucket": b}):
+            padded = {k: self._pad(v, m, b) for k, v in chunk.items()}
         t0 = time.perf_counter()
-        out = fn(self._params, padded, self._bn)
-        # host materialization IS the fence: results leave as numpy
-        out = jax.tree.map(lambda a: np.asarray(a)[:m], out)
+        with trace_span("serve.engine_forward",
+                        attrs={"batch": m, "bucket": b}):
+            out = fn(self._params, padded, self._bn)
+            # host materialization IS the fence: results leave as numpy
+            out = jax.tree.map(lambda a: np.asarray(a)[:m], out)
         compute_us = (time.perf_counter() - t0) * 1e6
-        self.stats.record_dispatch()
+        self.stats.record_dispatch(bucket=b)
         emit("serve", phase="dispatch", batch=m, bucket=b, padded=b - m,
              fill=m / b, queue_wait_us=float(queue_wait_us),
              compute_us=compute_us)

@@ -11,12 +11,15 @@ math is pinned by ``tests/test_serving.py``.
 
 from __future__ import annotations
 
+import bisect
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..telemetry.metrics import LATENCY_BUCKETS_US
 
 
 class LatencyStats:
@@ -28,6 +31,16 @@ class LatencyStats:
     all latencies seen, so the percentile estimate keeps tracking live
     traffic instead of freezing on the first ``max_samples``
     (startup-era, compile-warm) requests.
+
+    Alongside the reservoir, every ``record`` increments one FIXED
+    bucket counter (``LATENCY_BUCKETS_US`` + overflow — one bisect and
+    one ``+= 1`` under the lock the record already holds), so the
+    Prometheus exporter (telemetry/exporter.py) can serve cumulative
+    ``_bucket`` counts per scrape without locking and scanning the full
+    reservoir; ``summary()`` is unchanged and still reads the
+    reservoir.  ``record_dispatch(bucket=...)`` likewise keeps
+    per-bucket dispatch counts for the ``dlrm_serve_dispatches_total``
+    family.
     """
 
     def __init__(self, max_samples: int = 100_000):
@@ -39,18 +52,27 @@ class LatencyStats:
         self.rejected = 0
         self.deadline_misses = 0
         self.dispatches = 0
+        # fixed-bucket histogram: one slot per LATENCY_BUCKETS_US edge
+        # (counts values <= edge goes in the FIRST edge >= value) plus
+        # the +Inf overflow slot; _lat_sum feeds the histogram's _sum
+        self._hist = [0] * (len(LATENCY_BUCKETS_US) + 1)
+        self._lat_sum = 0.0
+        self.dispatch_buckets: Dict[int, int] = {}
         self._t0 = time.perf_counter()
 
     # ------------------------------------------------------------ recording
     def record(self, lat_us: float) -> None:
+        lat = float(lat_us)
         with self._lock:
             self.count += 1
+            self._lat_sum += lat
+            self._hist[bisect.bisect_left(LATENCY_BUCKETS_US, lat)] += 1
             if len(self._lat_us) < self.max_samples:
-                self._lat_us.append(float(lat_us))
+                self._lat_us.append(lat)
             else:
                 j = self._rng.randrange(self.count)
                 if j < self.max_samples:
-                    self._lat_us[j] = float(lat_us)
+                    self._lat_us[j] = lat
 
     def record_many(self, lats_us) -> None:
         for v in lats_us:
@@ -64,9 +86,29 @@ class LatencyStats:
         with self._lock:
             self.deadline_misses += 1
 
-    def record_dispatch(self) -> None:
+    def record_dispatch(self, bucket: Optional[int] = None) -> None:
         with self._lock:
             self.dispatches += 1
+            if bucket is not None:
+                b = int(bucket)
+                self.dispatch_buckets[b] = \
+                    self.dispatch_buckets.get(b, 0) + 1
+
+    # ------------------------------------------------------------ histogram
+    def histogram(self) -> Tuple[List[int], float, int]:
+        """One locked snapshot for the exporter: (CUMULATIVE counts per
+        ``LATENCY_BUCKETS_US`` edge plus the final +Inf slot, sum of all
+        recorded latencies in us, total recorded count).  O(buckets) —
+        never touches the reservoir."""
+        with self._lock:
+            per_slot = list(self._hist)
+            total_sum = self._lat_sum
+            n = self.count
+        cum, running = [], 0
+        for c in per_slot:
+            running += c
+            cum.append(running)
+        return cum, total_sum, n
 
     # ------------------------------------------------------------- reading
     def percentile(self, p: float) -> Optional[float]:

@@ -15,21 +15,34 @@ typed, schema-checked events from every layer of the framework:
                   simulator's prediction (profiling.OpTimer)
   * ``serve``   — online-serving dispatches, shed requests, and latency
                   summaries (serving/, docs/serving.md)
+  * ``span``    — Dapper-style causal spans: serving request chains
+                  (submit → queue-wait → forward → reply) and training
+                  chains (fit → epoch → dispatch → checkpoint)
+                  (telemetry/trace.py)
 
 Activate with ``set_event_log(EventLog(path=...))`` or the scoped
 ``event_log(...)`` context manager; producers no-op when telemetry is
-off.  ``python -m dlrm_flexflow_tpu.telemetry report run.jsonl`` prints
-the per-op time table, compile timeline, throughput summary, and
-sim-vs-measured calibration error.
+off.  ``python -m dlrm_flexflow_tpu.telemetry report run.jsonl``
+(``--format json`` for the machine-readable object) prints the per-op
+time table, compile timeline, throughput summary, sim-vs-measured
+calibration error, and span roll-up; ``export-trace`` renders the run
+for https://ui.perfetto.dev; ``regress`` gates a fresh BENCH result
+against a baseline.  Live metrics (telemetry/metrics.py) are exposed
+as Prometheus text at ``/metrics`` by ``telemetry/exporter.py`` —
+opt-in via ``FFConfig.metrics_port`` / ``--metrics-port``.
 """
 
 from .events import (EventLog, active_log, emit, event_log,
                      sample_memory, set_event_log, suppressed)
 from .jax_hooks import compile_stats, install_compile_hooks
 from .schema import SCHEMA, SCHEMA_VERSION, validate_event
+from .trace import (NULL_SPAN, Span, current_span, record_span, span,
+                    start_span)
 
 __all__ = [
     "EventLog", "active_log", "emit", "event_log",
     "sample_memory", "set_event_log", "suppressed", "compile_stats",
     "install_compile_hooks", "SCHEMA", "SCHEMA_VERSION", "validate_event",
+    "NULL_SPAN", "Span", "current_span", "record_span", "span",
+    "start_span",
 ]

@@ -1,0 +1,408 @@
+"""Pull-based metrics registry + Prometheus text exposition
+(docs/telemetry.md).
+
+The EventLog answers "what happened"; a live server also needs "what is
+happening NOW" on a scrape endpoint.  This module is the stdlib-only
+registry behind ``telemetry/exporter.py``'s ``/metrics``: a declared
+table of metric families (:data:`FAMILIES` — the single source of truth
+``scripts/check_telemetry_schema.py`` lints against docs/telemetry.md),
+three instrument kinds (Counter / Gauge / Histogram), and PULL-based
+collection — values are computed at scrape time from state the hot
+paths already maintain, so serving metrics add **no lock acquisition on
+the engine forward path beyond what LatencyStats already takes** (the
+per-bucket dispatch counts and the fixed-bucket latency histogram ride
+LatencyStats' existing lock; queue depth reads ``Queue.qsize`` at
+scrape).
+
+Live serving objects register themselves (``track_batcher`` /
+``track_engine``) into weak sets; a closed batcher folds its final
+counters into a retained base (``retire_batcher``) and a garbage-
+collected engine folds via a finalizer, so the exposed counters stay
+MONOTONE across scrapes — the Prometheus contract.
+
+Everything here is always-on and cheap (a counter bump is one lock at
+host-loop rates); the HTTP exporter itself is opt-in via
+``FFConfig.metrics_port`` / ``--metrics-port``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: fixed latency histogram bucket upper edges, microseconds (the +Inf
+#: overflow slot is implicit).  Shared with serving.LatencyStats so the
+#: accumulator and the exposition can never disagree on edges.
+LATENCY_BUCKETS_US: Tuple[float, ...] = (
+    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10_000.0, 25_000.0,
+    50_000.0, 100_000.0, 250_000.0, 500_000.0, 1_000_000.0)
+
+#: THE metric-name registry: family -> (type, help).  Every registered
+#: metric must be declared here (``MetricsRegistry.register`` refuses
+#: unknown or duplicate names) and every family must appear in
+#: docs/telemetry.md — both linted by scripts/check_telemetry_schema.py.
+FAMILIES: Dict[str, Tuple[str, str]] = {
+    "dlrm_serve_queue_depth": (
+        "gauge", "requests waiting in live DynamicBatcher queues"),
+    "dlrm_serve_requests_total": (
+        "counter", "requests served to completion (latency recorded)"),
+    "dlrm_serve_rejected_total": (
+        "counter", "requests shed (queue full / shutdown)"),
+    "dlrm_serve_deadline_missed_total": (
+        "counter", "requests expired before dispatch"),
+    "dlrm_serve_dispatches_total": (
+        "counter", "engine forward dispatches by compiled bucket size"),
+    "dlrm_serve_latency_us": (
+        "histogram", "end-to-end request latency in microseconds"),
+    "dlrm_train_steps_total": (
+        "counter", "training dispatches adopted (global steps)"),
+    "dlrm_train_samples_per_s": (
+        "gauge", "throughput of the most recent fit/bench window"),
+    "dlrm_checkpoint_saves_total": (
+        "counter", "checkpoints committed by CheckpointManager.save"),
+    "dlrm_checkpoint_age_s": (
+        "gauge", "seconds since the last committed checkpoint"),
+    "dlrm_sentinel_rollbacks_total": (
+        "counter", "dispatches the NaN sentinel rejected and rolled back"),
+}
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class Metric:
+    """One family.  ``expose()`` returns the sample lines (no HELP/TYPE
+    headers — the registry prints those from :data:`FAMILIES`)."""
+
+    def __init__(self, name: str):
+        if name not in FAMILIES:
+            raise ValueError(
+                f"metric {name!r} is not declared in telemetry.metrics."
+                f"FAMILIES — declare it there (and in docs/telemetry.md) "
+                f"first")
+        self.name = name
+        self.mtype, self.help = FAMILIES[name]
+
+    def expose(self) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotone counter; ``inc`` takes one short lock (host-loop rates
+    only — scrape-hot serving counts are pulled, not pushed)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def expose(self) -> List[str]:
+        return [f"{self.name} {_fmt(self._v)}"]
+
+
+class Gauge(Metric):
+    """Set-able or pull-based (``fn`` evaluated at scrape; returning
+    None omits the sample — 'no data yet' is absent, never faked)."""
+
+    def __init__(self, name: str,
+                 fn: Optional[Callable[[], Optional[float]]] = None):
+        super().__init__(name)
+        self._v: Optional[float] = None
+        self._fn = fn
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._fn() if self._fn is not None else self._v
+
+    def expose(self) -> List[str]:
+        v = self.value
+        return [] if v is None else [f"{self.name} {_fmt(v)}"]
+
+
+class LabeledCounter(Metric):
+    """Pull-based counter family with one label (``label``): ``fn``
+    returns {label_value: count} at scrape time."""
+
+    def __init__(self, name: str, label: str,
+                 fn: Callable[[], Dict[str, float]]):
+        super().__init__(name)
+        self.label = label
+        self._fn = fn
+
+    def expose(self) -> List[str]:
+        return [f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}'
+                for k, v in sorted(self._fn().items())]
+
+
+class Histogram(Metric):
+    """Pull-based cumulative histogram: ``fn`` returns (cumulative
+    counts per ``buckets`` edge + the +Inf slot, sum, count) — the
+    exact shape ``LatencyStats.histogram()`` snapshots under its one
+    existing lock."""
+
+    def __init__(self, name: str, buckets: Tuple[float, ...],
+                 fn: Callable[[], Tuple[List[float], float, float]]):
+        super().__init__(name)
+        self.buckets = tuple(buckets)
+        self._fn = fn
+
+    def expose(self) -> List[str]:
+        cum, total_sum, n = self._fn()
+        lines = []
+        for edge, c in zip(self.buckets, cum):
+            lines.append(f'{self.name}_bucket{{le="{_fmt(edge)}"}} '
+                         f'{_fmt(c)}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {_fmt(cum[-1])}')
+        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count {_fmt(n)}")
+        return lines
+
+
+class MetricsRegistry:
+    """Ordered family table -> one Prometheus text exposition."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> Metric:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(
+                    f"duplicate metric registration: {metric.name!r}")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._metrics)
+
+    def render(self) -> str:
+        """The ``/metrics`` body (Prometheus text format 0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: List[str] = []
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.mtype}")
+            out.extend(m.expose())
+        return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------- live serving collection
+#
+# Counter rigor: every tracked LatencyStats is at any instant EITHER in
+# the strong ``_live_stats`` registry (swept by scrapes) OR folded into
+# the retained base — the transition happens atomically under
+# ``_retired_lock``, so a scrape can never observe an object in neither
+# place and report a "monotone" counter moving backwards.  A
+# batcher/engine abandoned without close() is handled by a GC
+# finalizer, which only queues the stats on a LOCK-FREE deque (a
+# finalizer can fire at any allocation point, possibly on a thread
+# already holding some LatencyStats lock, so it must never contend for
+# _retired_lock itself); the strong registry keeps the stats alive and
+# scrapeable until the queue is drained at the next collection.
+_live_stats: set = set()                 # strong refs until folded
+_live_batchers: "weakref.WeakSet" = weakref.WeakSet()  # queue depth only
+_pending_folds: deque = deque()
+_retired_lock = threading.Lock()
+_retired = {"requests": 0, "rejected": 0, "deadline": 0}
+_retired_hist = [0] * (len(LATENCY_BUCKETS_US) + 1)  # cumulative
+_retired_sum = 0.0
+_retired_count = 0
+_retired_buckets: Dict[int, int] = {}
+
+
+def _fold_stats_locked(stats) -> None:
+    """Fold one retiring LatencyStats into the retained base and drop
+    it from the live registry — callers hold ``_retired_lock``.
+    Idempotent per stats object (close() and the GC path can race)."""
+    global _retired_sum, _retired_count
+    if getattr(stats, "_metrics_folded", False):
+        _live_stats.discard(stats)
+        return
+    stats._metrics_folded = True
+    _retired["requests"] += int(stats.count)
+    _retired["rejected"] += int(stats.rejected)
+    _retired["deadline"] += int(stats.deadline_misses)
+    cum, s, n = stats.histogram()
+    for i, c in enumerate(cum):
+        _retired_hist[i] += int(c)
+    _retired_sum += float(s)
+    _retired_count += int(n)
+    with stats._lock:
+        snap = dict(stats.dispatch_buckets)
+    for b, c in snap.items():
+        _retired_buckets[b] = _retired_buckets.get(b, 0) + int(c)
+    _live_stats.discard(stats)
+
+
+def _drain_pending_locked() -> None:
+    while True:
+        try:
+            stats = _pending_folds.popleft()
+        except IndexError:
+            return
+        _fold_stats_locked(stats)
+
+
+def _finalize_stats(stats) -> None:
+    _pending_folds.append(stats)  # lock-free; folded at next scrape
+
+
+def track_batcher(batcher) -> None:
+    """Called by ``DynamicBatcher.__init__``: expose this batcher's
+    queue depth and counters until it closes (``retire_batcher``) or is
+    collected (finalizer queues its stats for folding so counters stay
+    monotone).  Tracking also drains the pending-fold queue, so a
+    process that never scrapes (``metrics_port=0``) still folds-and-
+    frees the stats of GC'd instances instead of retaining them in the
+    strong registry forever."""
+    with _retired_lock:
+        _drain_pending_locked()
+        _live_stats.add(batcher.stats)
+    _live_batchers.add(batcher)
+    weakref.finalize(batcher, _finalize_stats, batcher.stats)
+
+
+def retire_batcher(batcher) -> None:
+    """Called by ``DynamicBatcher.close``: fold the final counters into
+    the retained base and stop scraping the instance."""
+    with _retired_lock:
+        _drain_pending_locked()
+        _fold_stats_locked(batcher.stats)
+    _live_batchers.discard(batcher)
+
+
+def track_engine(engine) -> None:
+    """Called by ``InferenceEngine.__init__``: expose per-bucket
+    dispatch counts (LatencyStats.dispatch_buckets).  Engine stats
+    record no latencies/rejects, so sharing the batchers' registry is
+    harmless — their contribution to those families is zero.  Drains
+    the pending-fold queue like ``track_batcher`` (engines have no
+    close(); a reloading server folds the previous generation here)."""
+    with _retired_lock:
+        _drain_pending_locked()
+        _live_stats.add(engine.stats)
+    weakref.finalize(engine, _finalize_stats, engine.stats)
+
+
+def record_shed_late(stats, kind: str = "rejected") -> None:
+    """Count one shed (``kind="rejected"``) or deadline miss
+    (``"deadline"``) that may land AFTER its batcher retired (a submit
+    racing close): once the stats object is folded its counters are
+    invisible to scrapes, so the count goes straight into the retained
+    base; before the fold it rides the stats object like any other
+    (lock order retired->stats matches ``_fold_stats_locked``)."""
+    with _retired_lock:
+        if getattr(stats, "_metrics_folded", False):
+            _retired[kind] += 1
+        elif kind == "rejected":
+            stats.record_reject()
+        else:
+            stats.record_deadline_miss()
+
+
+def _queue_depth() -> float:
+    return float(sum(b._q.qsize() for b in list(_live_batchers)))
+
+
+# the scrape collectors hold _retired_lock across the pending-fold
+# drain, the retained base, AND the live sweep, so fold transitions are
+# invisible to them and the exposed counters are exactly-once sums
+
+def _count_of(field: str, retired_key: str) -> Callable[[], float]:
+    def fn() -> float:
+        with _retired_lock:
+            _drain_pending_locked()
+            return float(_retired[retired_key]
+                         + sum(int(getattr(s, field))
+                               for s in _live_stats))
+    return fn
+
+
+def _latency_hist() -> Tuple[List[float], float, float]:
+    with _retired_lock:
+        _drain_pending_locked()
+        cum = [float(c) for c in _retired_hist]
+        s, n = _retired_sum, _retired_count
+        for st in _live_stats:
+            bc, bs, bn = st.histogram()
+            for i, c in enumerate(bc):
+                cum[i] += c
+            s += bs
+            n += bn
+    return cum, s, n
+
+
+def _dispatch_buckets() -> Dict[str, float]:
+    with _retired_lock:
+        _drain_pending_locked()
+        out = {str(k): float(v) for k, v in _retired_buckets.items()}
+        for st in _live_stats:
+            with st._lock:
+                snap = dict(st.dispatch_buckets)
+            for b, c in snap.items():
+                out[str(b)] = out.get(str(b), 0.0) + c
+    return out
+
+
+# ---------------------------------------------------------- checkpoint age
+_last_ckpt_ts: Optional[float] = None
+
+
+def note_checkpoint_save() -> None:
+    """Called by ``CheckpointManager.save`` on every committed
+    checkpoint: bumps the saves counter and resets the age gauge."""
+    global _last_ckpt_ts
+    _last_ckpt_ts = time.time()
+    CHECKPOINT_SAVES.inc()
+
+
+def _ckpt_age() -> Optional[float]:
+    return None if _last_ckpt_ts is None else time.time() - _last_ckpt_ts
+
+
+# ------------------------------------------------------- the default registry
+REGISTRY = MetricsRegistry()
+
+SERVE_QUEUE_DEPTH = REGISTRY.register(
+    Gauge("dlrm_serve_queue_depth", fn=_queue_depth))
+SERVE_REQUESTS = REGISTRY.register(
+    Gauge("dlrm_serve_requests_total", fn=_count_of("count", "requests")))
+SERVE_REJECTED = REGISTRY.register(
+    Gauge("dlrm_serve_rejected_total",
+          fn=_count_of("rejected", "rejected")))
+SERVE_DEADLINE_MISSED = REGISTRY.register(
+    Gauge("dlrm_serve_deadline_missed_total",
+          fn=_count_of("deadline_misses", "deadline")))
+SERVE_DISPATCHES = REGISTRY.register(
+    LabeledCounter("dlrm_serve_dispatches_total", "bucket",
+                   _dispatch_buckets))
+SERVE_LATENCY = REGISTRY.register(
+    Histogram("dlrm_serve_latency_us", LATENCY_BUCKETS_US, _latency_hist))
+TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
+TRAIN_SAMPLES_PER_S = REGISTRY.register(
+    Gauge("dlrm_train_samples_per_s"))
+CHECKPOINT_SAVES = REGISTRY.register(
+    Counter("dlrm_checkpoint_saves_total"))
+CHECKPOINT_AGE = REGISTRY.register(
+    Gauge("dlrm_checkpoint_age_s", fn=_ckpt_age))
+SENTINEL_ROLLBACKS = REGISTRY.register(
+    Counter("dlrm_sentinel_rollbacks_total"))

@@ -1,0 +1,141 @@
+"""Perf-regression gate over the BENCH_* trajectory (docs/telemetry.md).
+
+    python -m dlrm_flexflow_tpu.telemetry regress \\
+        --baseline bench_history.json --new BENCH_r06.json --tolerance 5
+
+Diffs the HEADLINE metrics two bench artifacts share — throughput
+(samples/s or requests/s), busy-equivalent throughput (samples per
+device-busy second, the queue-lottery-proof number PERF.md trusts),
+and MFU — and exits nonzero naming each metric whose new value fell
+more than ``tolerance`` percent below the baseline.  Every compared
+metric is higher-is-better by construction.
+
+Accepted file shapes (auto-detected):
+
+* ``bench_history.json`` — the append-only list ``bench.py`` maintains;
+  the NEWEST fenced entry per metric anchors (derived busy/MFU metrics
+  ride along when the entry carries ``device_busy_ms`` / ``mfu_pct``);
+* ``BENCH_rNN.json`` — the driver's per-round record with a ``parsed``
+  one-line-protocol object;
+* a bare ``{"metric": ..., "value": ...}`` protocol line saved as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+
+def _history_metric_name(entry: dict) -> str:
+    """The one-line-protocol metric name a history entry was emitted
+    under (bench.py: main() vs bench_app() vs bench_serving())."""
+    app = entry.get("app", "dlrm")
+    if app == "dlrm":
+        return "dlrm_synthetic_samples_per_sec"
+    if app == "dlrm_serving":
+        return "dlrm_serving_qps"
+    return f"{app}_samples_per_sec"
+
+
+def _history_metrics(entries: List[dict]) -> Dict[str, float]:
+    """Newest fenced value per metric (append order = chronology), plus
+    the derived busy-equivalent and MFU metrics when the entry carries
+    the provenance fields."""
+    out: Dict[str, float] = {}
+    for h in entries:
+        if not isinstance(h, dict) or not h.get("value"):
+            continue
+        if not h.get("fenced"):
+            continue  # pre-fence-fix methodology: never comparable
+        name = _history_metric_name(h)
+        # later entries overwrite: the NEWEST anchors the gate
+        for k in list(out):
+            if k == name or k.startswith(name + ":"):
+                del out[k]
+        out[name] = float(h["value"])
+        if h.get("mfu_pct"):
+            out[f"{name}:mfu_pct"] = float(h["mfu_pct"])
+        busy_ms = h.get("device_busy_ms")
+        if busy_ms and all(k in h for k in ("batch", "num_batches",
+                                            "epochs")):
+            samples = (int(h["batch"]) * int(h["num_batches"])
+                       * int(h["epochs"]))
+            out[f"{name}:busy_samples_per_s"] = samples / (busy_ms * 1e-3)
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """{metric: value} from any accepted bench artifact shape."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return _history_metrics(data)
+    if isinstance(data, dict):
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            data = parsed
+        if "metric" in data and "value" in data:
+            return {str(data["metric"]): float(data["value"])}
+    raise ValueError(
+        f"{path!r}: not a recognized bench artifact (want a "
+        f"bench_history.json list, a BENCH_rNN.json record with a "
+        f"'parsed' object, or a one-line-protocol JSON object)")
+
+
+def compare(base: Dict[str, float], new: Dict[str, float],
+            tolerance_pct: float
+            ) -> Tuple[List[Tuple[str, float, float, float]],
+                       List[Tuple[str, float, float, float]]]:
+    """(all shared rows, regressed rows) as (metric, base, new,
+    delta_pct).  A metric regresses when the new value is more than
+    ``tolerance_pct`` percent BELOW the baseline; improvements of any
+    size pass."""
+    rows, regressions = [], []
+    for name in sorted(set(base) & set(new)):
+        b, n = float(base[name]), float(new[name])
+        if b <= 0:
+            continue  # nothing to anchor against
+        delta_pct = 100.0 * (n - b) / b
+        row = (name, b, n, delta_pct)
+        rows.append(row)
+        if delta_pct < -float(tolerance_pct):
+            regressions.append(row)
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dlrm_flexflow_tpu.telemetry regress",
+        description=__doc__.split("\n")[0])
+    p.add_argument("--baseline", required=True,
+                   help="bench_history.json or a BENCH_rNN.json")
+    p.add_argument("--new", required=True, dest="new_path",
+                   help="the fresh result to gate")
+    p.add_argument("--tolerance", type=float, default=5.0,
+                   help="allowed regression, percent (default 5)")
+    args = p.parse_args(argv)
+    try:
+        base = load_metrics(args.baseline)
+        new = load_metrics(args.new_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"regress: ERROR loading inputs: {e}")
+        return 2
+    rows, regressions = compare(base, new, args.tolerance)
+    if not rows:
+        print(f"regress: ERROR: no shared metrics between "
+              f"{args.baseline!r} ({sorted(base) or 'none'}) and "
+              f"{args.new_path!r} ({sorted(new) or 'none'})")
+        return 2
+    for name, b, n, d in rows:
+        print(f"regress: {name}: baseline {b:,.2f} -> new {n:,.2f} "
+              f"({d:+.2f}%)")
+    for name, b, n, d in regressions:
+        print(f"regress: REGRESSION {name}: {n:,.2f} is {-d:.2f}% below "
+              f"baseline {b:,.2f} (tolerance {args.tolerance:.1f}%)")
+    if regressions:
+        return 1
+    print(f"regress: OK ({len(rows)} metric(s) within "
+          f"{args.tolerance:.1f}% tolerance)")
+    return 0

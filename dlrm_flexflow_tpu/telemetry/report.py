@@ -1,6 +1,6 @@
 """Telemetry report CLI: summarize one run's event JSONL.
 
-    python -m dlrm_flexflow_tpu.telemetry report <run.jsonl>
+    python -m dlrm_flexflow_tpu.telemetry report <run.jsonl> [--format json]
 
 Prints (sections appear only when the run emitted the matching events):
   * throughput summary        — from ``step`` events (fenced vs dispatch)
@@ -13,12 +13,19 @@ Prints (sections appear only when the run emitted the matching events):
   * memory watermarks         — from ``memory`` events, per device
   * search trajectory         — from ``search`` events (MCMC proposals,
     acceptance rate, best-cost trajectory, calibration fits)
+  * span summary              — from ``span`` events (telemetry/trace.py)
+
+``--format json`` emits the same sections as ONE machine-readable
+object (``report_data``) — what the regress gate and dashboards
+consume.  Sibling subcommands: ``export-trace`` (Perfetto/Chrome-trace
+JSON, telemetry/exporter.py) and ``regress`` (perf-regression gate,
+telemetry/regress.py).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 from .schema import validate_event
 
@@ -61,6 +68,19 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f} GiB"
 
 
+def _step_sps(e: dict) -> float:
+    return e.get("samples_per_s",
+                 e["samples"] / max(e["wall_s"], 1e-12))
+
+
+def _best_fenced(fenced: List[dict]) -> Tuple[dict, float]:
+    """THE best-fenced-window selection — shared by the text report and
+    ``report_data`` so the number dashboards consume can never drift
+    from the one the text report prints."""
+    best = max(fenced, key=_step_sps)
+    return best, _step_sps(best)
+
+
 def throughput_summary(events: List[dict]) -> List[str]:
     steps = [e for e in events if e.get("type") == "step"]
     if not steps:
@@ -71,12 +91,7 @@ def throughput_summary(events: List[dict]) -> List[str]:
     lines.append(f"step events: {len(steps)} ({len(fenced)} fenced), "
                  f"{total} samples total")
     if fenced:
-        best = max(fenced,
-                   key=lambda e: e.get("samples_per_s",
-                                       e["samples"] / max(e["wall_s"],
-                                                          1e-12)))
-        bsps = best.get("samples_per_s",
-                        best["samples"] / max(best["wall_s"], 1e-12))
+        best, bsps = _best_fenced(fenced)
         lines.append(f"best fenced window: {bsps:,.0f} samples/s "
                      f"({best.get('phase', '?')}, "
                      f"wall {best['wall_s'] * 1e3:.2f} ms)")
@@ -329,6 +344,52 @@ def serving_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+def span_summary(events: List[dict]) -> List[str]:
+    """Span roll-up (telemetry/trace.py): per-name counts and mean
+    duration, trace count, and the non-ok status tally — the quick
+    'what did the traced requests actually do' view; the full timeline
+    lives in ``export-trace``."""
+    spans = [e for e in events if e.get("type") == "span"]
+    if not spans:
+        return []
+    lines = ["== spans =="]
+    traces = {e["trace_id"] for e in spans}
+    lines.append(f"{len(spans)} spans across {len(traces)} traces")
+    by_name: Dict[str, List[dict]] = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    lines.append(f"{'span':28s} {'count':>7s} {'mean(us)':>10s} "
+                 f"{'max(us)':>10s}")
+    for name, evs in sorted(by_name.items()):
+        durs = [e["dur_us"] for e in evs]
+        lines.append(f"{name:28s} {len(evs):7d} "
+                     f"{sum(durs) / len(durs):10.1f} {max(durs):10.1f}")
+    bad: Dict[str, int] = {}
+    for e in spans:
+        st = e.get("status", "ok")
+        if st != "ok":
+            bad[st] = bad.get(st, 0) + 1
+    if bad:
+        lines.append("non-ok: " + ", ".join(
+            f"{n} {s}" for s, n in sorted(bad.items())))
+    return lines
+
+
+#: section name -> text renderer; report_data mirrors these keys so the
+#: text and JSON forms can never disagree about which sections a run has
+SECTIONS = (
+    ("throughput", throughput_summary),
+    ("per_op", per_op_table),
+    ("calibration", calibration_summary),
+    ("compile", compile_timeline),
+    ("memory", memory_summary),
+    ("search", search_summary),
+    ("resilience", resilience_summary),
+    ("serving", serving_summary),
+    ("spans", span_summary),
+)
+
+
 def format_report(events: List[dict]) -> str:
     if not events:
         return "(no events)"
@@ -338,9 +399,7 @@ def format_report(events: List[dict]) -> str:
     lines = ["== run summary ==",
              f"{len(events)} events over {t1 - t0:.1f}s: "
              + ", ".join(f"{len(v)} {k}" for k, v in sorted(by.items()))]
-    for section in (throughput_summary, per_op_table, calibration_summary,
-                    compile_timeline, memory_summary, search_summary,
-                    resilience_summary, serving_summary):
+    for _name, section in SECTIONS:
         part = section(events)
         if part:
             lines.append("")
@@ -348,9 +407,90 @@ def format_report(events: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def report_data(events: List[dict]) -> Dict[str, object]:
+    """The ``--format json`` object: one ``run`` header plus, for every
+    section the text report would print, that section's lines as
+    structured data — section presence is IDENTICAL to the text report
+    (both iterate :data:`SECTIONS`), and each section carries its
+    headline numbers next to the rendered lines so dashboards and the
+    regress gate can consume values without re-parsing text."""
+    out: Dict[str, object] = {}
+    if not events:
+        return {"run": {"events": 0}}
+    by = _by_type(events)
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] for e in events)
+    out["run"] = {"events": len(events), "wall_s": t1 - t0,
+                  "by_type": {k: len(v) for k, v in sorted(by.items())}}
+    headline: Dict[str, Dict[str, object]] = {k: {} for k, _ in SECTIONS}
+    steps = by.get("step", [])
+    fenced = [e for e in steps if e.get("fenced")]
+    if steps:
+        h = headline["throughput"]
+        h["step_events"] = len(steps)
+        h["fenced"] = len(fenced)
+        h["samples"] = sum(int(e.get("samples", 0)) for e in steps)
+        if fenced:
+            h["best_fenced_samples_per_s"] = _best_fenced(fenced)[1]
+        losses = [e["loss"] for e in steps if "loss" in e]
+        if losses:
+            h["loss_first"], h["loss_last"] = losses[0], losses[-1]
+    ops = by.get("op_time", [])
+    if ops:
+        latest: Dict[str, dict] = {}
+        for e in ops:
+            latest[e["op"]] = e
+        headline["per_op"]["ops"] = [
+            {k: e[k] for k in ("op", "forward_s", "backward_s",
+                               "sim_forward_s", "sim_backward_s")
+             if k in e}
+            for e in sorted(latest.values(),
+                            key=lambda e: -e["forward_s"])]
+    comps = by.get("compile", [])
+    if comps:
+        misses = [e for e in comps if e["kind"] == "backend_compile"]
+        aots = [e for e in comps if e["kind"] == "aot"]
+        headline["compile"] = {
+            "backend_compiles": len(misses),
+            "backend_compile_s": sum(e["duration_s"] for e in misses),
+            "aot_builds": len(aots),
+            "aot_s": sum(e["duration_s"] for e in aots)}
+    serves = by.get("serve", [])
+    sums = [e for e in serves if e.get("phase") == "summary"]
+    if sums:
+        headline["serving"] = {
+            k: sums[-1][k] for k in ("requests", "qps", "p50_us", "p95_us",
+                                     "p99_us", "rejected",
+                                     "deadline_misses", "dispatches")
+            if k in sums[-1]}
+    spans = by.get("span", [])
+    if spans:
+        names: Dict[str, int] = {}
+        for e in spans:
+            names[e["name"]] = names.get(e["name"], 0) + 1
+        headline["spans"] = {
+            "spans": len(spans),
+            "traces": len({e["trace_id"] for e in spans}),
+            "by_name": names}
+    for name, section in SECTIONS:
+        lines = section(events)
+        if lines:
+            out[name] = {**headline.get(name, {}), "lines": lines[1:]}
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
+    import sys
 
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["regress"]:
+        # forwarded VERBATIM so regress's options are declared once, in
+        # regress.py's own parser (argparse.REMAINDER cannot forward
+        # leading optionals — bpo-17050)
+        from .regress import main as regress_main
+
+        return regress_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m dlrm_flexflow_tpu.telemetry",
         description=__doc__.split("\n")[0])
@@ -360,10 +500,36 @@ def main(argv=None) -> int:
     rep.add_argument("--strict", action="store_true",
                      help="fail on malformed/invalid lines instead of "
                           "skipping them")
+    rep.add_argument("--format", choices=("text", "json"), default="text",
+                     help="text sections (default) or one JSON object "
+                          "with the same sections")
+    exp = sub.add_parser("export-trace",
+                         help="render spans + step/compile/op_time "
+                              "events as Chrome-trace JSON for "
+                              "ui.perfetto.dev")
+    exp.add_argument("path")
+    exp.add_argument("-o", "--output", default=None,
+                     help="output path (default: <path>.trace.json)")
+    sub.add_parser("regress",
+                   help="perf-regression gate over bench artifacts "
+                        "(handled above — options live in regress.py; "
+                        "see `regress --help`)")
     args = p.parse_args(argv)
-    if args.cmd != "report":
-        p.print_help()
-        return 2
-    events = load_events(args.path, strict=args.strict)
-    print(format_report(events))
-    return 0
+    if args.cmd == "report":
+        events = load_events(args.path, strict=args.strict)
+        if args.format == "json":
+            print(json.dumps(report_data(events), indent=1, default=str))
+        else:
+            print(format_report(events))
+        return 0
+    if args.cmd == "export-trace":
+        from .exporter import export_trace
+
+        out = args.output or (args.path + ".trace.json")
+        stats = export_trace(args.path, out)
+        print(f"export-trace: {stats['events']} events "
+              f"({stats['spans']} spans) -> {stats['trace_events']} "
+              f"trace events in {out} (open in https://ui.perfetto.dev)")
+        return 0
+    p.print_help()
+    return 2

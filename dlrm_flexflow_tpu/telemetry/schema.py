@@ -134,6 +134,23 @@ SCHEMA: Dict[str, dict] = {
         "required": {"kind": str, "point": str},
         "optional": {"step": int, "remaining": int},
     },
+    # one closed span (telemetry/trace.py) — a Dapper-style timed,
+    # attributed region of a request or training run, emitted at span
+    # END.  ``start_s`` is the wall-clock start (time.time());
+    # ``dur_us`` comes from a monotonic clock.  ``parent_id`` links the
+    # causal chain within one ``trace_id`` (serving: submit →
+    # queue-wait → dispatch → pad → forward → reply; training: fit →
+    # epoch → dispatch → checkpoint/rollback).  ``status`` is "ok" or
+    # the reason the region ended otherwise ("error", "shed",
+    # "deadline", "cancelled", "rejected"); ``thread``/``tid`` name the
+    # thread that OPENED the span (the export-trace CLI's per-thread
+    # tracks).
+    "span": {
+        "required": {"name": str, "trace_id": str, "span_id": str,
+                     "start_s": float, "dur_us": float},
+        "optional": {"parent_id": str, "status": str, "attrs": dict,
+                     "thread": str, "tid": int},
+    },
 }
 
 

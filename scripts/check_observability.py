@@ -1,0 +1,296 @@
+"""Observability smoke matrix (tier-1: tests/test_observability.py
+runs it).
+
+End-to-end proof of the tracing / metrics / regress-gate contract on a
+tiny DLRM, CPU backend — the observability analogue of
+``check_serving.py`` (docs/telemetry.md):
+
+  1. traced serving run — a closed-loop run through the
+     DynamicBatcher with tracing on yields a JSONL in which >= 95% of
+     SERVED requests have a complete submit→reply span chain: a
+     ``serve.request`` root closed ``status="ok"`` with
+     ``serve.queue_wait`` and ``serve.forward`` children in the same
+     trace;
+  2. export-trace — the same JSONL converts to Chrome-trace JSON that
+     parses, carries one X slice per span, and names per-thread
+     tracks (opens directly in ui.perfetto.dev);
+  3. /metrics under traffic — two scrapes while a second traffic wave
+     flows return well-formed Prometheus text exposition with every
+     required family present and all counters monotone;
+  4. regress gate — identical inputs exit 0; a baseline doctored 10%
+     above the new result exits nonzero and NAMES the regressed
+     metric with its delta.
+
+Exit 0 when every scenario passes; prints one line per scenario and
+exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.serving import (DynamicBatcher,  # noqa: E402
+                                       InferenceEngine)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+from dlrm_flexflow_tpu.telemetry.exporter import (MetricsServer,  # noqa: E402
+                                                  export_trace)
+from dlrm_flexflow_tpu.telemetry.regress import main as regress  # noqa: E402
+from dlrm_flexflow_tpu.telemetry.report import load_events  # noqa: E402
+
+BUCKETS = "2,4,8"
+N_REQUESTS = 24
+
+#: families the /metrics scrape must always expose (sample-name
+#: prefixes: the histogram appears as _bucket/_sum/_count samples)
+REQUIRED_FAMILIES = (
+    "dlrm_serve_queue_depth", "dlrm_serve_requests_total",
+    "dlrm_serve_rejected_total", "dlrm_serve_deadline_missed_total",
+    "dlrm_serve_dispatches_total", "dlrm_serve_latency_us",
+    "dlrm_train_steps_total", "dlrm_checkpoint_saves_total",
+    "dlrm_sentinel_rollbacks_total",
+)
+
+_COUNTER_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([0-9.eE+-]+|NaN)$")
+
+
+def make_model():
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 48],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=8, serve_buckets=BUCKETS))
+    m.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return cfg, m
+
+
+def make_request(cfg, rng, n=1):
+    return {"dense": rng.standard_normal((n, cfg.mlp_bot[0])).astype(
+                np.float32),
+            "sparse": np.stack(
+                [rng.integers(0, r, size=(n, cfg.embedding_bag_size),
+                              dtype=np.int64)
+                 for r in cfg.embedding_size], axis=1)}
+
+
+def drive_traffic(cfg, engine, n=N_REQUESTS, seed=5) -> int:
+    """One closed-loop wave through THE serve_bench harness
+    (scripts/serve_bench.py::closed_loop — the same code the
+    BENCH_APP=dlrm_serving headline drives): ``n`` requests over 4
+    clients, drained batcher.  Returns the served-request count."""
+    from scripts.serve_bench import closed_loop
+
+    rng = np.random.default_rng(seed)
+    pool = [make_request(cfg, rng, 1 + i % 2) for i in range(n)]
+    batcher = DynamicBatcher(engine, max_wait_us=300)
+    clients = 4
+    _wall, rejected = closed_loop(batcher, pool, clients, n // clients)
+    summary = batcher.close()
+    if rejected:
+        raise RuntimeError(f"{rejected} requests rejected")
+    return int(summary["requests"])
+
+
+def scenario_traced_run(cfg, m, paths) -> str:
+    engine = InferenceEngine(m, m.init(seed=0))
+    jsonl = os.path.join(paths["dir"], "traced_serving.jsonl")
+    with event_log(jsonl, mode="w"):
+        served = drive_traffic(cfg, engine)
+    paths["jsonl"] = jsonl
+    paths["engine"] = engine
+    if served != N_REQUESTS:
+        return f"served {served} of {N_REQUESTS}"
+    spans = [e for e in load_events(jsonl) if e.get("type") == "span"]
+    roots = [s for s in spans if s["name"] == "serve.request"]
+    ok_roots = [s for s in roots if s.get("status") == "ok"]
+    if len(ok_roots) != served:
+        return (f"{len(ok_roots)} ok serve.request roots for "
+                f"{served} served requests")
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+    complete = sum(
+        1 for r in ok_roots
+        if {"serve.queue_wait", "serve.forward"}
+        <= by_trace.get(r["trace_id"], set()))
+    if complete < 0.95 * served:
+        return (f"only {complete}/{served} served requests have a "
+                f"complete submit->reply span chain")
+    # every span must have closed exactly once
+    ids = [s["span_id"] for s in spans]
+    if len(ids) != len(set(ids)):
+        return "a span event was emitted twice for one span_id"
+    return ""
+
+
+def scenario_export_trace(cfg, m, paths) -> str:
+    out = paths["jsonl"] + ".trace.json"
+    stats = export_trace(paths["jsonl"], out)
+    with open(out) as f:
+        doc = json.load(f)  # must PARSE — that is the contract
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        return "no traceEvents in the exported trace"
+    xs = [e for e in evs if e.get("ph") == "X"]
+    if len(xs) < stats["spans"]:
+        return (f"{len(xs)} X slices for {stats['spans']} spans")
+    for e in xs:
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            if k not in e:
+                return f"X slice missing {k!r}: {e!r}"
+    if not any(e.get("ph") == "M" and e.get("name") == "thread_name"
+               for e in evs):
+        return "no per-thread track names (thread_name metadata)"
+    return ""
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        if r.status != 200:
+            raise RuntimeError(f"/metrics -> HTTP {r.status}")
+        ctype = r.headers.get("Content-Type", "")
+        if not ctype.startswith("text/plain"):
+            raise RuntimeError(f"/metrics content-type {ctype!r}")
+        return r.read().decode("utf-8")
+
+
+def _parse_exposition(body: str) -> dict:
+    """{sample_name_with_labels: value}; raises on malformed lines."""
+    out = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        mo = _COUNTER_RE.match(line)
+        if mo is None:
+            raise RuntimeError(f"malformed exposition line: {line!r}")
+        out[mo.group(1) + (mo.group(2) or "")] = float(mo.group(3))
+    return out
+
+
+def scenario_metrics_scrape(cfg, m, paths) -> str:
+    engine = paths["engine"]
+    with MetricsServer(port=0, host="127.0.0.1") as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            if json.load(r).get("status") != "ok":
+                return "/healthz did not report ok"
+        first = _parse_exposition(_scrape(srv.port))
+        # second traffic wave WHILE scraping concurrently
+        stop = threading.Event()
+        scrape_errs = []
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    _parse_exposition(_scrape(srv.port))
+                except Exception as e:  # noqa: BLE001
+                    scrape_errs.append(repr(e))
+                    return
+
+        t = threading.Thread(target=scraper)
+        t.start()
+        try:
+            drive_traffic(cfg, engine, seed=7)
+        finally:
+            stop.set()
+            t.join()
+        if scrape_errs:
+            return f"concurrent scrape failed: {scrape_errs[0]}"
+        second = _parse_exposition(_scrape(srv.port))
+    for fam in REQUIRED_FAMILIES:
+        if not any(k == fam or k.startswith(fam + "_")
+                   or k.startswith(fam + "{") for k in second):
+            return f"family {fam} absent from the scrape"
+    if "dlrm_serve_queue_depth" not in second:
+        return "queue-depth gauge missing"
+    # counters monotone between the two scrapes
+    for k, v in first.items():
+        if k == "dlrm_serve_queue_depth" or "_samples_per_s" in k \
+                or "age_s" in k:
+            continue  # gauges may move either way
+        if second.get(k, 0.0) < v:
+            return f"counter {k} moved backwards: {v} -> {second.get(k)}"
+    served = second.get("dlrm_serve_requests_total", 0.0)
+    if served < first.get("dlrm_serve_requests_total", 0.0) + N_REQUESTS:
+        return (f"requests_total did not advance by the second wave "
+                f"({first.get('dlrm_serve_requests_total')} -> {served})")
+    return ""
+
+
+def scenario_regress_gate(cfg, m, paths) -> str:
+    import contextlib
+
+    rec = {"parsed": {"metric": "dlrm_synthetic_samples_per_sec",
+                      "value": 1000.0, "unit": "samples/s"}}
+    new_p = os.path.join(paths["dir"], "BENCH_new.json")
+    with open(new_p, "w") as f:
+        json.dump(rec, f)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = regress(["--baseline", new_p, "--new", new_p,
+                      "--tolerance", "5"])
+    if rc != 0:
+        return f"self-comparison exited {rc}: {buf.getvalue()!r}"
+    doctored = {"parsed": dict(rec["parsed"], value=1100.0)}  # +10%
+    base_p = os.path.join(paths["dir"], "BENCH_base.json")
+    with open(base_p, "w") as f:
+        json.dump(doctored, f)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = regress(["--baseline", base_p, "--new", new_p,
+                      "--tolerance", "5"])
+    out = buf.getvalue()
+    if rc == 0:
+        return "10% regression passed a 5% gate"
+    if "dlrm_synthetic_samples_per_sec" not in out or "%" not in out:
+        return f"regression output names no metric/delta: {out!r}"
+    return ""
+
+
+SCENARIOS = [
+    ("traced serving run -> complete span chains", scenario_traced_run),
+    ("export-trace -> valid Chrome trace", scenario_export_trace),
+    ("/metrics scrape under traffic", scenario_metrics_scrape),
+    ("regress gate (pass + doctored fail)", scenario_regress_gate),
+]
+
+
+def main() -> int:
+    cfg, m = make_model()  # one compile shared by the whole matrix
+    paths = {"dir": tempfile.mkdtemp(prefix="check_obs_")}
+    failed = 0
+    for name, fn in SCENARIOS:
+        try:
+            err = fn(cfg, m, paths)
+        except Exception as e:  # a scenario must fail loudly, not crash
+            err = f"raised {e!r}"
+        if err:
+            print(f"check_observability: {name}: FAIL — {err}")
+            failed += 1
+        else:
+            print(f"check_observability: {name}: OK")
+    if failed:
+        return 1
+    print(f"check_observability: OK ({len(SCENARIOS)} observability "
+          f"paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,10 +1,12 @@
-"""Telemetry schema lint (tier-1: tests/test_telemetry.py runs it).
+"""Telemetry schema + metrics-registry lint (tier-1:
+tests/test_telemetry.py runs it).
 
 Guards the three-way contract between the event producers (model.py,
 bench.py, sim/search.py, sim/simulator.py, profiling.OpTimer, the
-jax.monitoring hooks), ``telemetry/schema.py``, and the documented
-schema in ``docs/telemetry.md`` — so a producer cannot add, rename, or
-retype a field without the schema and the report CLI seeing it:
+jax.monitoring hooks, telemetry/trace.py spans),
+``telemetry/schema.py``, and the documented schema in
+``docs/telemetry.md`` — so a producer cannot add, rename, or retype a
+field without the schema and the report CLI seeing it:
 
   1. self-consistency — a maximal example event of every type (all
      required + optional fields) must pass ``validate_event`` through
@@ -14,7 +16,14 @@ retype a field without the schema and the report CLI seeing it:
      event section in the doc must exist in the schema;
   3. producer scan — every ``*.emit("<type>", field=...)`` call in the
      package (AST walk, no regex guessing) must name a known event type
-     and only known fields for it.
+     and only known fields for it;
+  4. metrics-name registry — every family the default
+     ``telemetry.metrics.REGISTRY`` exposes must be declared in
+     ``metrics.FAMILIES`` (and vice versa: no dead declarations), names
+     must be valid Prometheus identifiers with counter families ending
+     ``_total``, the rendered exposition must carry each family exactly
+     once (no duplicates), and every family must be documented in
+     docs/telemetry.md.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -158,15 +167,67 @@ def check_producers() -> list:
     return errs
 
 
+def check_metrics_registry(doc_path: str) -> list:
+    """The metric-name registry contract (telemetry/metrics.py): the
+    declared FAMILIES table, the default REGISTRY, the rendered
+    exposition, and docs/telemetry.md must all agree."""
+    import re
+
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+
+    errs = []
+    registered = set(tmetrics.REGISTRY.names())
+    declared = set(tmetrics.FAMILIES)
+    for name in sorted(registered - declared):
+        errs.append(f"metric {name!r} registered but not declared in "
+                    f"telemetry.metrics.FAMILIES")
+    for name in sorted(declared - registered):
+        errs.append(f"metric {name!r} declared in FAMILIES but never "
+                    f"registered in the default REGISTRY")
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for name, (mtype, help_) in sorted(tmetrics.FAMILIES.items()):
+        if not name_re.match(name):
+            errs.append(f"metric {name!r}: not a valid Prometheus "
+                        f"metric name")
+        if mtype not in ("counter", "gauge", "histogram"):
+            errs.append(f"metric {name!r}: unknown type {mtype!r}")
+        if mtype == "counter" and not name.endswith("_total"):
+            errs.append(f"metric {name!r}: counter families must end "
+                        f"'_total'")
+        if not help_.strip():
+            errs.append(f"metric {name!r}: empty help text")
+    try:
+        rendered = tmetrics.REGISTRY.render()
+    except Exception as e:
+        return errs + [f"REGISTRY.render() raised {e!r}"]
+    for name in sorted(declared):
+        n = rendered.count(f"# TYPE {name} ")
+        if n != 1:
+            errs.append(f"metric {name!r}: {n} TYPE lines in the "
+                        f"exposition (want exactly 1)")
+    if os.path.exists(doc_path):
+        with open(doc_path) as f:
+            doc = f.read()
+        for name in sorted(declared):
+            if f"`{name}`" not in doc:
+                errs.append(f"docs/telemetry.md does not document "
+                            f"metric family `{name}`")
+    return errs
+
+
 def main() -> int:
+    doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
-            + check_doc_sync(os.path.join(REPO, "docs", "telemetry.md"))
-            + check_producers())
+            + check_doc_sync(doc)
+            + check_producers()
+            + check_metrics_registry(doc))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:
         return 1
-    print(f"check_telemetry_schema: OK ({len(SCHEMA)} event types)")
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    print(f"check_telemetry_schema: OK ({len(SCHEMA)} event types, "
+          f"{len(tmetrics.FAMILIES)} metric families)")
     return 0
 
 

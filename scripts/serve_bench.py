@@ -15,16 +15,20 @@ p50/p95/p99 latency and QPS — the serving twin of the training
     offered load, including explicit `Rejected` shedding when the
     bounded queue fills.
 
-Telemetry lands in a JSONL (default ``telemetry_serving.jsonl`` next to
-this script's repo root; ``--telemetry`` overrides) whose ``serve``
-events feed::
+Telemetry lands in a JSONL (default
+``artifacts/telemetry_serving.jsonl`` under the repo root;
+``--telemetry`` overrides) whose ``serve`` + ``span`` events feed::
 
-    python -m dlrm_flexflow_tpu.telemetry report telemetry_serving.jsonl
+    python -m dlrm_flexflow_tpu.telemetry report artifacts/telemetry_serving.jsonl
+    python -m dlrm_flexflow_tpu.telemetry export-trace artifacts/telemetry_serving.jsonl
 
-which prints the ``== serving ==`` section this run produced.  With
-``--checkpoint DIR`` the engine loads params from a training
-checkpoint (optimizer slots skipped — checkpoint.py inference-only
-restore) instead of a fresh init.
+the report's ``== serving ==`` / ``== spans ==`` sections and the
+Perfetto timeline of every request's submit → queue-wait → forward →
+reply chain.  With ``--checkpoint DIR`` the engine loads params from a
+training checkpoint (optimizer slots skipped — checkpoint.py
+inference-only restore) instead of a fresh init; ``--metrics-port N``
+serves live Prometheus metrics at ``http://:N/metrics`` for the run's
+duration (docs/telemetry.md).
 """
 
 from __future__ import annotations
@@ -176,9 +180,26 @@ def main(argv=None) -> int:
                    help="CheckpointManager dir (or one ckpt dir) to "
                         "load params from (inference-only restore)")
     p.add_argument("--telemetry",
-                   default=os.path.join(REPO, "telemetry_serving.jsonl"))
+                   default=os.path.join(REPO, "artifacts",
+                                        "telemetry_serving.jsonl"))
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus /metrics + /healthz on this "
+                        "port for the run (0 = off)")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   help="bind address for /metrics (loopback by "
+                        "default — the endpoint is unauthenticated; "
+                        "0.0.0.0 exposes it to the network)")
     args = p.parse_args(argv)
 
+    os.makedirs(os.path.dirname(os.path.abspath(args.telemetry)),
+                exist_ok=True)
+    if args.metrics_port:
+        from dlrm_flexflow_tpu.telemetry.exporter import start_metrics_server
+
+        srv = start_metrics_server(args.metrics_port,
+                                   host=args.metrics_host)
+        print(f"serve_bench: metrics at "
+              f"http://{args.metrics_host}:{srv.port}/metrics")
     cfg, model = build_model(args)
     with event_log(args.telemetry, mode="w"):
         if args.checkpoint:
