@@ -1,0 +1,82 @@
+"""ffcheck CLI (docs/analysis.md).
+
+    python -m dlrm_flexflow_tpu.analysis                 # all passes
+    python -m dlrm_flexflow_tpu.analysis --pass lock-discipline
+    python -m dlrm_flexflow_tpu.analysis --format json -o artifacts/analysis_1.json
+
+Exit 0 when every finding is clean or waived AND no waiver is stale;
+1 otherwise; 2 on usage errors.  ``-o`` writes the JSON result as an
+``artifacts/analysis_*.json`` sink the telemetry report CLI's
+``== analysis ==`` section picks up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (Waivers, WaiverError, all_passes, default_waivers,
+                     repo_root, run_analysis, write_json)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dlrm_flexflow_tpu.analysis",
+        description=__doc__.split("\n")[0])
+    p.add_argument("roots", nargs="*", default=None,
+                   help="files/dirs to analyze, relative to --root "
+                        "(default: the package, scripts/, bench.py)")
+    p.add_argument("--pass", dest="passes", action="append", default=None,
+                   metavar="NAME",
+                   help="run only this pass (repeatable; see --list)")
+    p.add_argument("--list", action="store_true",
+                   help="list available passes and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="findings as text lines (default) or one JSON "
+                        "object")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the checkout containing "
+                        "this package)")
+    p.add_argument("--waivers", default=None,
+                   help="waiver file (default: ANALYSIS_WAIVERS.txt at "
+                        "the repo root, if present)")
+    p.add_argument("-o", "--output", default=None,
+                   help="also write the JSON result here (e.g. "
+                        "artifacts/analysis_1.json for the telemetry "
+                        "report's == analysis == section)")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, cls in sorted(all_passes().items()):
+            print(f"{name:18s} {cls.description}")
+        return 0
+
+    repo = args.root or repo_root()
+    try:
+        waivers = (Waivers.load(args.waivers) if args.waivers
+                   else default_waivers(repo))
+    except (WaiverError, OSError) as e:
+        print(f"ffcheck: bad waiver file: {e}", file=sys.stderr)
+        return 2
+    try:
+        result = run_analysis(repo=repo, roots=args.roots or None,
+                              pass_names=args.passes, waivers=waivers)
+    except ValueError as e:
+        print(f"ffcheck: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"ffcheck: unparseable source: {e}", file=sys.stderr)
+        return 2
+
+    if args.output:
+        write_json(result, args.output)
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        print(result.format_text())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,463 @@
+"""ffcheck shared engine: module loader, symbol index, findings,
+waivers (docs/analysis.md).
+
+The framework's correctness rests on conventions no runtime test can
+economically cover — "never emit telemetry while holding a lock", "no
+host syncs inside jitted paths", "serving's AOT forward is donation-
+free", "subsystems import downward only".  RacerD (Blackshear et al.,
+OOPSLA'18) showed this class of invariant is findable by compositional
+AST analysis without executing anything; this module is the shared
+spine every pass (``analysis/passes/``) builds on:
+
+* :func:`load_modules` — ONE module walker for the whole toolchain
+  (``scripts/check_telemetry_schema.py`` delegates its producer scan
+  here too): package + scripts + bench.py parsed once into
+  :class:`Module` records with repo-relative paths;
+* :class:`FunctionIndex` — lexically-scoped function/method lookup so
+  passes resolve ``f(...)`` / ``self.m(...)`` call targets the way the
+  interpreter would, not by grepping names;
+* :class:`Finding` — ``path:line`` + pass + code + a STABLE waiver key
+  (no line numbers — waivers survive unrelated edits);
+* :class:`Waivers` — the committed baseline (``ANALYSIS_WAIVERS.txt``):
+  every entry carries a one-line justification, matching is exact-key,
+  and an entry no finding uses FAILS the run (stale waivers rot into
+  silent blanket exemptions otherwise);
+* :func:`run_analysis` — load, run passes, apply waivers, one
+  :class:`AnalysisResult` the CLI renders as text or JSON.
+
+Everything here is stdlib-only (ast/os/json): the analyzer must stay
+runnable before jax imports, in CI, and on machines with no accelerator.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: default roots the analyzer covers, relative to the repo root: the
+#: package itself, the ops/CI scripts, and the bench entry points.
+DEFAULT_ROOTS = ("dlrm_flexflow_tpu", "scripts", "bench.py")
+
+#: the committed waiver/baseline file, at the repo root next to the
+#: package (absent == no waivers, e.g. for an installed wheel).
+WAIVER_FILE = "ANALYSIS_WAIVERS.txt"
+
+
+def repo_root() -> str:
+    """The directory holding the package (and the waiver file)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------- modules
+class Module:
+    """One parsed source file: dotted name, repo-relative path, AST."""
+
+    __slots__ = ("name", "path", "relpath", "tree", "source")
+
+    def __init__(self, name: str, path: str, relpath: str,
+                 tree: ast.Module, source: str):
+        self.name = name          # e.g. "dlrm_flexflow_tpu.serving.engine"
+        self.path = path          # absolute
+        self.relpath = relpath    # repo-relative, '/'-separated
+        self.tree = tree
+        self.source = source
+
+    @property
+    def top(self) -> str:
+        """The layering unit: first path component under the repo for
+        package modules ("dlrm_flexflow_tpu/serving/..." -> "serving"),
+        the directory for scripts, the stem for top-level files."""
+        parts = self.relpath.split("/")
+        if parts[0] == "dlrm_flexflow_tpu":
+            if len(parts) == 2:
+                return parts[1][:-3]  # dlrm_flexflow_tpu/model.py -> model
+            return parts[1]
+        if len(parts) > 1:
+            return parts[0]           # scripts/foo.py -> scripts
+        return parts[0][:-3]          # bench.py -> bench
+
+    def __repr__(self):
+        return f"Module({self.relpath!r})"
+
+
+def load_modules(roots: Optional[Sequence[str]] = None,
+                 repo: Optional[str] = None,
+                 errors: Optional[List[Tuple[str, SyntaxError]]] = None
+                 ) -> List[Module]:
+    """Parse every ``*.py`` under ``roots`` (files or directories,
+    repo-relative) into :class:`Module` records, sorted by relpath.
+    A file that does not parse raises — an unparseable source would
+    silently blind every pass, which is exactly the failure mode a
+    lint exists to prevent.  Callers that want to REPORT per-file and
+    keep scanning the rest (check_telemetry_schema's producer scan)
+    pass ``errors``: failures append ``(relpath, exc)`` there and the
+    file is skipped instead of raising."""
+    repo = repo or repo_root()
+    roots = DEFAULT_ROOTS if roots is None else roots
+    out: List[Module] = []
+    paths: List[str] = []
+    for root in roots:
+        full = os.path.join(repo, root)
+        if os.path.isfile(full):
+            paths.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirs, files in os.walk(full):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                paths.extend(os.path.join(dirpath, f)
+                             for f in files if f.endswith(".py"))
+    for path in sorted(paths):
+        rel = os.path.relpath(path, repo).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            if errors is None:
+                raise
+            errors.append((rel, e))
+            continue
+        name = rel[:-3].replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[:-len(".__init__")]
+        out.append(Module(name, path, rel, tree, source))
+    return out
+
+
+# --------------------------------------------------------- function index
+def walk_functions(module: Module):
+    """Yield ``(qualname, node, classname, scope)`` for every function/
+    method in the module, where ``scope`` is the tuple of enclosing
+    FUNCTION names (classes contribute to qualname but not to lexical
+    name visibility — a method is not callable as a bare name)."""
+
+    def visit(node, qual: Tuple[str, ...], cls: Optional[str],
+              scope: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = qual + (child.name,)
+                yield ".".join(q), child, cls, scope
+                yield from visit(child, q, None, scope + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, qual + (child.name,),
+                                 child.name, scope)
+            elif isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                # defs nested in if/try/for/with bodies: same scope
+                yield from visit(child, qual, cls, scope)
+
+    yield from visit(module.tree, (), None, ())
+
+
+class FunctionIndex:
+    """Call-target resolution for one project, the way Python scoping
+    would: bare names resolve lexically (innermost enclosing function
+    scope outward, then module level; methods are invisible to bare
+    names), ``self.m`` resolves to the enclosing class, and ``obj.m``
+    resolves only when exactly one class in the project defines ``m``
+    (ambiguity -> None, never a guess)."""
+
+    #: attribute names too generic to resolve by project-wide uniqueness
+    GENERIC = frozenset({
+        "get", "put", "pop", "append", "add", "items", "keys", "values",
+        "update", "copy", "close", "open", "read", "write", "start",
+        "end", "run", "join", "split", "strip", "format", "emit",
+        "__init__", "__enter__", "__exit__"})
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules = list(modules)
+        # (module name, scope tuple, bare name) -> def node
+        self._scoped: Dict[Tuple[str, Tuple[str, ...], str], ast.AST] = {}
+        # method name -> [(module, classname, node)]
+        self._methods: Dict[str, List[Tuple[Module, str, ast.AST]]] = {}
+        # (module name, classname, method name) -> node
+        self._class_methods: Dict[Tuple[str, str, str], ast.AST] = {}
+        # def node -> (module, qualname, classname-or-None, scope)
+        self.owner: Dict[ast.AST, Tuple[Module, str, Optional[str],
+                                        Tuple[str, ...]]] = {}
+        for m in self.modules:
+            for qual, node, cls, scope in walk_functions(m):
+                self.owner[node] = (m, qual, cls, scope)
+                if cls is None:
+                    self._scoped[(m.name, scope, node.name)] = node
+                else:
+                    self._methods.setdefault(node.name, []).append(
+                        (m, cls, node))
+                    self._class_methods[(m.name, cls, node.name)] = node
+
+    def resolve_name(self, module: Module, scope: Tuple[str, ...],
+                     name: str) -> Optional[ast.AST]:
+        """A bare-name call ``name(...)`` made inside ``scope``."""
+        for i in range(len(scope), -1, -1):
+            node = self._scoped.get((module.name, scope[:i], name))
+            if node is not None:
+                return node
+        return None
+
+    def resolve_self_method(self, module: Module, classname: str,
+                            name: str) -> Optional[ast.AST]:
+        return self._class_methods.get((module.name, classname, name))
+
+    def resolve_unique_method(self, name: str) -> Optional[ast.AST]:
+        if name in self.GENERIC:
+            return None
+        cands = self._methods.get(name, ())
+        if len(cands) == 1:
+            return cands[0][2]
+        return None
+
+    def resolve_call(self, call: ast.Call, module: Module,
+                     scope: Tuple[str, ...],
+                     classname: Optional[str]) -> Optional[ast.AST]:
+        """Best-effort target of one Call node, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.resolve_name(module, scope, fn.id)
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and classname is not None:
+                found = self.resolve_self_method(module, classname,
+                                                 fn.attr)
+                if found is not None:
+                    return found
+            return self.resolve_unique_method(fn.attr)
+        return None
+
+
+# --------------------------------------------------------------- findings
+class Finding:
+    """One violation: ``path:line`` for humans, a line-number-free
+    ``waiver_key`` for the committed baseline."""
+
+    __slots__ = ("pass_name", "path", "line", "code", "message",
+                 "severity", "detail")
+
+    def __init__(self, pass_name: str, path: str, line: int, code: str,
+                 message: str, detail: str = "", severity: str = "error"):
+        self.pass_name = pass_name
+        self.path = path
+        self.line = int(line)
+        self.code = code
+        self.message = message
+        self.detail = detail          # usually the enclosing qualname
+        self.severity = severity
+
+    @property
+    def waiver_key(self) -> str:
+        return f"{self.pass_name}:{self.path}:{self.detail}:{self.code}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: "
+                f"[{self.pass_name}/{self.code}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"pass": self.pass_name, "path": self.path,
+                "line": self.line, "code": self.code,
+                "message": self.message, "detail": self.detail,
+                "severity": self.severity,
+                "waiver_key": self.waiver_key}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(d["pass"], d["path"], d["line"], d["code"],
+                   d["message"], d.get("detail", ""),
+                   d.get("severity", "error"))
+
+    def __repr__(self):
+        return f"Finding({self.format()!r})"
+
+
+class AnalysisPass:
+    """Base class; subclasses set ``name``/``description`` and
+    implement ``run(modules, index) -> List[Finding]``."""
+
+    name: str = "?"
+    description: str = ""
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, code: str, message: str,
+                detail: str = "", severity: str = "error") -> Finding:
+        return Finding(self.name, path, line, code, message,
+                       detail=detail, severity=severity)
+
+
+def all_passes() -> Dict[str, type]:
+    """name -> pass class for every shipped pass (import deferred so
+    the engine itself stays importable from pass modules)."""
+    from .passes import PASSES
+    return {p.name: p for p in PASSES}
+
+
+# ---------------------------------------------------------------- waivers
+class WaiverError(ValueError):
+    """The waiver file itself is malformed (fail loudly: a silently
+    dropped waiver line would either block CI or mask a violation)."""
+
+
+class Waivers:
+    """The committed baseline: ``<waiver-key> | <justification>`` lines
+    (``#`` comments, blanks ignored).  Matching is exact-key; every
+    entry must justify itself and must still match at least one finding
+    (:meth:`unused` feeds the stale-waiver failure)."""
+
+    def __init__(self, entries: Optional[List[Tuple[str, str, int]]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries = entries or []   # (key, justification, lineno)
+        self._used: Dict[str, bool] = {k: False for k, _, _ in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Waivers":
+        entries: List[Tuple[str, str, int]] = []
+        seen: Dict[str, int] = {}
+        with open(path, encoding="utf-8") as f:
+            for i, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "|" not in line:
+                    raise WaiverError(
+                        f"{path}:{i}: waiver entry needs "
+                        f"'<key> | <justification>', got {line!r}")
+                key, just = (s.strip() for s in line.split("|", 1))
+                if not just:
+                    raise WaiverError(
+                        f"{path}:{i}: waiver {key!r} has no "
+                        f"justification — every exemption must say why")
+                if key.count(":") < 3:
+                    raise WaiverError(
+                        f"{path}:{i}: malformed waiver key {key!r} "
+                        f"(want pass:path:detail:code)")
+                if key in seen:
+                    raise WaiverError(
+                        f"{path}:{i}: duplicate waiver {key!r} "
+                        f"(first at line {seen[key]})")
+                seen[key] = i
+                entries.append((key, just, i))
+        return cls(entries, path=path)
+
+    def match(self, finding: Finding) -> Optional[str]:
+        """The justification when ``finding`` is waived (marking the
+        entry used), else None."""
+        key = finding.waiver_key
+        for k, just, _ in self.entries:
+            if k == key:
+                self._used[k] = True
+                return just
+        return None
+
+    def unused(self) -> List[Tuple[str, str, int]]:
+        return [(k, j, ln) for k, j, ln in self.entries
+                if not self._used.get(k)]
+
+
+# ----------------------------------------------------------------- runner
+class AnalysisResult:
+    """One run: active findings, waived findings (with justification),
+    and stale waivers.  ``ok`` is the CI gate."""
+
+    def __init__(self, pass_names: List[str], n_modules: int,
+                 findings: List[Finding],
+                 waived: List[Tuple[Finding, str]],
+                 unused_waivers: List[Tuple[str, str, int]]):
+        self.pass_names = pass_names
+        self.n_modules = n_modules
+        self.findings = findings
+        self.waived = waived
+        self.unused_waivers = unused_waivers
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.unused_waivers
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "tool": "ffcheck",
+            "passes": list(self.pass_names),
+            "modules": self.n_modules,
+            "findings": [f.to_dict() for f in self.findings],
+            "waived": [{**f.to_dict(), "justification": j}
+                       for f, j in self.waived],
+            "unused_waivers": [{"key": k, "justification": j, "line": ln}
+                               for k, j, ln in self.unused_waivers],
+            "summary": {"findings": len(self.findings),
+                        "waived": len(self.waived),
+                        "unused_waivers": len(self.unused_waivers),
+                        "ok": self.ok},
+        }
+
+    def format_text(self) -> str:
+        lines: List[str] = []
+        for f in self.findings:
+            lines.append(f.format())
+        for k, j, ln in self.unused_waivers:
+            where = f"{self.waivers_path or WAIVER_FILE}:{ln}"
+            lines.append(f"{where}: [waivers/unused-waiver] waiver "
+                         f"{k!r} matches no finding — remove it "
+                         f"(was: {j})")
+        status = "OK" if self.ok else "FAIL"
+        lines.append(
+            f"ffcheck: {status} — {len(self.findings)} finding(s), "
+            f"{len(self.waived)} waived, "
+            f"{len(self.unused_waivers)} stale waiver(s); "
+            f"{len(self.pass_names)} pass(es) over "
+            f"{self.n_modules} modules")
+        return "\n".join(lines)
+
+    waivers_path: Optional[str] = None
+
+
+def run_analysis(modules: Optional[List[Module]] = None,
+                 pass_names: Optional[Sequence[str]] = None,
+                 waivers: Optional[Waivers] = None,
+                 repo: Optional[str] = None,
+                 roots: Optional[Sequence[str]] = None) -> AnalysisResult:
+    """Load (unless given), run the requested passes (default: all),
+    apply waivers.  Raises KeyError on an unknown pass name."""
+    if modules is None:
+        modules = load_modules(roots=roots, repo=repo)
+    registry = all_passes()
+    names = list(pass_names) if pass_names else sorted(registry)
+    for n in names:
+        if n not in registry:
+            raise ValueError(
+                f"unknown pass {n!r} (have: {sorted(registry)})")
+    index = FunctionIndex(modules)
+    findings: List[Finding] = []
+    for n in names:
+        findings.extend(registry[n]().run(modules, index))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    active: List[Finding] = []
+    waived: List[Tuple[Finding, str]] = []
+    for f in findings:
+        just = waivers.match(f) if waivers is not None else None
+        if just is None:
+            active.append(f)
+        else:
+            waived.append((f, just))
+    unused = waivers.unused() if waivers is not None else []
+    res = AnalysisResult(names, len(modules), active, waived, unused)
+    res.waivers_path = waivers.path if waivers is not None else None
+    return res
+
+
+def default_waivers(repo: Optional[str] = None) -> Optional[Waivers]:
+    """The committed waiver file, or None when absent."""
+    path = os.path.join(repo or repo_root(), WAIVER_FILE)
+    return Waivers.load(path) if os.path.exists(path) else None
+
+
+def write_json(result: AnalysisResult, path: str) -> None:
+    """One ``artifacts/analysis_*.json``-style sink the telemetry
+    report CLI's ``== analysis ==`` section reads."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(result.to_dict(), f, indent=1)
+        f.write("\n")

@@ -1,0 +1,33 @@
+"""ffcheck pass catalog (docs/analysis.md).
+
+Each pass is one :class:`~..engine.AnalysisPass` subclass grounded in a
+real hazard this codebase has already hit in review:
+
+* ``lock-discipline`` — telemetry emits / blocking I/O / future
+  completion under a held lock, and inconsistent pairwise lock
+  acquisition order (deadlock potential);
+* ``trace-purity``    — host syncs, side effects, and telemetry emits
+  inside functions reachable from jit/AOT-compiled entry points;
+* ``donation-safety`` — arguments donated to a compiled callable
+  referenced again after the call;
+* ``import-layering`` — module-level imports that climb the subsystem
+  DAG upward.
+
+Adding a pass: subclass AnalysisPass in a new module here, set
+``name``/``description``, implement ``run``, append to ``PASSES``.
+"""
+
+from .donation import DonationSafetyPass
+from .layering import ImportLayeringPass
+from .locks import LockDisciplinePass
+from .purity import TracePurityPass
+
+PASSES = [
+    LockDisciplinePass,
+    TracePurityPass,
+    DonationSafetyPass,
+    ImportLayeringPass,
+]
+
+__all__ = ["PASSES", "LockDisciplinePass", "TracePurityPass",
+           "DonationSafetyPass", "ImportLayeringPass"]

@@ -1,0 +1,326 @@
+"""donation-safety pass: a donated buffer is dead after the call.
+
+``jax.jit(f, donate_argnums=(0,))`` lets XLA reuse the argument's
+device buffers for outputs — the input is INVALID afterwards, and
+touching it raises (on TPU) or silently reads garbage (some backends /
+future versions).  The training path donates its ``TrainState``
+(model.py ``_compile_body``); the serving engine is deliberately
+donation-free (engine.py builds ``_forward_fn`` with no
+``donate_argnums`` so shed/retried request buffers survive) — this
+pass both proves that (no findings on serving/) and guards the train
+path: any call through a donating callable whose donated argument is a
+variable that is READ again afterwards is flagged.
+
+What counts as a donating callable:
+
+* ``self._x = jax.jit(f, donate_argnums=...)`` — attribute ``_x`` is
+  donating project-wide (argnums from a literal int/tuple, or resolved
+  through one local assignment, including both arms of a conditional
+  ``(0,) if flag else ()`` — the union, since EITHER arm may run);
+* ``g = jax.jit(f, donate_argnums=...)`` — local name ``g``;
+* a local alias of a donating attribute (``step = self._train_step``
+  or ``step = self._train_step if d else self._train_step_nodonate``
+  — again the union: if ANY arm donates, the alias may donate).
+
+The "read after the call" check is linear in source order within the
+enclosing function: the classic safe pattern ``state = step(state, ..)``
+(the call's own assignment rebinds the donated name, in tuple targets
+too) is recognized; a later rebinding of the name ends the taint.
+Cross-function escapes and reads on earlier lines of a loop body are
+out of scope (documented in docs/analysis.md).
+
+Code: ``donated-arg-reuse``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import AnalysisPass, Finding, FunctionIndex, Module
+
+
+def _literal_argnums(node: ast.expr) -> Optional[Set[int]]:
+    """The donate_argnums a literal expresses, or None if not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _resolve_argnums(expr: ast.expr,
+                     enclosing: Optional[ast.AST]) -> Set[int]:
+    """Donated argnums of a ``donate_argnums=EXPR`` keyword: literal,
+    conditional of literals (union — either arm may run), or a Name
+    resolved through ONE simple assignment in the enclosing function."""
+    lit = _literal_argnums(expr)
+    if lit is not None:
+        return lit
+    if isinstance(expr, ast.IfExp):
+        return (_resolve_argnums(expr.body, enclosing)
+                | _resolve_argnums(expr.orelse, enclosing))
+    if isinstance(expr, ast.Name) and enclosing is not None:
+        out: Set[int] = set()
+        for node in ast.walk(enclosing):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == expr.id
+                            for t in node.targets):
+                out |= _resolve_argnums(node.value, None)
+        return out
+    return set()
+
+
+def _jit_donation(call: ast.Call,
+                  enclosing: Optional[ast.AST]) -> Optional[Set[int]]:
+    """Non-empty argnums when ``call`` is a jit with donation."""
+    fn = call.func
+    is_jit = (isinstance(fn, ast.Attribute) and fn.attr == "jit") \
+        or (isinstance(fn, ast.Name) and fn.id == "jit")
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            nums = _resolve_argnums(kw.value, enclosing)
+            return nums or None
+    return None
+
+
+class DonationSafetyPass(AnalysisPass):
+    name = "donation-safety"
+    description = ("arguments donated to a compiled callable must not "
+                   "be referenced after the call")
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        # attr name -> donated argnums, project-wide (jitted programs
+        # are stored on self and called from other modules, e.g. the
+        # resilient loop driving model._train_step)
+        donated_attrs: Dict[str, Set[int]] = {}
+        for node, (mod, _q, _c, _s) in index.owner.items():
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                nums = _jit_donation(call, node)
+                if not nums:
+                    continue
+                parent = self._assign_parent(node, call)
+                if parent is None:
+                    continue
+                for t in parent.targets:
+                    if isinstance(t, ast.Attribute):
+                        donated_attrs[t.attr] = \
+                            donated_attrs.get(t.attr, set()) | nums
+        findings: List[Finding] = []
+        for node, (mod, qual, _cls, _scope) in index.owner.items():
+            findings.extend(self._check_function(
+                node, mod, qual, donated_attrs))
+        return findings
+
+    @staticmethod
+    def _assign_parent(fn_node: ast.AST,
+                       call: ast.Call) -> Optional[ast.Assign]:
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and node.value is call:
+                return node
+        return None
+
+    # ------------------------------------------------------------ per-fn
+    def _check_function(self, fn_node: ast.AST, module: Module,
+                        qual: str,
+                        donated_attrs: Dict[str, Set[int]]
+                        ) -> List[Finding]:
+        # local donating names: direct jit assignment or alias of a
+        # donating attribute (either arm of a conditional counts)
+        local: Dict[str, Set[int]] = {}
+
+        def alias_nums(expr: ast.expr) -> Set[int]:
+            if isinstance(expr, ast.Attribute):
+                return donated_attrs.get(expr.attr, set())
+            if isinstance(expr, ast.IfExp):
+                return alias_nums(expr.body) | alias_nums(expr.orelse)
+            if isinstance(expr, ast.Call):
+                return _jit_donation(expr, fn_node) or set()
+            return set()
+
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                nums = alias_nums(node.value)
+                if nums:
+                    local[node.targets[0].id] = nums
+
+        stmts = self._linear_statements(fn_node)
+        findings: List[Finding] = []
+        for si, (stmt, _branches) in enumerate(stmts):
+            for call in self._own_calls_of_stmt(stmt):
+                nums = self._call_donation(call, local, donated_attrs)
+                if not nums:
+                    continue
+                rebound = self._stmt_binds(stmt)
+                for i in sorted(nums):
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id in rebound:
+                        continue  # state = step(state, ...) — safe
+                    use = self._read_after(stmts, si, arg.id)
+                    if use is not None:
+                        cname = self._call_name(call)
+                        findings.append(self.finding(
+                            module.relpath, use,
+                            "donated-arg-reuse",
+                            f"`{arg.id}` was donated (argnum {i}) to "
+                            f"{cname} at line {call.lineno} and is "
+                            f"read again here — donation invalidates "
+                            f"its buffers",
+                            detail=f"{qual}.{arg.id}"))
+        return findings
+
+    @staticmethod
+    def _own_calls_of_stmt(stmt: ast.stmt):
+        """Calls belonging DIRECTLY to this statement (not to nested
+        statements, which get their own linear slot)."""
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from visit(child)
+
+        yield from visit(stmt)
+
+    @staticmethod
+    def _call_name(call: ast.Call) -> str:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            return f".{fn.attr}()"
+        if isinstance(fn, ast.Name):
+            return f"{fn.id}()"
+        return "<call>()"
+
+    @staticmethod
+    def _call_donation(call: ast.Call, local: Dict[str, Set[int]],
+                       donated_attrs: Dict[str, Set[int]]) -> Set[int]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return local.get(fn.id, set())
+        if isinstance(fn, ast.Attribute):
+            return donated_attrs.get(fn.attr, set())
+        return set()
+
+    @staticmethod
+    def _linear_statements(fn_node: ast.AST
+                           ) -> List[Tuple[ast.stmt, tuple]]:
+        """``(statement, branch-chain)`` in source order, nested defs
+        excluded.  The branch chain records which arm of each enclosing
+        ``if`` the statement sits in, so a "read after the call" in the
+        MUTUALLY EXCLUSIVE arm is not a finding."""
+        out: List[Tuple[ast.stmt, tuple]] = []
+
+        def visit(node, branches: tuple):
+            if isinstance(node, ast.If):
+                for child in node.body:
+                    record(child, branches + ((id(node), "body"),))
+                for child in node.orelse:
+                    record(child, branches + ((id(node), "orelse"),))
+                return
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    record(child, branches)
+                elif not isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.Lambda, ast.ClassDef)):
+                    visit(child, branches)
+
+        def record(stmt: ast.stmt, branches: tuple):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            out.append((stmt, branches))
+            visit(stmt, branches)
+
+        for child in ast.iter_child_nodes(fn_node):
+            if isinstance(child, ast.stmt):
+                record(child, ())
+            elif not isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda, ast.ClassDef)):
+                visit(child, ())
+        out.sort(key=lambda se: (se[0].lineno, se[0].col_offset))
+        return out
+
+    @staticmethod
+    def _excluded(a: tuple, b: tuple) -> bool:
+        """True when the two branch chains sit in different arms of
+        the same ``if`` — control flow can reach one or the other,
+        never both."""
+        da = dict(a)
+        return any(da.get(nid) not in (None, arm) for nid, arm in b)
+
+    @staticmethod
+    def _stmt_binds(stmt: ast.stmt) -> Set[str]:
+        """Names (re)bound by this statement's assignment targets,
+        tuple elements included."""
+        out: Set[str] = set()
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        return out
+
+    def _read_after(self, stmts: List[Tuple[ast.stmt, tuple]],
+                    call_si: int, name: str) -> Optional[int]:
+        """Line of the first Load of ``name`` after statement
+        ``call_si`` (skipping arms mutually exclusive with the call's),
+        stopping at a statement that rebinds it."""
+        call_branches = stmts[call_si][1]
+        for stmt, branches in stmts[call_si + 1:]:
+            if self._excluded(call_branches, branches):
+                continue
+            # a rebinding statement may also READ the name in its value
+            # (x = f(x)) — reads in the value side still count, so scan
+            # loads first, then stop if rebound
+            for n in self._own_exprs_of_stmt(stmt):
+                if isinstance(n, ast.Name) and n.id == name \
+                        and isinstance(n.ctx, ast.Load):
+                    return n.lineno
+            if name in self._stmt_binds(stmt):
+                return None
+        return None
+
+    @staticmethod
+    def _own_exprs_of_stmt(stmt: ast.stmt):
+        """Expression nodes directly in this statement (nested
+        statements have their own linear slot; nested defs are other
+        scopes)."""
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                yield child
+                stack.append(child)

@@ -1,0 +1,234 @@
+"""trace-purity pass: jit-traced code must stay on the device.
+
+``FFModel.compile`` builds its programs with ``jax.jit`` (train_step /
+train_epoch(s) / eval_step / forward — the serving engine AOT-compiles
+the same ``forward``); anything reachable from those entry points runs
+under a tracer.  A host sync there (``.item()``, ``np.asarray``,
+``.block_until_ready()``) either crashes on a tracer or silently
+fences the pipeline; a Python side effect (``print``, ``open``,
+telemetry ``emit``) fires at TRACE time only — once per compile, never
+per step — which is almost never what the author meant; a host clock
+read bakes trace-time wall time into the graph as a constant.
+
+Entry points are discovered, not configured: every ``jax.jit(f, ...)``
+call whose first argument resolves lexically to a function definition
+seeds the walk.  Reachability follows bare-name calls (lexical
+resolution), ``self.method`` calls, function arguments to the
+``jax.lax`` control-flow combinators (scan/cond/while_loop/fori_loop/
+switch), and nested function definitions (scan bodies and closures run
+in-graph).  Attribute calls on unknown objects are NOT followed — this
+pass prefers silence to guessing (documented in docs/analysis.md).
+
+Codes: ``host-sync-in-trace``, ``side-effect-in-trace``,
+``emit-in-trace``, ``host-clock-in-trace``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import AnalysisPass, Finding, FunctionIndex, Module
+
+#: attribute calls that force a device->host sync
+SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
+#: numpy-module calls that materialize on host (flagged only through a
+#: name actually bound to the ``numpy`` module — jnp.asarray is fine)
+NUMPY_SYNCS = frozenset({"asarray", "array", "frombuffer", "copyto"})
+#: side effects at trace time
+SIDE_EFFECT_NAMES = frozenset({"print", "open"})
+#: telemetry producers
+EMIT_NAMES = frozenset({"emit", "emit_summary", "sample_memory",
+                        "record_span", "start_span", "active_log"})
+#: host clock reads (through a name bound to the ``time`` module)
+CLOCK_ATTRS = frozenset({"time", "perf_counter", "monotonic",
+                         "process_time"})
+#: jax.lax control-flow combinators whose function args run in-trace
+LAX_COMBINATORS = frozenset({"scan", "cond", "while_loop", "fori_loop",
+                             "switch", "associative_scan", "map"})
+
+
+def _module_aliases(module: Module) -> Tuple[Set[str], Set[str], Set[str]]:
+    """Names bound at module level to numpy / jax / time."""
+    np_names: Set[str] = set()
+    jax_names: Set[str] = set()
+    time_names: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "numpy":
+                    np_names.add(bound)
+                elif a.name == "jax" or a.name.startswith("jax."):
+                    if a.name == "jax" or a.asname is None:
+                        jax_names.add("jax" if a.asname is None
+                                      else a.asname)
+                elif a.name == "time":
+                    time_names.add(bound)
+    return np_names, jax_names, time_names
+
+
+class TracePurityPass(AnalysisPass):
+    name = "trace-purity"
+    description = ("no host syncs, side effects, telemetry emits, or "
+                   "host clock reads inside jit/AOT-traced functions")
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        # entry discovery + closure is per module: jitted programs are
+        # built from locally visible functions in this codebase
+        for m in modules:
+            findings.extend(self._run_module(m, index))
+        return findings
+
+    # --------------------------------------------------------- discovery
+    def _jit_entries(self, module: Module,
+                     index: FunctionIndex) -> Dict[ast.AST, str]:
+        """def node -> jit-site description, for every ``jax.jit(f)``/
+        ``jit(f)`` whose first arg resolves to a local function; the
+        jit site's own lexical scope resolves the name, so a nested
+        ``train_step`` shadows any same-named method."""
+        entries: Dict[ast.AST, str] = {}
+        for node, (mod, qual, _cls, def_scope) in index.owner.items():
+            if mod is not module:
+                continue
+            scope = def_scope + (qual.split(".")[-1],)
+            for call in self._own_calls(node):
+                self._maybe_jit(call, module, index, scope, entries)
+        # module/class level (not inside any function): same walker,
+        # rooted at the module
+        for call in self._own_calls(module.tree):
+            self._maybe_jit(call, module, index, (), entries)
+        return entries
+
+    @staticmethod
+    def _maybe_jit(node: ast.Call, module: Module, index: FunctionIndex,
+                   scope: Tuple[str, ...],
+                   entries: Dict[ast.AST, str]) -> None:
+        if not node.args:
+            return
+        fn = node.func
+        is_jit = (isinstance(fn, ast.Attribute) and fn.attr == "jit") \
+            or (isinstance(fn, ast.Name) and fn.id == "jit")
+        if not is_jit:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Name):
+            target = index.resolve_name(module, scope, first.id)
+            if target is not None:
+                entries.setdefault(target,
+                                   f"jax.jit at line {node.lineno}")
+
+    def _reachable(self, entries: Dict[ast.AST, str], module: Module,
+                   index: FunctionIndex) -> Dict[ast.AST, str]:
+        """Transitive closure over in-trace calls; node -> entry note."""
+        reach: Dict[ast.AST, str] = {}
+        work = [(n, note) for n, note in entries.items()]
+        while work:
+            node, note = work.pop()
+            if node in reach:
+                continue
+            reach[node] = note
+            _mod, qual, cls, def_scope = index.owner[node]
+            scope = def_scope + (qual.split(".")[-1],)
+            # nested defs run in-graph (scan bodies, closures)
+            for child in ast.walk(node):
+                if child is node:
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    work.append((child, f"{note} via nested "
+                                        f"{child.name}"))
+            for call in self._own_calls(node):
+                fn = call.func
+                if isinstance(fn, ast.Name):
+                    t = index.resolve_name(module, scope, fn.id)
+                    if t is not None:
+                        work.append((t, f"{note} via {fn.id}()"))
+                elif isinstance(fn, ast.Attribute):
+                    if isinstance(fn.value, ast.Name) \
+                            and fn.value.id == "self" and cls is not None:
+                        t = index.resolve_self_method(module, cls,
+                                                      fn.attr)
+                        if t is not None:
+                            work.append(
+                                (t, f"{note} via self.{fn.attr}()"))
+                    if fn.attr in LAX_COMBINATORS:
+                        for arg in call.args:
+                            if isinstance(arg, ast.Name):
+                                t = index.resolve_name(module, scope,
+                                                       arg.id)
+                                if t is not None:
+                                    work.append(
+                                        (t, f"{note} via jax.lax."
+                                            f"{fn.attr}"))
+        return reach
+
+    # ----------------------------------------------------------- flagging
+    def _run_module(self, module: Module,
+                    index: FunctionIndex) -> List[Finding]:
+        entries = self._jit_entries(module, index)
+        if not entries:
+            return []
+        reach = self._reachable(entries, module, index)
+        np_names, jax_names, time_names = _module_aliases(module)
+        findings: List[Finding] = []
+        for node, note in reach.items():
+            mod, qual, _cls, _scope = index.owner[node]
+            for call in self._own_calls(node):
+                hit = self._classify(call, np_names, jax_names,
+                                     time_names)
+                if hit is None:
+                    continue
+                code, what = hit
+                findings.append(self.finding(
+                    mod.relpath, call.lineno, code,
+                    f"{what} inside traced {qual} ({note})",
+                    detail=qual))
+        return findings
+
+    @staticmethod
+    def _own_calls(fn_node: ast.AST):
+        """Call nodes of this function EXCLUDING nested defs (those are
+        reachable in their own right — no double reporting)."""
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from visit(child)
+
+        yield from visit(fn_node)
+
+    @staticmethod
+    def _classify(call: ast.Call, np_names: Set[str],
+                  jax_names: Set[str],
+                  time_names: Set[str]) -> Optional[Tuple[str, str]]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in SIDE_EFFECT_NAMES:
+                return "side-effect-in-trace", f"{fn.id}()"
+            if fn.id in EMIT_NAMES:
+                return "emit-in-trace", f"{fn.id}()"
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        if fn.attr in SYNC_ATTRS:
+            return "host-sync-in-trace", f".{fn.attr}()"
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id in np_names and fn.attr in NUMPY_SYNCS:
+                return ("host-sync-in-trace",
+                        f"{base.id}.{fn.attr}() (host numpy)")
+            if base.id in jax_names and fn.attr == "device_get":
+                return "host-sync-in-trace", "jax.device_get()"
+            if base.id in time_names and fn.attr in CLOCK_ATTRS:
+                return ("host-clock-in-trace",
+                        f"{base.id}.{fn.attr}() (trace-time constant)")
+        if fn.attr in EMIT_NAMES:
+            return "emit-in-trace", f".{fn.attr}()"
+        return None

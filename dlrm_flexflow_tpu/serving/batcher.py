@@ -146,7 +146,14 @@ class DynamicBatcher:
         # caller blocks forever) and the dispatcher's sentinel re-put
         # in _collect() could block on a queue a late submit refilled
         self._intake_lock = threading.Lock()
-        self._close_lock = threading.Lock()  # one close() runs shutdown
+        # close() election: the lock guards ONLY the who-runs-shutdown
+        # flag (ffcheck lock-discipline — the shutdown itself emits
+        # telemetry, completes futures, and joins the dispatcher, none
+        # of which may run under a held lock); losers wait on the event
+        # and return the winner's summary
+        self._close_lock = threading.Lock()
+        self._close_started = False
+        self._close_done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # one request held over from a batch it would have overflowed
         # (a bounded Queue cannot push-front; re-put could deadlock the
@@ -389,13 +396,41 @@ class DynamicBatcher:
         Returns (and by default emits) the run's latency summary.
         Idempotent: a second close (e.g. explicit close inside a
         ``with`` block, or a concurrent one) returns the first summary
-        without re-running shutdown or re-emitting."""
-        with self._close_lock:
-            return self._close(drain, emit_summary)
+        without re-running shutdown or re-emitting.  Only the
+        flag election runs under ``_close_lock`` — the shutdown itself
+        (queue flush, future delivery, dispatcher join, summary emit)
+        runs lock-free, with concurrent closers parked on
+        ``_close_done`` until the winner finishes.  A winner whose
+        shutdown RAISES un-elects itself before re-raising, so parked
+        and later closers re-run shutdown instead of inheriting a
+        None summary forever."""
+        while True:
+            with self._close_lock:
+                if self._final_summary is not None:
+                    return self._final_summary
+                if not self._close_started:
+                    self._close_started = True
+                    self._close_done.clear()
+                    break  # this caller runs the shutdown
+            self._close_done.wait()
+            # loop: either the winner finished (summary set) or it
+            # failed and un-elected — re-check under the lock
+        try:
+            summary = self._close(drain, emit_summary)
+        except BaseException:
+            # un-elect AND wake parked closers in one locked step: a
+            # set() after the lock released could land after a new
+            # winner's clear(), leaving the event stuck set and the
+            # parked closers spinning through wait() for the whole
+            # retry shutdown
+            with self._close_lock:
+                self._close_started = False
+                self._close_done.set()
+            raise
+        self._close_done.set()
+        return summary
 
     def _close(self, drain: bool, emit_summary: bool) -> Dict[str, float]:
-        if self._final_summary is not None:
-            return self._final_summary
         with self._intake_lock:
             self._closed = True
         # from here no submit can enqueue (rejected under the lock), so

@@ -158,34 +158,41 @@ class InferenceEngine:
         fn = self._compiled.get(b)
         if fn is not None:
             return fn
+        aot_wall = None
         with self._lock:
             fn = self._compiled.get(b)
-            if fn is not None:
-                return fn
-            t0 = time.perf_counter()
-            if self._aot:
-                # donation-free explicit build: forward is jitted with no
-                # donate_argnums, so params/request buffers survive the
-                # call (a shed/retried request can be re-run)
-                fn = self.model._forward_fn.lower(
-                    self._params, self._abstract_inputs(b),
-                    self._bn).compile()
-                emit("compile", kind="aot", fn=f"serve[bucket={b}]",
-                     duration_s=time.perf_counter() - t0,
-                     donated_args=0, backend=jax.default_backend())
-            else:
-                # jit path (mesh): run one padded dummy batch through the
-                # jitted forward so the cache entry for this bucket's
-                # shape exists before traffic arrives (the jax.monitoring
-                # hook records the compile when telemetry is on)
-                dummy = {name: np.zeros((b,) + shape, dtype)
-                         for name, (shape, dtype)
-                         in self._in_specs.items()}
-                jax.block_until_ready(self._jit_call(
-                    self._params, dummy, self._bn))
-                fn = self._jit_call
-            self._compiled[b] = fn
-            return fn
+            if fn is None:
+                t0 = time.perf_counter()
+                if self._aot:
+                    # donation-free explicit build: forward is jitted
+                    # with no donate_argnums, so params/request buffers
+                    # survive the call (a shed/retried request can be
+                    # re-run)
+                    fn = self.model._forward_fn.lower(
+                        self._params, self._abstract_inputs(b),
+                        self._bn).compile()
+                    aot_wall = time.perf_counter() - t0
+                else:
+                    # jit path (mesh): run one padded dummy batch
+                    # through the jitted forward so the cache entry for
+                    # this bucket's shape exists before traffic arrives
+                    # (the jax.monitoring hook records the compile when
+                    # telemetry is on)
+                    dummy = {name: np.zeros((b,) + shape, dtype)
+                             for name, (shape, dtype)
+                             in self._in_specs.items()}
+                    jax.block_until_ready(self._jit_call(
+                        self._params, dummy, self._bn))
+                    fn = self._jit_call
+                self._compiled[b] = fn
+        if aot_wall is not None:
+            # the emit runs OUTSIDE the bucket-cache lock (ffcheck
+            # lock-discipline): a flushed sink write must not serialize
+            # a concurrent request's bucket lookup behind disk I/O
+            emit("compile", kind="aot", fn=f"serve[bucket={b}]",
+                 duration_s=aot_wall, donated_args=0,
+                 backend=jax.default_backend())
+        return fn
 
     def _jit_call(self, params, inputs, bn):
         # same signature as the AOT executables; routes through the ONE

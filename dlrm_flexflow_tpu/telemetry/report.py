@@ -25,7 +25,8 @@ telemetry/regress.py).
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Tuple
+import os
+from typing import Dict, List, Optional, Tuple
 
 from .schema import validate_event
 
@@ -375,6 +376,60 @@ def span_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+def find_analysis_artifact(near: str = ".") -> Optional[str]:
+    """The newest ``artifacts/analysis_*.json`` sink (ffcheck output,
+    ``python -m dlrm_flexflow_tpu.analysis -o ...``) near a run:
+    looked up under ``<near>/artifacts`` and ``./artifacts``; None when
+    no analyzer run left one."""
+    import glob
+
+    cands: List[str] = []
+    for base in dict.fromkeys((near or ".", ".")):
+        cands.extend(glob.glob(os.path.join(base, "artifacts",
+                                            "analysis_*.json")))
+    cands = [p for p in cands if os.path.isfile(p)]
+    if not cands:
+        return None
+    return max(cands, key=os.path.getmtime)
+
+
+def load_analysis(path: str) -> Optional[dict]:
+    """Parse one analyzer JSON sink; None when unreadable/not ffcheck
+    output (the report must render regardless)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and doc.get("tool") == "ffcheck" \
+        else None
+
+
+def analysis_summary(doc: dict, src: str) -> List[str]:
+    """The ``== analysis ==`` section: one ffcheck headline plus the
+    first few findings/stale waivers when the run was not clean."""
+    s = doc.get("summary", {})
+    lines = ["== analysis =="]
+    status = "OK" if s.get("ok") else "FAIL"
+    lines.append(f"ffcheck: {status} — {s.get('findings', 0)} "
+                 f"finding(s), {s.get('waived', 0)} waived, "
+                 f"{s.get('unused_waivers', 0)} stale waiver(s); "
+                 f"{len(doc.get('passes', []))} passes over "
+                 f"{doc.get('modules', '?')} modules ({src})")
+    shown = 0
+    for f in doc.get("findings", []):
+        if shown >= 8:
+            lines.append(f"  ... {len(doc['findings']) - shown} more")
+            break
+        lines.append(f"  {f.get('path')}:{f.get('line')}: "
+                     f"[{f.get('pass')}/{f.get('code')}] "
+                     f"{f.get('message')}")
+        shown += 1
+    for w in doc.get("unused_waivers", [])[:4]:
+        lines.append(f"  stale waiver: {w.get('key')}")
+    return lines
+
+
 #: section name -> text renderer; report_data mirrors these keys so the
 #: text and JSON forms can never disagree about which sections a run has
 SECTIONS = (
@@ -390,33 +445,56 @@ SECTIONS = (
 )
 
 
-def format_report(events: List[dict]) -> str:
-    if not events:
+def format_report(events: List[dict],
+                  analysis: Optional[Tuple[dict, str]] = None) -> str:
+    if not events and analysis is None:
         return "(no events)"
     by = _by_type(events)
-    t0 = min(e["ts"] for e in events)
-    t1 = max(e["ts"] for e in events)
-    lines = ["== run summary ==",
-             f"{len(events)} events over {t1 - t0:.1f}s: "
-             + ", ".join(f"{len(v)} {k}" for k, v in sorted(by.items()))]
+    if events:
+        t0 = min(e["ts"] for e in events)
+        t1 = max(e["ts"] for e in events)
+        lines = ["== run summary ==",
+                 f"{len(events)} events over {t1 - t0:.1f}s: "
+                 + ", ".join(f"{len(v)} {k}"
+                             for k, v in sorted(by.items()))]
+    else:
+        lines = ["== run summary ==", "(no events)"]
     for _name, section in SECTIONS:
         part = section(events)
         if part:
             lines.append("")
             lines.extend(part)
+    if analysis is not None:
+        lines.append("")
+        lines.extend(analysis_summary(*analysis))
     return "\n".join(lines)
 
 
-def report_data(events: List[dict]) -> Dict[str, object]:
+def _attach_analysis(out: Dict[str, object],
+                     analysis: Optional[Tuple[dict, str]]) -> None:
+    """THE analysis-key attach (both report_data exits use it, so the
+    shape cannot drift between the empty- and populated-run paths)."""
+    if analysis is not None:
+        doc, src = analysis
+        out["analysis"] = {**doc.get("summary", {}), "source": src,
+                           "lines": analysis_summary(doc, src)[1:]}
+
+
+def report_data(events: List[dict],
+                analysis: Optional[Tuple[dict, str]] = None
+                ) -> Dict[str, object]:
     """The ``--format json`` object: one ``run`` header plus, for every
     section the text report would print, that section's lines as
     structured data — section presence is IDENTICAL to the text report
-    (both iterate :data:`SECTIONS`), and each section carries its
-    headline numbers next to the rendered lines so dashboards and the
-    regress gate can consume values without re-parsing text."""
+    (both iterate :data:`SECTIONS`, and both gate the ``analysis``
+    section on the same discovered artifact), and each section carries
+    its headline numbers next to the rendered lines so dashboards and
+    the regress gate can consume values without re-parsing text."""
     out: Dict[str, object] = {}
     if not events:
-        return {"run": {"events": 0}}
+        out = {"run": {"events": 0}}
+        _attach_analysis(out, analysis)
+        return out
     by = _by_type(events)
     t0 = min(e["ts"] for e in events)
     t1 = max(e["ts"] for e in events)
@@ -476,6 +554,7 @@ def report_data(events: List[dict]) -> Dict[str, object]:
         lines = section(events)
         if lines:
             out[name] = {**headline.get(name, {}), "lines": lines[1:]}
+    _attach_analysis(out, analysis)
     return out
 
 
@@ -517,10 +596,19 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.cmd == "report":
         events = load_events(args.path, strict=args.strict)
+        # the == analysis == section rides along when an ffcheck sink
+        # (artifacts/analysis_*.json) sits next to the run or the CWD
+        analysis = None
+        apath = find_analysis_artifact(os.path.dirname(args.path) or ".")
+        if apath is not None:
+            doc = load_analysis(apath)
+            if doc is not None:
+                analysis = (doc, apath)
         if args.format == "json":
-            print(json.dumps(report_data(events), indent=1, default=str))
+            print(json.dumps(report_data(events, analysis=analysis),
+                             indent=1, default=str))
         else:
-            print(format_report(events))
+            print(format_report(events, analysis=analysis))
         return 0
     if args.cmd == "export-trace":
         from .exporter import export_trace

@@ -1,0 +1,170 @@
+"""ffcheck smoke matrix (tier-1: tests/test_analysis.py runs it).
+
+End-to-end scenarios for the static-analysis suite — the analysis
+analogue of ``check_serving.py``/``check_observability.py``
+(docs/analysis.md):
+
+  1. repo clean-or-waived — all passes over the real tree with the
+     committed ``ANALYSIS_WAIVERS.txt`` report zero unwaived findings
+     and zero stale waivers (the CI gate);
+  2. injected violation — an emit-under-lock snippet seeded into a
+     temp tree fires the lock-discipline pass naming ``path:line``;
+  3. stale waiver — a waiver matching nothing makes the run FAIL
+     (exemptions must not outlive their findings);
+  4. JSON round-trip — the ``--format json`` object reconstructs the
+     same findings (``Finding.from_dict``) with identical waiver keys,
+     and its summary agrees with the result.
+
+Exit 0 when every scenario passes; prints one line per scenario and
+exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dlrm_flexflow_tpu.analysis import (Finding, Waivers,  # noqa: E402
+                                        default_waivers, run_analysis)
+
+#: a lock-discipline violation, byte-for-byte what a careless producer
+#: would write: telemetry emitted while the instance lock is held
+BAD_SNIPPET = '''\
+import threading
+
+from ..telemetry import emit
+
+
+class Broken:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+            emit("step", wall_s=0.0, samples=1)
+'''
+
+
+_repo_result = None
+
+
+def _repo_run():
+    """One full-repo all-passes run shared by the scenarios that only
+    read it (tier-1 time budget)."""
+    global _repo_result
+    if _repo_result is None:
+        _repo_result = run_analysis(repo=REPO,
+                                    waivers=default_waivers(REPO))
+    return _repo_result
+
+
+def scenario_repo_clean() -> str:
+    res = _repo_run()
+    if res.findings:
+        return ("unwaived findings: "
+                + "; ".join(f.format() for f in res.findings[:3]))
+    if res.unused_waivers:
+        return f"stale waivers: {[k for k, _, _ in res.unused_waivers]}"
+    if not res.waived:
+        return ("zero waived findings — the committed waiver file "
+                "should be matching something (did keys drift?)")
+    return ""
+
+
+def _mini_tree(root: str, snippet: str) -> str:
+    """A minimal package tree under ``root`` holding one module with
+    ``snippet``; returns the module's repo-relative path."""
+    pkg = os.path.join(root, "dlrm_flexflow_tpu", "serving")
+    os.makedirs(pkg, exist_ok=True)
+    for d in (os.path.dirname(pkg), pkg):
+        with open(os.path.join(d, "__init__.py"), "w") as f:
+            f.write("")
+    mod = os.path.join(pkg, "injected.py")
+    with open(mod, "w") as f:
+        f.write(snippet)
+    return "dlrm_flexflow_tpu/serving/injected.py"
+
+
+def scenario_injected_violation() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, BAD_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"])
+        hits = [f for f in res.findings
+                if f.code == "emit-under-lock" and f.path == rel]
+        if not hits:
+            return ("seeded emit-under-lock did not fire "
+                    f"(got {[f.format() for f in res.findings]})")
+        if hits[0].line != 14:
+            return f"finding line {hits[0].line}, expected 14 (the emit)"
+        if res.ok:
+            return "result.ok despite an active finding"
+    return ""
+
+
+def scenario_stale_waiver() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        _mini_tree(root, "x = 1\n")
+        stale = Waivers(
+            [("lock-discipline:nowhere.py:gone:emit-under-lock",
+              "left over", 1)])
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"],
+                           waivers=stale)
+        if res.ok:
+            return "stale waiver did not fail the run"
+        if not res.unused_waivers:
+            return "stale waiver not reported as unused"
+    return ""
+
+
+def scenario_json_roundtrip() -> str:
+    res = _repo_run()
+    doc = res.to_dict()
+    back = [Finding.from_dict(d) for d in doc["findings"]]
+    if [f.waiver_key for f in back] != \
+            [f.waiver_key for f in res.findings]:
+        return "findings did not round-trip through to_dict/from_dict"
+    if doc["summary"]["ok"] != res.ok:
+        return "summary.ok disagrees with result.ok"
+    waived_back = [Finding.from_dict(d) for d in doc["waived"]]
+    if [f.waiver_key for f in waived_back] != \
+            [f.waiver_key for f, _ in res.waived]:
+        return "waived findings did not round-trip"
+    return ""
+
+
+SCENARIOS = [
+    ("repo clean or waived", scenario_repo_clean),
+    ("injected violation fires", scenario_injected_violation),
+    ("stale waiver fails", scenario_stale_waiver),
+    ("json round-trip", scenario_json_roundtrip),
+]
+
+
+def main() -> int:
+    failed = 0
+    for name, fn in SCENARIOS:
+        try:
+            err = fn()
+        except Exception as e:  # a scenario must fail loudly, not crash
+            err = f"raised {e!r}"
+        if err:
+            print(f"check_analysis: {name}: FAIL — {err}")
+            failed += 1
+        else:
+            print(f"check_analysis: {name}: OK")
+    if failed:
+        return 1
+    print(f"check_analysis: OK ({len(SCENARIOS)} analysis paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

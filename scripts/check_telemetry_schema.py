@@ -45,7 +45,9 @@ from dlrm_flexflow_tpu.telemetry.schema import (COMMON_REQUIRED,  # noqa: E402
 _EXAMPLE = {float: 0.5, int: 3, str: "x", bool: True,
             dict: {"k": 1.0}, list: [1, 2]}
 
-#: files whose ``emit(...)`` calls the producer scan covers
+#: files whose ``emit(...)`` calls the producer scan covers (loaded
+#: through the shared analysis-engine walker — ONE loader for every
+#: AST-based lint, see dlrm_flexflow_tpu/analysis/engine.py)
 _SCAN = ["bench.py", "dlrm_flexflow_tpu"]
 
 
@@ -129,26 +131,15 @@ def _emit_calls(tree: ast.AST):
 
 
 def check_producers() -> list:
+    from dlrm_flexflow_tpu.analysis.engine import load_modules
+
     errs = []
-    paths = []
-    for root in _SCAN:
-        full = os.path.join(REPO, root)
-        if os.path.isfile(full):
-            paths.append(full)
-        else:
-            for dirpath, _dirs, files in os.walk(full):
-                paths.extend(os.path.join(dirpath, f) for f in files
-                             if f.endswith(".py"))
-    for path in sorted(paths):
-        rel = os.path.relpath(path, REPO)
-        with open(path) as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src, filename=path)
-        except SyntaxError as e:
-            errs.append(f"{rel}: unparseable: {e}")
-            continue
-        for lineno, etype, kws, starstar in _emit_calls(tree):
+    parse_errors: list = []
+    modules = load_modules(roots=_SCAN, repo=REPO, errors=parse_errors)
+    errs.extend(f"{rel}: unparseable: {e}" for rel, e in parse_errors)
+    for mod in modules:
+        rel = mod.relpath
+        for lineno, etype, kws, starstar in _emit_calls(mod.tree):
             if etype not in SCHEMA:
                 errs.append(f"{rel}:{lineno}: emit of unknown event "
                             f"type {etype!r}")

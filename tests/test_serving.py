@@ -226,6 +226,28 @@ class TestBatcher:
             f.result(0)
         assert b.stats.rejected == 1
 
+    def test_close_retries_after_failed_shutdown(self, served):
+        # the close() winner-election must UN-ELECT on failure: a raise
+        # mid-shutdown (e.g. summary emission) leaves the batcher
+        # closeable, not wedged with every later close() returning None
+        _, _, _, engine = served
+        b = DynamicBatcher(engine, autostart=False)
+        real = b.stats.emit_summary
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("sink died")
+
+        b.stats.emit_summary = boom
+        with pytest.raises(RuntimeError, match="sink died"):
+            b.close()
+        b.stats.emit_summary = real
+        summary = b.close()  # re-elects and completes
+        assert calls["n"] == 1
+        assert isinstance(summary, dict) and "requests" in summary
+        assert b.close() is summary  # and stays idempotent after
+
     def test_deadline_timeout(self, served):
         cfg, _, _, engine = served
         rng = np.random.default_rng(0)
