@@ -48,7 +48,11 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
     fenced history entry matching ``key`` (entries predating the "app"
     field count as app=="dlrm"), append this run (plus ``extra``
     provenance fields like dtype, excluded from matching), and print the
-    one-line JSON protocol."""
+    one-line JSON protocol.  ``vs_baseline`` always reads >1 = BETTER:
+    for latency-style metrics (regress.lower_is_better, e.g.
+    dlrm_serving_p99_ms) the ratio is baseline/new, for throughput
+    new/baseline."""
+    from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_history.json")
     vs = 1.0
@@ -67,13 +71,25 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
                     hv = "float32"  # records written before emb_dtype
                 if k == "act_dtype" and hv is None:
                     hv = "float32"  # records written before act_dtype
+                if k == "quantize" and hv is None:
+                    hv = "off"  # records written before serve quantize
+                if k == "metric" and hv is None:
+                    # records written before the metric field carry the
+                    # app's ONE historical headline — THE mapping lives
+                    # in telemetry/regress.py, used here verbatim
+                    from dlrm_flexflow_tpu.telemetry.regress import (
+                        _history_metric_name)
+                    hv = _history_metric_name(h)
                 if hv != v:
                     return False
             return True
 
         for h in hist:
             if h.get("fenced") and h.get("value") and matches(h):
-                vs = thpt / float(h["value"])
+                if lower_is_better(metric):
+                    vs = float(h["value"]) / thpt if thpt else 1.0
+                else:
+                    vs = thpt / float(h["value"])
                 break
     except (OSError, ValueError, TypeError, AttributeError):
         hist = []
@@ -382,6 +398,12 @@ def main():
 
     cfg = DLRMConfig()  # run_random.sh architecture
     cfg.embedding_size = [rows] * 8
+    # BENCH_FUSED={off,auto,on}: build the gather->pool->interact chain
+    # as the ONE FusedEmbedInteract op (cost-model kernel dispatch
+    # inside; bit-exact vs the classic graph, so like compute dtype it
+    # is provenance, not part of the anchor key)
+    cfg.fused_interaction = (os.environ.get("BENCH_FUSED", "off")
+                             .strip().lower() or "off")
     # fp32 table storage is the default: like-for-like with the
     # reference's fp32 tables and with the fp32 anchor entry (emb_dtype
     # is part of the history key — advisor r1).  BENCH_EMB_DTYPE=bfloat16
@@ -424,7 +446,8 @@ def main():
     _emit("dlrm_synthetic_samples_per_sec", thpt,
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
            "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype},
-          extra={"dtype": dtype, "probe_us": round(probe_us, 1), **prov,
+          extra={"dtype": dtype, "fused": cfg.fused_interaction,
+                 "probe_us": round(probe_us, 1), **prov,
                  **_mfu_extras(model, batch, epochs * num_batches, prov)})
 
 
@@ -692,16 +715,24 @@ def bench_serving():
     req_rows = int(os.environ.get("BENCH_REQ_ROWS", 1))
     buckets = os.environ.get("BENCH_BUCKETS", "1,8,64,256")
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-
+    # BENCH_QUANTIZE={off,int8,bf16}: row-quantized serving tables
+    # (docs/serving.md).  Quantization changes numerics (tolerance-
+    # pinned), so like emb_dtype it is part of the anchor key — f32 and
+    # quantized runs never share an anchor.
+    quantize = (os.environ.get("BENCH_QUANTIZE", "off")
+                .strip().lower() or "off")
     cfg = DLRMConfig()  # run_random.sh architecture — same as main()
     cfg.embedding_size = [rows] * 8
+    cfg.fused_interaction = (os.environ.get("BENCH_FUSED", "off")
+                             .strip().lower() or "off")
     fc = ff.FFConfig(batch_size=parse_buckets(buckets)[-1],
                      compute_dtype=dtype, serve_buckets=buckets)
     model = build_dlrm(cfg, fc)
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                   loss_type="mean_squared_error", metrics=(),
                   mesh=False if jax.device_count() == 1 else None)
-    engine = InferenceEngine(model, model.init(seed=0))  # warmup: AOT all
+    engine = InferenceEngine(model, model.init(seed=0),
+                             quantize=quantize)  # warmup: AOT all
     rng = np.random.default_rng(0)
     # request pool in main()'s input convention: uniform tables, one
     # (rows, T, bag) id block — NOT the per-table ragged stacking the
@@ -718,13 +749,33 @@ def bench_serving():
     # SERVED requests only — shed (Rejected) submissions must not
     # inflate the headline or its history anchor
     qps = summary["requests"] / max(wall, 1e-9)
-    extra = {"dtype": dtype,
+    extra = {"dtype": dtype, "fused": cfg.fused_interaction,
              **{k: round(summary[k], 1) for k in
                 ("p50_us", "p95_us", "p99_us") if k in summary}}
     _emit("dlrm_serving_qps", qps,
-          {"app": "dlrm_serving", "rows": rows, "clients": clients,
-           "req_rows": req_rows, "buckets": buckets},
+          {"app": "dlrm_serving", "metric": "dlrm_serving_qps",
+           "rows": rows, "clients": clients, "req_rows": req_rows,
+           "buckets": buckets, "quantize": quantize},
           extra=extra, unit="requests/s")
+    # second serving headline: engine-forward p99 at the LARGEST bucket
+    # the run dispatched (per-bucket histograms, LatencyStats) — the
+    # tail-latency number the quantized tables exist to cut.  LOWER is
+    # better; the regress CLI knows (latency metrics invert the gate).
+    dispatched = engine.stats.bucket_histograms()  # locked snapshot
+    if dispatched:
+        top_bucket = max(dispatched)
+        p99_us = engine.stats.bucket_percentile(top_bucket, 99)
+        if p99_us is not None:
+            # "bucket" is PART of the anchor key: which bucket ends up
+            # largest is load/timing-dependent, and a bucket-8 p99 must
+            # never gate against a bucket-64 anchor
+            _emit("dlrm_serving_p99_ms", p99_us / 1e3,
+                  {"app": "dlrm_serving", "metric": "dlrm_serving_p99_ms",
+                   "rows": rows, "clients": clients, "req_rows": req_rows,
+                   "buckets": buckets, "quantize": quantize,
+                   "bucket": top_bucket},
+                  extra={"dtype": dtype, "fused": cfg.fused_interaction},
+                  unit="ms")
 
 
 if __name__ == "__main__":

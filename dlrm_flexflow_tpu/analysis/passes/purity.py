@@ -12,12 +12,20 @@ read bakes trace-time wall time into the graph as a constant.
 
 Entry points are discovered, not configured: every ``jax.jit(f, ...)``
 call whose first argument resolves lexically to a function definition
-seeds the walk.  Reachability follows bare-name calls (lexical
-resolution), ``self.method`` calls, function arguments to the
-``jax.lax`` control-flow combinators (scan/cond/while_loop/fori_loop/
-switch), and nested function definitions (scan bodies and closures run
-in-graph).  Attribute calls on unknown objects are NOT followed — this
-pass prefers silence to guessing (documented in docs/analysis.md).
+seeds the walk, and so does every ``pl.pallas_call(kernel, ...)`` —
+a pallas kernel body IS jit-traced code (Mosaic lowers it inside the
+surrounding program), so a host sync or emit inside one is exactly as
+wrong as in any jitted function.  The kernel argument resolves like
+the jit case (a bare name, lexically), plus the two idioms this
+codebase's kernels use: ``functools.partial(kernel, ...)`` inline as
+the first argument, and a local ``kern = functools.partial(kernel,
+...)`` binding whose name the call site passes.  Reachability follows
+bare-name calls (lexical resolution), ``self.method`` calls, function
+arguments to the ``jax.lax`` control-flow combinators (scan/cond/
+while_loop/fori_loop/switch), and nested function definitions (scan
+bodies and closures run in-graph).  Attribute calls on unknown objects
+are NOT followed — this pass prefers silence to guessing (documented
+in docs/analysis.md).
 
 Codes: ``host-sync-in-trace``, ``side-effect-in-trace``,
 ``emit-in-trace``, ``host-clock-in-trace``.
@@ -96,10 +104,14 @@ class TracePurityPass(AnalysisPass):
             scope = def_scope + (qual.split(".")[-1],)
             for call in self._own_calls(node):
                 self._maybe_jit(call, module, index, scope, entries)
+                self._maybe_pallas(call, module, index, scope, entries,
+                                   node)
         # module/class level (not inside any function): same walker,
         # rooted at the module
         for call in self._own_calls(module.tree):
             self._maybe_jit(call, module, index, (), entries)
+            self._maybe_pallas(call, module, index, (), entries,
+                               module.tree)
         return entries
 
     @staticmethod
@@ -119,6 +131,71 @@ class TracePurityPass(AnalysisPass):
             if target is not None:
                 entries.setdefault(target,
                                    f"jax.jit at line {node.lineno}")
+
+    @classmethod
+    def _maybe_pallas(cls, node: ast.Call, module: Module,
+                      index: FunctionIndex, scope: Tuple[str, ...],
+                      entries: Dict[ast.AST, str],
+                      encl: ast.AST) -> None:
+        """``pl.pallas_call(kernel, ...)`` / ``pallas_call(kernel)``:
+        the kernel body is jit-reachable.  ``encl`` is the enclosing
+        function (or module) node, scanned for the local
+        ``kern = functools.partial(kernel, ...)`` binding idiom."""
+        if not node.args:
+            return
+        fn = node.func
+        is_pc = (isinstance(fn, ast.Attribute)
+                 and fn.attr == "pallas_call") \
+            or (isinstance(fn, ast.Name) and fn.id == "pallas_call")
+        if not is_pc:
+            return
+        note = f"pl.pallas_call at line {node.lineno}"
+        first = node.args[0]
+        target = None
+        if isinstance(first, ast.Name):
+            target = index.resolve_name(module, scope, first.id)
+            if target is None:
+                target = cls._partial_binding(encl, module, index, scope,
+                                              first.id)
+        elif isinstance(first, ast.Call):
+            target = cls._partial_arg(first, module, index, scope)
+        if target is not None:
+            entries.setdefault(target, note)
+
+    @staticmethod
+    def _is_partial(call: ast.Call) -> bool:
+        f = call.func
+        return (isinstance(f, ast.Name) and f.id == "partial") or \
+            (isinstance(f, ast.Attribute) and f.attr == "partial")
+
+    @classmethod
+    def _partial_arg(cls, call: ast.Call, module: Module,
+                     index: FunctionIndex,
+                     scope: Tuple[str, ...]) -> Optional[ast.AST]:
+        """The wrapped function of a ``functools.partial(f, ...)``
+        call, resolved lexically; None for anything else."""
+        if cls._is_partial(call) and call.args \
+                and isinstance(call.args[0], ast.Name):
+            return index.resolve_name(module, scope, call.args[0].id)
+        return None
+
+    @classmethod
+    def _partial_binding(cls, encl: ast.AST, module: Module,
+                         index: FunctionIndex, scope: Tuple[str, ...],
+                         var: str) -> Optional[ast.AST]:
+        """Resolve ``var`` through a local ``var = functools.partial(f,
+        ...)`` assignment in the enclosing function — the standard
+        kernel-construction idiom (pallas_scatter/_embedding)."""
+        for child in ast.walk(encl):
+            if isinstance(child, ast.Assign) \
+                    and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name) \
+                    and child.targets[0].id == var \
+                    and isinstance(child.value, ast.Call):
+                t = cls._partial_arg(child.value, module, index, scope)
+                if t is not None:
+                    return t
+        return None
 
     def _reachable(self, entries: Dict[ast.AST, str], module: Module,
                    index: FunctionIndex) -> Dict[ast.AST, str]:

@@ -38,6 +38,13 @@ class DLRMConfig:
     mlp_bot: List[int] = field(default_factory=lambda: [64, 512, 512, 64])
     mlp_top: List[int] = field(default_factory=lambda: [576, 1024, 1024, 1024, 1])
     arch_interaction_op: str = "cat"       # --arch-interaction-op {cat,dot}
+    # --fused-interaction {off,auto,on}: build the gather->pool->interact
+    # chain as ONE FusedEmbedInteract op (ops/fused_interact.py) instead
+    # of stacked_embedding -> reshape -> concat/batch_matmul.  "auto"
+    # fuses on single-chip TPU (where the pallas kernel can engage);
+    # "on" forces the fused graph everywhere (the emitter path runs
+    # off-TPU, bit-exact); "off" (default) keeps the classic graph.
+    fused_interaction: str = "off"
     loss_threshold: float = 0.0            # --loss-threshold
     sigmoid_bot: int = -1                  # -1 = no sigmoid in bottom MLP
     sigmoid_top: int = -1                  # -1 = sigmoid on the last top layer
@@ -67,6 +74,8 @@ class DLRMConfig:
                 c.mlp_top = [int(x) for x in nxt().split("-")]
             elif a == "--arch-interaction-op":
                 c.arch_interaction_op = nxt()
+            elif a == "--fused-interaction":
+                c.fused_interaction = nxt()
             elif a == "--loss-threshold":
                 c.loss_threshold = float(nxt())
             elif a == "--dataset":
@@ -97,6 +106,15 @@ def criteo_kaggle_config() -> "DLRMConfig":
                       embedding_bag_size=1,
                       mlp_bot=[13, 512, 256, 64, 16],
                       mlp_top=[16 + 26 * 16, 512, 256, 1])
+
+
+def _on_single_tpu() -> bool:
+    """fused_interaction="auto" regime: one TPU chip (under a mesh the
+    pallas kernel cannot engage and the classic graph keeps its proven
+    sharding annotations)."""
+    import jax
+
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
 
 
 def _create_mlp(model: FFModel, x, layer_sizes, sigmoid_layer: int,
@@ -144,6 +162,12 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
     run_criteo_kaggle.sh).  Defaults to True.
     ``table_parallel``: mark embedding + interaction ops with model-axis
     strategies (the hybrid strategy of dlrm_strategy.cc:242-296).
+
+    ``cfg.fused_interaction`` (off/auto/on) swaps the embedding +
+    interaction chain for ONE FusedEmbedInteract op (same loader input
+    convention as the stacked graph).  "auto" engages on single-chip
+    TPU; table-parallel builds always keep the classic graph (the
+    model-axis sharding annotates the unfused stacked op).
     """
     ffconfig = ffconfig or FFConfig()
     model = FFModel(ffconfig)
@@ -156,6 +180,30 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
 
     dense_in = model.create_tensor((b, cfg.mlp_bot[0]), "float32", name="dense")
     bottom = _create_mlp(model, dense_in, cfg.mlp_bot, cfg.sigmoid_bot, "bot")
+
+    fmode = getattr(cfg, "fused_interaction", "off")
+    if fmode not in ("off", "auto", "on"):
+        raise ValueError(
+            f"fused_interaction must be 'off'|'auto'|'on', got {fmode!r}")
+    if fmode == "on" and not stacked_embeddings:
+        raise ValueError(
+            "fused_interaction='on' needs the stacked input convention "
+            "(one (B, T, bag) ids tensor); per-table inputs "
+            "(stacked_embeddings=False) cannot feed the fused op")
+    use_fused = stacked_embeddings and not table_parallel and (
+        fmode == "on" or (fmode == "auto" and _on_single_tpu()))
+    if use_fused:
+        ids = model.create_tensor((b, t, cfg.embedding_bag_size), "int64",
+                                  name="sparse")
+        z = model.fused_embed_interact(
+            ids, bottom, list(cfg.embedding_size), d,
+            interact=cfg.arch_interaction_op, aggr="sum", name="emb")
+        assert z.shape[1] == cfg.mlp_top[0], (
+            f"interaction width {z.shape[1]} != mlp_top[0] {cfg.mlp_top[0]}")
+        sig = cfg.sigmoid_top if cfg.sigmoid_top >= 0 else len(cfg.mlp_top) - 2
+        top = _create_mlp(model, z, cfg.mlp_top, sig, "top")
+        model._dlrm_stacked = True
+        return model
 
     emb_out = []
     if stacked_embeddings:

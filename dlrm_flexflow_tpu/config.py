@@ -179,6 +179,12 @@ class FFConfig:
     serve_max_wait_us: float = 2000.0
     serve_queue_depth: int = 256
     serve_timeout_us: float = 0.0
+    # Serving-table quantization (ops/quantized.py, docs/serving.md):
+    # "off" serves the f32 training tables bit-exactly; "int8" re-encodes
+    # each embedding table at engine load as int8 codes + per-row f32
+    # scale (~4x smaller sweep, tolerance-pinned outputs); "bf16" stores
+    # bf16 rows (~2x).  Training numerics are never touched.
+    serve_quantize: str = "off"
     # Live-metrics endpoint (telemetry/exporter.py, docs/telemetry.md):
     # port for the process-wide Prometheus /metrics + /healthz HTTP
     # server, started once at compile().  0 (default) = off — scrapes
@@ -246,6 +252,8 @@ class FFConfig:
                 cfg.serve_queue_depth = int(nxt())
             elif a == "--serve-timeout-us":
                 cfg.serve_timeout_us = float(nxt())
+            elif a == "--serve-quantize":
+                cfg.serve_quantize = nxt()
             elif a == "--metrics-port":
                 cfg.metrics_port = int(nxt())
             elif a in ("-d", "--devices", "-ll:gpu"):

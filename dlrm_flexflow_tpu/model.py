@@ -44,10 +44,10 @@ from .losses import get_loss
 from .metrics import MetricsAccumulator, compute_metrics
 from .optim import Optimizer, SGDOptimizer
 from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
-                  ElementBinary, ElementUnary, Embedding, Flat, Linear,
-                  MultiHeadAttention, Op, Pool2D, RaggedStackedEmbedding,
-                  Reshape, Reverse, Softmax, Split, StackedEmbedding,
-                  Transpose)
+                  ElementBinary, ElementUnary, Embedding, Flat,
+                  FusedEmbedInteract, Linear, MultiHeadAttention, Op,
+                  Pool2D, RaggedStackedEmbedding, Reshape, Reverse,
+                  Softmax, Split, StackedEmbedding, Transpose)
 from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
                             param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
@@ -175,6 +175,22 @@ class FFModel:
             self._name("ragged_stacked_embedding", name), input_tensor,
             row_counts, out_dim, aggr, kernel_initializer,
             table_dtype=self._table_dtype(table_dtype))
+        return self._add(op)
+
+    def fused_embed_interact(self, ids_tensor, bottom_tensor, row_counts,
+                             out_dim, interact="cat", aggr="sum",
+                             kernel_initializer=None, name=None,
+                             table_dtype=None):
+        """Embedding bags + DLRM feature interaction as ONE node over
+        the fused flat row space (ops/fused_interact.py): gather ->
+        pool -> cat/dot without materializing the per-table pooled
+        intermediate (the fused pallas kernel runs where the cost model
+        says it wins; the emitter path elsewhere, bit-exact)."""
+        op = FusedEmbedInteract(
+            self._name("fused_embed_interact", name), ids_tensor,
+            bottom_tensor, row_counts, out_dim, interact, aggr,
+            kernel_initializer, table_dtype=self._table_dtype(table_dtype),
+            compute_dtype=self._op_compute_dtype())
         return self._add(op)
 
     def conv2d(self, input_tensor, out_channels, kernel_h, kernel_w,

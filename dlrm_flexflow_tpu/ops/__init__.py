@@ -4,6 +4,7 @@ from .base import Op, activation_fn, matmul
 from .linear import Linear
 from .embedding import (Embedding, RaggedStackedEmbedding,
                         StackedEmbedding)
+from .fused_interact import FusedEmbedInteract
 from .elementwise import ElementBinary, ElementUnary
 from .shape_ops import (BatchMatmul, Concat, Flat, Reshape, Reverse, Split,
                         Transpose)
@@ -16,6 +17,7 @@ from .moe import MixtureOfExperts
 __all__ = [
     "Op", "activation_fn", "matmul",
     "Linear", "Embedding", "StackedEmbedding", "RaggedStackedEmbedding",
+    "FusedEmbedInteract",
     "ElementBinary", "ElementUnary",
     "BatchMatmul", "Concat", "Flat", "Reshape", "Reverse", "Split", "Transpose",
     "BatchNorm", "Conv2D", "Pool2D",

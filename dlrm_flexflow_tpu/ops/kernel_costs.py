@@ -1,0 +1,129 @@
+"""Unified kernel-dispatch cost model (PERF.md "Where the cycles go").
+
+Every hand-written pallas kernel in this tree competes with an XLA
+emitter path that computes the identical values, and each one needs a
+STATIC dispatch gate deciding which implementation a given shape should
+run.  Before this module the gate logic lived next to each kernel
+(``row_set_wins`` in pallas_scatter.py); with the fused
+embedding-bag→interaction kernel (pallas_fused_interact.py) joining the
+row-set and row-update kernels, the measured machine constants would
+have been copied a third time — so they live here once, and every gate
+reads them.
+
+The constants are MEASURED on the bench chip (TPU v5e behind the shared
+tunnel), not datasheet numbers; each records where it was measured so a
+re-measurement updates one line:
+
+* ``SET_KERNEL_NS_PER_ROW`` — per-row async-copy cost of the row-set
+  kernel's DMA epilogue (round 5, scripts/ab_prologue_layout.py): the
+  hybrid epilogue's 8.2k-row writeback measured ~64 ns/row, latency-
+  not bandwidth-bound.
+* ``EMITTER_SWEEP_GBPS`` — the XLA scatter emitter's full-parent RMW
+  sweep rate (round 5: a 2 GB parent swept in ~6.1 ms ≈ 650 GB/s of
+  read+write traffic).
+* ``GATHER_NS_PER_ROW`` — XLA's fused dynamic-gather pipeline
+  (pallas_embedding.py bring-up: 2048 rows in ~19 us ≈ 9 ns/row; the
+  gather pipeline batches row fetches where per-row DMAs serialize on
+  latency).
+* ``HBM_GBPS`` — streamed-intermediate bandwidth for materialized
+  tensors bounced through HBM between ops (v5e HBM, de-rated to the
+  sweep rate above — both directions of the bounce pay it).
+* ``OP_BOUNDARY_NS`` — per-XLA-op fixed cost at the fusion boundaries
+  the unfused path cannot cross (gather → pool → reshape/concat →
+  matmul each start a new fusion root; measured kernel-launch overhead
+  on this platform is ~2 us per root, sim/cost_model.py
+  ``kernel_launch_overhead``).
+
+Both gates apply ``DISPATCH_MARGIN`` the same way ``row_set_wins``
+always did: the kernel must win by 2x before the gate leaves the
+emitter, so a call near the crossover keeps the battle-tested default.
+"""
+
+from __future__ import annotations
+
+#: per-row DMA cost of a hand-written pallas row kernel (ns) — measured
+#: round 5 on the row-set epilogue; the fused kernel's per-row fetches
+#: are the same make_async_copy machinery.
+SET_KERNEL_NS_PER_ROW = 64.0
+
+#: XLA scatter emitter's full-parent RMW sweep rate (GB/s, round 5).
+EMITTER_SWEEP_GBPS = 650.0
+
+#: XLA fused dynamic-gather pipeline per-row cost (ns) — measured in
+#: the pallas_embedding.py bring-up (19 us / 2048 rows).
+GATHER_NS_PER_ROW = 9.0
+
+#: bandwidth charged to intermediates materialized between XLA ops
+#: (GB/s; write + read both pay it).
+HBM_GBPS = 650.0
+
+#: fixed cost per XLA fusion root the unfused gather→pool→interact
+#: chain pays and the fused kernel does not (ns).
+OP_BOUNDARY_NS = 2000.0
+
+#: a kernel must beat the emitter by this factor before dispatch flips.
+DISPATCH_MARGIN = 2.0
+
+
+def row_set_wins(parent_rows: int, dim: int, n: int,
+                 itemsize: int) -> bool:
+    """Static dispatch gate for the row-SET kernel vs the scatter
+    emitter (pallas_scatter._row_set_pallas), from the measured cost
+    model (round 5): the emitter's scatter-set costs ~max(parent RMW
+    sweep at ~650 GB/s, ~15 ns/row issue) while the kernel pays
+    ~64 ns/row.  The kernel therefore wins only in the sweep-bound
+    low-density regime; the 2x margin keeps the emitter wherever the
+    call is close.  Checked against three measured points: dlrm_hybrid
+    epilogue (8.2k rows / 2 GB parent: kernel, measured emitter 6.1 ms
+    vs model 6.3), kaggle (26.6k / 411 MB: emitter) and the headline
+    (1M / 2 GB: emitter).
+
+    ``n`` from the epilogue caller is the PADDED row count (sentinel
+    holes included — the live distinct count is data-dependent), so the
+    kernel's cost is an upper bound: near the threshold the slack tips
+    the dispatch toward the emitter, never the kernel (advisor r5; the
+    measured slack is re-documented in PERF.md "Dispatch gates")."""
+    kernel_ns = n * SET_KERNEL_NS_PER_ROW * DISPATCH_MARGIN
+    sweep_ns = parent_rows * dim * itemsize * 2.0 / EMITTER_SWEEP_GBPS
+    return kernel_ns < sweep_ns
+
+
+def fused_interact_wins(batch: int, num_tables: int, bag: int, dim: int,
+                        itemsize: int, interact: str = "cat") -> bool:
+    """Static dispatch gate for the fused embedding-bag→interaction
+    kernel (pallas_fused_interact.py) vs the emitter chain (gather →
+    pool → reshape/concat [→ batched matmul → flat → concat]).
+
+    Kernel cost: one per-row DMA per looked-up row (the row-set
+    kernel's measured ~64 ns/row — latency-bound, so it scales with
+    ``batch * num_tables * bag`` regardless of dim).
+
+    Emitter cost: the gather pipeline (~9 ns/row), PLUS the pooled
+    ``(batch, num_tables, dim)`` intermediate bounced through HBM
+    (write + read — the materialization the fused kernel exists to
+    delete; for ``dot`` the ``(batch, F, F)`` pairwise product and its
+    flat view bounce too), PLUS one fixed fusion-root cost per op
+    boundary XLA cannot fuse across (3 roots for cat: gather+pool,
+    reshape, concat; 5 for dot: + batched matmul, flat).
+
+    Regimes this selects (by construction, pinned in
+    tests/test_kernels.py): the smallest serving buckets (batch 1-4
+    for cat, through 8 for dot, at the run_random.sh table set) are
+    boundary-cost dominated — the kernel wins; the training headline
+    (batch 256, 8 tables, bag 1) is gather-pipeline dominated and the
+    per-row DMAs lose — the emitter keeps it, exactly as the
+    pallas_embedding bring-up measured for the bag alone (70 us kernel
+    vs 19 us XLA).  The 2x ``DISPATCH_MARGIN`` keeps crossover shapes
+    on the emitter."""
+    rows = batch * num_tables * bag
+    kernel_ns = rows * SET_KERNEL_NS_PER_ROW * DISPATCH_MARGIN
+    inter_bytes = 2.0 * batch * num_tables * dim * itemsize
+    boundaries = 3
+    if interact == "dot":
+        f = num_tables + 1
+        inter_bytes += 2.0 * batch * f * f * itemsize
+        boundaries = 5
+    emitter_ns = (rows * GATHER_NS_PER_ROW
+                  + inter_bytes / HBM_GBPS
+                  + boundaries * OP_BOUNDARY_NS)
+    return kernel_ns < emitter_ns

@@ -1,0 +1,318 @@
+"""Pallas TPU kernel: fused embedding-bag -> feature-interaction.
+
+The DLRM hot path is gather -> pool -> interact (reference
+dlrm.cc:122-138; apps/dlrm.py::_interact_features): per-table embedding
+rows are gathered and bag-pooled, then the pooled per-table vectors
+meet the bottom-MLP output in the interaction — ``cat`` (concat) or
+``dot`` (pairwise dots).  Unfused, XLA runs this as separate ops with a
+materialized ``(batch, num_tables, dim)`` intermediate bounced through
+HBM (plus the ``(batch, F, F)`` pairwise product for ``dot``), because
+the gather is a fusion root it cannot fuse across.
+
+This kernel streams the embedding rows from HBM straight through a
+VMEM scratch (per-row async DMAs, start-all-then-wait like
+``pallas_embedding._bag_kernel``), pools each bag on the VPU, and
+feeds the pooled vectors DIRECTLY into the interaction — the pooled
+intermediate never exists in HBM.  For ``dot`` the pairwise products
+run as one small batched ``jnp.matmul`` per block (the MXU primitive
+the unfused BatchMatmul op uses, so the two paths stay bit-exact).
+
+Dropped-id semantics (parity with the row-set kernel, PR 1 advisor
+r5): an id that is negative or out of its table's range is DROPPED —
+its slot contributes exact 0.0 to the pool, and no HBM DMA is ever
+issued for it.  ``mask_local_ids`` encodes the rule once (invalid ->
+-1) so the kernel and the emitter reference path below cannot
+disagree; ``tests/test_kernels.py`` pins both.
+
+Dispatch is cost-model gated (``ops/kernel_costs.fused_interact_wins``
+— the same measured constants as the row-set gate): per-row DMAs are
+latency-bound, so the kernel wins only where the unfused chain's
+fusion-boundary overheads and intermediate bounce dominate (the small
+serving buckets); the training headline keeps XLA's batched gather
+pipeline, exactly as the pallas_embedding bring-up measured for the
+bag alone.  Off-TPU the reference path runs; tests exercise the kernel
+in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_B = 8  # samples per grid step (min f32 sublane tile)
+
+
+def mask_local_ids(idx, offsets, row_counts):
+    """Per-table LOCAL ids ``(..., T, bag)`` -> flat global row ids
+    with every invalid entry (negative, or >= its table's row count)
+    mapped to -1.  THE dropped-id rule shared by the kernel (-1 slots
+    fetch nothing and pool as 0.0) and the reference path (masked
+    gather) — one encoding, so the two cannot drift."""
+    rc = jnp.asarray(row_counts, dtype=idx.dtype)[:, None]
+    off = jnp.asarray(offsets, dtype=idx.dtype)[:, None]
+    valid = (idx >= 0) & (idx < rc)
+    return jnp.where(valid, idx + off, jnp.array(-1, idx.dtype))
+
+
+def interact_width(interact: str, num_tables: int, dim: int,
+                   bot_dim: int) -> int:
+    """Output feature width of the fused op."""
+    if interact == "cat":
+        return bot_dim + num_tables * dim
+    if interact == "dot":
+        f = num_tables + 1
+        return dim + f * f
+    raise ValueError(f"unknown interaction op {interact!r}")
+
+
+def pool_rows(rows, aggr: str, out_dtype):
+    """Bag-pool pre-gathered rows ``(B, T, bag, d)`` -> ``(B, T, d)``
+    with the SAME reduce formulation on every path (bit-exactness
+    demands one summation): ``jnp.sum`` over the bag axis, ``avg``
+    divides by the static bag.  An EMPTY bag (bag == 0) pools to exact
+    0.0 for both modes (the mean of nothing must not be NaN)."""
+    b, t, bag, d = rows.shape
+    if bag == 0:
+        return jnp.zeros((b, t, d), out_dtype)
+    pooled = jnp.sum(rows, axis=2)
+    if aggr == "avg":
+        pooled = pooled / bag
+    return pooled.astype(out_dtype)
+
+
+def _pairwise_dots(z, compute_dtype):
+    """``z @ z^T`` exactly as BatchMatmul.forward computes it — incl.
+    the bf16 operand cast under ``compute_dtype='bfloat16'`` with f32
+    accumulation — so fused 'dot' stays bit-exact vs the classic graph
+    at EITHER compute precision."""
+    zt = jnp.swapaxes(z, -1, -2)
+    if compute_dtype in ("bfloat16", jnp.bfloat16):
+        z = z.astype(jnp.bfloat16)
+        zt = zt.astype(jnp.bfloat16)
+    return jnp.matmul(z, zt, preferred_element_type=jnp.float32)
+
+
+def interact_features(bottom, pooled, interact: str, compute_dtype=None):
+    """The interaction on pooled per-table vectors — the exact jnp
+    formulation the UNFUSED graph ops compute (apps/dlrm.py
+    ``_interact_features``: Concat / Reshape + BatchMatmul + Flat +
+    Concat), so A/B against the emitter path is bit-exact.
+
+    bottom ``(B, bot_dim)``, pooled ``(B, T, d)``; ``compute_dtype``
+    is the model's MXU precision (BatchMatmul's cast, dot only)."""
+    b, t, d = pooled.shape
+    if interact == "cat":
+        return jnp.concatenate([bottom, pooled.reshape(b, t * d)], axis=1)
+    if interact == "dot":
+        # z = [bottom; pooled] (B, F, d); zz = z @ z^T via the same
+        # primitive BatchMatmul.forward lowers to; flat(zz) row-major —
+        # Flat.forward's reshape
+        z = jnp.concatenate([bottom[:, None, :], pooled], axis=1)
+        zz = _pairwise_dots(z, compute_dtype).astype(bottom.dtype)
+        return jnp.concatenate([bottom, zz.reshape(b, (t + 1) * (t + 1))],
+                               axis=1)
+    raise ValueError(f"unknown interaction op {interact!r}")
+
+
+def masked_pool_interact(rows, gids, bottom, interact: str, aggr: str,
+                         out_dtype=jnp.float32, compute_dtype=None):
+    """THE shared tail of every emitter-side path: zero the dropped
+    slots (``gids`` < 0, see ``mask_local_ids``), pool, interact.
+    ``fused_interact_ref`` and the op's packed/quantized forward both
+    call this, so the kernel's A/B target and the op's emitter branch
+    can never drift apart."""
+    rows = jnp.where((gids >= 0)[..., None], rows,
+                     jnp.zeros((), rows.dtype))
+    pooled = pool_rows(rows, aggr, out_dtype)
+    return interact_features(bottom.astype(out_dtype), pooled, interact,
+                             compute_dtype)
+
+
+def fused_interact_ref(table, gids, bottom, *, interact: str = "cat",
+                       aggr: str = "sum", out_dtype=jnp.float32,
+                       compute_dtype=None):
+    """The emitter REFERENCE path: masked gather -> pool -> interact,
+    all plain XLA ops.  ``gids`` are pre-masked flat ids (invalid =
+    -1, see ``mask_local_ids``); a dropped id contributes exact 0.0 —
+    the kernel's semantics, asserted bit-equal in interpret mode."""
+    safe = jnp.maximum(gids, 0).astype(jnp.int32)
+    rows = jnp.take(table, safe, axis=0)              # (B, T, bag, d)
+    return masked_pool_interact(rows, gids, bottom, interact, aggr,
+                                out_dtype, compute_dtype)
+
+
+def _fused_kernel(ids_ref, table_hbm, bottom_ref, out_ref, scratch, sems,
+                  *, num_tables: int, bag: int, dim: int, bot_dim: int,
+                  interact: str, aggr: str, block_b: int, num_rows: int,
+                  compute_dtype=None):
+    """One grid step = ``block_b`` samples: start every live row DMA
+    (all in flight together), zero the dropped slots, wait, pool each
+    bag on the VPU, interact, write the block."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    nslots = num_tables * bag
+
+    def row_id(i, s):
+        return ids_ref[blk * block_b + i, s]
+
+    def dma(i, s):
+        slot = i * nslots + s
+        return pltpu.make_async_copy(
+            table_hbm.at[pl.ds(row_id(i, s), 1)],
+            scratch.at[pl.ds(slot, 1)], sems.at[slot])
+
+    def live(i, s):
+        # ids are pre-masked to -1 by mask_local_ids; the upper bound
+        # is the same defensive guard the row-set kernel carries (a
+        # corrupt id must never issue an out-of-bounds HBM DMA)
+        return (row_id(i, s) >= 0) & (row_id(i, s) < num_rows)
+
+    for i in range(block_b):
+        for s in range(nslots):
+            @pl.when(live(i, s))
+            def _():
+                dma(i, s).start()
+
+            @pl.when(jnp.logical_not(live(i, s)))
+            def _():
+                # dropped id: the slot pools as exact 0.0
+                scratch[pl.ds(i * nslots + s, 1), :] = jnp.zeros(
+                    (1, dim), scratch.dtype)
+    for i in range(block_b):
+        for s in range(nslots):
+            @pl.when(live(i, s))
+            def _():
+                dma(i, s).wait()
+
+    # pool each sample's bags with the SAME reduce the reference path
+    # uses (jnp.sum over the bag axis), then interact in-register
+    pooled = []
+    for i in range(block_b):
+        bags = scratch[pl.ds(i * nslots, nslots), :]
+        bags = bags.reshape(num_tables, bag, dim)
+        pt = jnp.sum(bags, axis=1)
+        if aggr == "avg":
+            pt = pt / bag
+        pooled.append(pt.astype(out_ref.dtype))
+    pooled_blk = jnp.stack(pooled)                    # (block_b, T, d)
+    bottom_blk = bottom_ref[:, :].astype(out_ref.dtype)
+
+    if interact == "cat":
+        out_ref[:, pl.ds(0, bot_dim)] = bottom_blk
+        out_ref[:, pl.ds(bot_dim, num_tables * dim)] = pooled_blk.reshape(
+            block_b, num_tables * dim)
+    else:  # dot — the same batched-matmul primitive (and bf16 operand
+        # cast under compute_dtype) as BatchMatmul
+        f = num_tables + 1
+        z = jnp.concatenate([bottom_blk[:, None, :], pooled_blk], axis=1)
+        zz = _pairwise_dots(z, compute_dtype)
+        out_ref[:, pl.ds(0, dim)] = bottom_blk
+        out_ref[:, pl.ds(dim, f * f)] = zz.astype(out_ref.dtype).reshape(
+            block_b, f * f)
+
+
+def fused_interact_pallas(table, gids, bottom, *, interact: str = "cat",
+                          aggr: str = "sum", interpret: bool = False,
+                          compute_dtype=None):
+    """Run the fused kernel.  ``table`` (R, d) f32; ``gids`` (B, T,
+    bag) pre-masked flat ids (invalid = -1); ``bottom`` (B, bot_dim).
+    Any batch size: B pads up to the 8-sample block with dropped-id
+    rows and the padding is sliced back off."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bsz, t, bag = gids.shape
+    rows_n, dim = table.shape
+    bot_dim = bottom.shape[1]
+    assert bag > 0, "empty bags run the reference path (nothing to DMA)"
+    if interact == "dot":
+        assert bot_dim == dim, (
+            f"dot interaction needs bottom width {dim}, got {bot_dim}")
+    width = interact_width(interact, t, dim, bot_dim)
+    block_b = _BLOCK_B
+    pad = (-bsz) % block_b
+    if pad:
+        gids = jnp.concatenate(
+            [gids, jnp.full((pad, t, bag), -1, gids.dtype)])
+        bottom = jnp.concatenate(
+            [bottom, jnp.zeros((pad, bot_dim), bottom.dtype)])
+    bp = bsz + pad
+    ids2 = gids.reshape(bp, t * bag).astype(jnp.int32)
+    kern = functools.partial(
+        _fused_kernel, num_tables=t, bag=bag, dim=dim, bot_dim=bot_dim,
+        interact=interact, aggr=aggr, block_b=block_b, num_rows=rows_n,
+        compute_dtype=compute_dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # ids
+        grid=(bp // block_b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # table stays in HBM
+            pl.BlockSpec((block_b, bot_dim), lambda b, ids: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, width), lambda b, ids: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_b * t * bag, dim), table.dtype),
+            pltpu.SemaphoreType.DMA((block_b * t * bag,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bp, width), jnp.float32),
+        interpret=interpret,
+    )(ids2, table, bottom)
+    return out[:bsz]
+
+
+def kernel_eligible(table_dtype, dim: int, bag: int) -> bool:
+    """Static shape/dtype eligibility of the fused kernel: f32 tables
+    (bf16/quantized serving tables take the reference path — their
+    numerics are tolerance-pinned, not bit-exact), a non-empty bag,
+    and a lane-friendly dim (the (1, d) row DMAs need the 8-multiple
+    sublane tiling the row-update kernel established)."""
+    return (jnp.dtype(table_dtype) == jnp.float32 and bag > 0
+            and dim % 8 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def fused_embed_interact(table, gids, bottom, interact: str = "cat",
+                         aggr: str = "sum", use_kernel: bool = False,
+                         interpret: bool = False, compute_dtype=None):
+    """Differentiable fused gather->pool->interact with the kernel/
+    emitter dispatch already decided by the caller (the op consults
+    ``kernel_costs.fused_interact_wins``).  Backward re-derives through
+    the reference formulation — identical to autodiff of the unfused
+    graph (the training fast path instead injects pre-gathered rows
+    and never reaches this custom_vjp)."""
+    if use_kernel:
+        return fused_interact_pallas(table, gids, bottom,
+                                     interact=interact, aggr=aggr,
+                                     interpret=interpret,
+                                     compute_dtype=compute_dtype)
+    return fused_interact_ref(table, gids, bottom, interact=interact,
+                              aggr=aggr, compute_dtype=compute_dtype)
+
+
+def _fwd(table, gids, bottom, interact, aggr, use_kernel, interpret,
+         compute_dtype):
+    out = fused_embed_interact(table, gids, bottom, interact, aggr,
+                               use_kernel, interpret, compute_dtype)
+    return out, (table, gids, bottom)
+
+
+def _bwd(interact, aggr, use_kernel, interpret, compute_dtype, res, g):
+    table, gids, bottom = res
+    _, vjp = jax.vjp(
+        lambda t, b: fused_interact_ref(t, gids, b, interact=interact,
+                                        aggr=aggr,
+                                        compute_dtype=compute_dtype),
+        table, bottom)
+    dt, db = vjp(g)
+    return dt, None, db
+
+
+fused_embed_interact.defvjp(_fwd, _bwd)

@@ -31,6 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# the static dispatch gate for the set kernel vs the scatter emitter
+# lives in the UNIFIED cost module since the fused-interaction kernel
+# joined the row-set/row-update family: one set of measured machine
+# constants, three gates (ops/kernel_costs.py).  Re-exported here so
+# the round-5 call sites and tests keep their import path.
+from .kernel_costs import row_set_wins  # noqa: F401  (re-export)
+
 _BLOCK = int(__import__("os").environ.get("FF_SCATTER_BLOCK", 16))
 # ^ update slots per grid step (unrolled in-kernel); env-overridable for
 #   block-size sweeps on real hardware (scripts/ab_scatter.py)
@@ -531,25 +538,6 @@ def _row_set_pallas(table, ids, rows, interpret=False):
     )(ids.astype(jnp.int32), table, rows.astype(table.dtype))
 
 
-def row_set_wins(parent_rows: int, dim: int, n: int,
-                 itemsize: int) -> bool:
-    """Static dispatch gate for the set kernel vs the scatter emitter,
-    from the measured cost model (round 5): the emitter's scatter-set
-    costs ~max(parent RMW sweep at ~650 GB/s, ~15 ns/row issue) while
-    the kernel pays ~64 ns/row.  The kernel therefore wins only in the
-    sweep-bound low-density regime; a 2x margin keeps the emitter
-    wherever the call is close.  Checked against three measured points:
-    dlrm_hybrid epilogue (8.2k rows / 2 GB parent: kernel, measured
-    emitter 6.1 ms vs model 6.3), kaggle (26.6k / 411 MB: emitter) and
-    the headline (1M / 2 GB: emitter).
-
-    ``n`` from the epilogue caller is the PADDED rowof length (sentinel
-    holes included — the live distinct count is data-dependent), so the
-    kernel's cost is an upper bound: near the threshold the slack tips
-    the dispatch toward the emitter, never the kernel (advisor r5)."""
-    kernel_ns = n * 64.0 * 2.0
-    sweep_ns = parent_rows * dim * itemsize * 2.0 / 650.0
-    return kernel_ns < sweep_ns
 
 
 def supports_pallas_row_update(num_rows: int, dim: int, n: int) -> bool:

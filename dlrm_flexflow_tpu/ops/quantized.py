@@ -1,0 +1,116 @@
+"""Row-quantized embedding tables for SERVING (docs/serving.md).
+
+Training keeps f32 master tables; at inference-engine load the tables
+can be re-encoded to cut the HBM footprint and the full-table sweep
+that dominates big-table forwards:
+
+* ``int8`` — symmetric per-ROW quantization: each logical row stores
+  int8 codes plus one f32 scale (``scale = max|row| / 127``); the
+  forward dequantizes only the gathered rows (``codes * scale``), so
+  the 4x-smaller table is swept, never a dequantized copy.  ~4x table
+  memory saving (the (R, 1) scale column is ~``1/d`` overhead).
+* ``bf16`` — plain bfloat16 storage (the same halved-sweep trick
+  PERF.md round 3 measured for training tables), no scale column.
+
+Quantized outputs are TOLERANCE-pinned, not bit-exact (the pinned
+bounds live in ``scripts/check_kernels.py`` / ``tests/test_kernels.py``
+and docs/serving.md); training numerics are untouched — quantization
+happens on a COPY of the params at ``InferenceEngine`` load
+(``serving/engine.py``), gated by ``FFConfig.serve_quantize``.
+
+This module lives in ops/ (not serving/) because the dequant runs
+inside the ops' jitted forwards — serving imports downward from here
+(analysis/passes/layering.py's sanctioned direction).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+QUANT_MODES = ("off", "int8", "bf16")
+
+#: params key carrying the per-row f32 scale column next to the int8
+#: "embedding" codes.  The trailing "__" marks it as an injected
+#: sidecar (like the sparse path's "rows__"), never a declared
+#: ParameterSpec — checkpoints and training states never contain it.
+QSCALE_KEY = "qscale__"
+
+
+def quantize_table(table: np.ndarray, mode: str, logical_dim: int
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Quantize one embedding table array -> (stored, scale-or-None).
+
+    ``table`` may be the logical ``(R, d)`` form, the stacked
+    ``(T, R, d)`` form, or the lane-packed ``(Rv, pack*d)`` STORAGE
+    view — all are row-major layouts of logical ``d``-wide rows, so
+    the per-row math runs on the free ``(-1, d)`` reshape and the
+    result is stored back in the original shape.  The returned scale
+    is ``(R_logical, 1)`` f32, indexed by the same flat logical row
+    ids every gather path uses (``flat_ids``)."""
+    if mode == "bf16":
+        return np.asarray(table).astype(jnp.bfloat16), None
+    if mode != "int8":
+        raise ValueError(f"unknown quantize mode {mode!r} "
+                         f"(have {QUANT_MODES})")
+    arr = np.asarray(table, dtype=np.float32)
+    logical = arr.reshape(-1, logical_dim)
+    amax = np.abs(logical).max(axis=1, keepdims=True)
+    scale = np.where(amax > 0.0, amax / 127.0, 1.0).astype(np.float32)
+    codes = np.rint(logical / scale).astype(np.int8)
+    return codes.reshape(arr.shape), scale
+
+
+def dequant_rows(rows, qscale, gids):
+    """Dequantize gathered int8 rows inside a jitted forward:
+    ``rows`` (..., d) int8 codes gathered at flat logical ids ``gids``
+    (...,); ``qscale`` (R, 1) f32.  Returns f32 rows."""
+    scale = jnp.take(qscale, gids, axis=0)      # (..., 1)
+    return rows.astype(jnp.float32) * scale
+
+
+def quantize_embedding_params(layers, params: Dict[str, dict],
+                              mode: str) -> Tuple[Dict[str, dict], dict]:
+    """Quantize every eligible embedding table in a (copied) params
+    tree.  ``layers`` is the model's op list; an op is eligible when it
+    carries an ``"embedding"`` param, is device-resident, and is not a
+    manual-exchange op (its shard_map body reads raw f32 tables).
+
+    Returns ``(new_params, report)`` where ``report`` records the mode
+    and per-table byte savings (printed by the engine at load)."""
+    if mode in (None, "", "off"):
+        return params, {"mode": "off", "tables": {},
+                        "bytes_before": 0, "bytes_after": 0}
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quantize mode {mode!r} "
+                         f"(have {QUANT_MODES})")
+    out = dict(params)
+    tables = {}
+    before = after = 0
+    for op in layers:
+        p = params.get(op.name)
+        if (not isinstance(p, dict) or "embedding" not in p
+                or getattr(op, "placement", "tpu") == "cpu"
+                or getattr(op, "exchange_mode", None)):
+            continue
+        d = int(getattr(op, "out_dim", 0))
+        if d <= 0:
+            continue
+        table = np.asarray(p["embedding"])
+        stored, scale = quantize_table(table, mode, d)
+        q = dict(p)
+        q["embedding"] = jnp.asarray(stored)
+        nb_before = table.size * table.dtype.itemsize
+        nb_after = stored.size * np.dtype(stored.dtype).itemsize
+        if scale is not None:
+            q[QSCALE_KEY] = jnp.asarray(scale)
+            nb_after += scale.size * 4
+        out[op.name] = q
+        tables[op.name] = {"bytes_before": int(nb_before),
+                           "bytes_after": int(nb_after)}
+        before += nb_before
+        after += nb_after
+    return out, {"mode": mode, "tables": tables,
+                 "bytes_before": int(before), "bytes_after": int(after)}

@@ -69,12 +69,22 @@ class InferenceEngine:
     (default off-mesh) builds each bucket's executable explicitly at
     :meth:`warmup`; under a mesh the engine uses the jitted forward
     (shapes still bucket-stable, so the cache is hit after warmup).
+
+    ``quantize`` ("off" | "int8" | "bf16", default
+    ``model.config.serve_quantize``) re-encodes the embedding tables at
+    load (ops/quantized.py): int8 codes + per-row f32 scale (~4x
+    smaller table sweep) or bf16 rows (~2x).  Quantized outputs are
+    TOLERANCE-pinned against the f32 tables (docs/serving.md), not
+    bit-exact; padding bit-identity within one quantized engine still
+    holds (the forward stays row-independent).  Training state is
+    never mutated — quantization copies the params tree.
     """
 
     def __init__(self, model, params_or_state=None,
                  buckets: Optional[Union[str, Sequence[int]]] = None,
                  aot: Optional[bool] = None, warmup: bool = True,
-                 stats: Optional[LatencyStats] = None):
+                 stats: Optional[LatencyStats] = None,
+                 quantize: Optional[str] = None):
         if getattr(model, "_forward_fn", None) is None:
             raise ValueError(
                 "model must be compile()d before building an "
@@ -93,6 +103,18 @@ class InferenceEngine:
                 "model has BatchNorm state but none was provided — pass "
                 "a TrainState (bare params would serve on BATCH "
                 "statistics, breaking the bit-exact padding contract)")
+        if quantize is None:
+            quantize = getattr(model.config, "serve_quantize", "off")
+        quantize = (quantize or "off").strip().lower() or "off"
+        self.quantization = {"mode": "off"}
+        if quantize != "off":
+            # re-encode the embedding tables on a COPY of the params
+            # tree (training state untouched); the bucket programs then
+            # trace against the quantized dtypes at warmup below
+            from ..ops.quantized import quantize_embedding_params
+
+            self._params, self.quantization = quantize_embedding_params(
+                model.layers, self._params, quantize)
         if buckets is None:
             buckets = getattr(model.config, "serve_buckets", None)
         self.buckets = parse_buckets(buckets)
@@ -275,7 +297,11 @@ class InferenceEngine:
             # host materialization IS the fence: results leave as numpy
             out = jax.tree.map(lambda a: np.asarray(a)[:m], out)
         compute_us = (time.perf_counter() - t0) * 1e6
-        self.stats.record_dispatch(bucket=b)
+        # per-bucket latency rides the SAME lock acquisition as the
+        # dispatch count (LatencyStats.record_dispatch) — the /metrics
+        # family dlrm_serve_bucket_latency_us and the serving-p99 bench
+        # headline read it, no extra lock on this path
+        self.stats.record_dispatch(bucket=b, lat_us=compute_us)
         emit("serve", phase="dispatch", batch=m, bucket=b, padded=b - m,
              fill=m / b, queue_wait_us=float(queue_wait_us),
              compute_us=compute_us)

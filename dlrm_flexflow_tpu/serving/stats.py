@@ -58,6 +58,12 @@ class LatencyStats:
         self._hist = [0] * (len(LATENCY_BUCKETS_US) + 1)
         self._lat_sum = 0.0
         self.dispatch_buckets: Dict[int, int] = {}
+        # per-BUCKET engine-forward latency histograms (the labeled
+        # dlrm_serve_bucket_latency_us family + the serving-p99 bench
+        # headline): same fixed edges, one slot list per bucket size,
+        # fed by record_dispatch under the lock it already takes
+        self._bucket_hist: Dict[int, List[int]] = {}
+        self._bucket_lat_sum: Dict[int, float] = {}
         self._t0 = time.perf_counter()
 
     # ------------------------------------------------------------ recording
@@ -86,13 +92,27 @@ class LatencyStats:
         with self._lock:
             self.deadline_misses += 1
 
-    def record_dispatch(self, bucket: Optional[int] = None) -> None:
+    def record_dispatch(self, bucket: Optional[int] = None,
+                        lat_us: Optional[float] = None) -> None:
+        """One engine dispatch; ``lat_us`` (the engine-forward wall for
+        the padded bucket run) additionally lands in that bucket's
+        fixed-edge latency histogram — one bisect + one increment under
+        the lock this call already holds."""
         with self._lock:
             self.dispatches += 1
             if bucket is not None:
                 b = int(bucket)
                 self.dispatch_buckets[b] = \
                     self.dispatch_buckets.get(b, 0) + 1
+                if lat_us is not None:
+                    h = self._bucket_hist.get(b)
+                    if h is None:
+                        h = self._bucket_hist[b] = \
+                            [0] * (len(LATENCY_BUCKETS_US) + 1)
+                    lat = float(lat_us)
+                    h[bisect.bisect_left(LATENCY_BUCKETS_US, lat)] += 1
+                    self._bucket_lat_sum[b] = \
+                        self._bucket_lat_sum.get(b, 0.0) + lat
 
     # ------------------------------------------------------------ histogram
     def histogram(self) -> Tuple[List[int], float, int]:
@@ -109,6 +129,46 @@ class LatencyStats:
             running += c
             cum.append(running)
         return cum, total_sum, n
+
+    def bucket_histograms(self) -> Dict[int, Tuple[List[int], float, int]]:
+        """One locked snapshot of the per-bucket dispatch-latency
+        histograms for the exporter: {bucket: (CUMULATIVE counts per
+        ``LATENCY_BUCKETS_US`` edge + the +Inf slot, latency sum us,
+        count)}."""
+        with self._lock:
+            slots = {b: list(h) for b, h in self._bucket_hist.items()}
+            sums = dict(self._bucket_lat_sum)
+        out: Dict[int, Tuple[List[int], float, int]] = {}
+        for b, per_slot in slots.items():
+            cum, running = [], 0
+            for c in per_slot:
+                running += c
+                cum.append(running)
+            out[b] = (cum, sums.get(b, 0.0), cum[-1])
+        return out
+
+    def bucket_percentile(self, bucket: int, p: float) -> Optional[float]:
+        """Histogram-estimated p-th percentile (0..100) of one bucket's
+        dispatch latencies in us — linear interpolation inside the
+        fixed edge the rank falls in (the Prometheus
+        ``histogram_quantile`` convention; resolution is the edge
+        grid, good enough to GATE on).  None with no dispatches."""
+        hists = self.bucket_histograms()
+        if bucket not in hists:
+            return None
+        cum, _s, n = hists[bucket]
+        if n <= 0:
+            return None
+        rank = (p / 100.0) * n
+        lo = 0.0
+        for i, edge in enumerate(LATENCY_BUCKETS_US):
+            if cum[i] >= rank:
+                prev = cum[i - 1] if i else 0
+                in_slot = cum[i] - prev
+                frac = (rank - prev) / in_slot if in_slot else 1.0
+                return lo + frac * (edge - lo)
+            lo = edge
+        return float(LATENCY_BUCKETS_US[-1])  # rank in the +Inf slot
 
     # ------------------------------------------------------------- reading
     def percentile(self, p: float) -> Optional[float]:

@@ -57,6 +57,9 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
         "counter", "engine forward dispatches by compiled bucket size"),
     "dlrm_serve_latency_us": (
         "histogram", "end-to-end request latency in microseconds"),
+    "dlrm_serve_bucket_latency_us": (
+        "histogram",
+        "engine forward wall per dispatch, labelled by compiled bucket"),
     "dlrm_train_steps_total": (
         "counter", "training dispatches adopted (global steps)"),
     "dlrm_train_samples_per_s": (
@@ -174,6 +177,35 @@ class Histogram(Metric):
         return lines
 
 
+class LabeledHistogram(Metric):
+    """Pull-based cumulative histogram FAMILY with one label: ``fn``
+    returns ``{label_value: (cumulative counts per edge + the +Inf
+    slot, sum, count)}`` at scrape time — the per-bucket shape
+    ``LatencyStats.bucket_histograms()`` snapshots under its one
+    existing lock."""
+
+    def __init__(self, name: str, label: str, buckets: Tuple[float, ...],
+                 fn: Callable[[], Dict[str, Tuple[List[float], float,
+                                                  float]]]):
+        super().__init__(name)
+        self.label = label
+        self.buckets = tuple(buckets)
+        self._fn = fn
+
+    def expose(self) -> List[str]:
+        lines: List[str] = []
+        for lv, (cum, total_sum, n) in sorted(self._fn().items()):
+            pre = f'{self.name}_bucket{{{self.label}="{lv}",'
+            for edge, c in zip(self.buckets, cum):
+                lines.append(f'{pre}le="{_fmt(edge)}"}} {_fmt(c)}')
+            lines.append(f'{pre}le="+Inf"}} {_fmt(cum[-1])}')
+            lines.append(f'{self.name}_sum{{{self.label}="{lv}"}} '
+                         f'{_fmt(total_sum)}')
+            lines.append(f'{self.name}_count{{{self.label}="{lv}"}} '
+                         f'{_fmt(n)}')
+        return lines
+
+
 class MetricsRegistry:
     """Ordered family table -> one Prometheus text exposition."""
 
@@ -227,6 +259,11 @@ _retired_hist = [0] * (len(LATENCY_BUCKETS_US) + 1)  # cumulative
 _retired_sum = 0.0
 _retired_count = 0
 _retired_buckets: Dict[int, int] = {}
+# per-bucket dispatch-latency histograms of retired stats (cumulative
+# slot counts + sum + count per bucket size)
+_retired_bucket_hist: Dict[int, List[int]] = {}
+_retired_bucket_sum: Dict[int, float] = {}
+_retired_bucket_n: Dict[int, int] = {}
 
 
 def _fold_stats_locked(stats) -> None:
@@ -250,6 +287,13 @@ def _fold_stats_locked(stats) -> None:
         snap = dict(stats.dispatch_buckets)
     for b, c in snap.items():
         _retired_buckets[b] = _retired_buckets.get(b, 0) + int(c)
+    for b, (bc, bs, bn) in stats.bucket_histograms().items():
+        base = _retired_bucket_hist.setdefault(
+            b, [0] * (len(LATENCY_BUCKETS_US) + 1))
+        for i, c in enumerate(bc):
+            base[i] += int(c)
+        _retired_bucket_sum[b] = _retired_bucket_sum.get(b, 0.0) + float(bs)
+        _retired_bucket_n[b] = _retired_bucket_n.get(b, 0) + int(bn)
     _live_stats.discard(stats)
 
 
@@ -351,6 +395,31 @@ def _latency_hist() -> Tuple[List[float], float, float]:
     return cum, s, n
 
 
+def _bucket_latency_hists() -> Dict[str, Tuple[List[float], float, float]]:
+    """Scrape collector for dlrm_serve_bucket_latency_us: retained base
+    + live sweep per bucket label, under the same exactly-once locking
+    discipline as the unlabeled latency histogram."""
+    with _retired_lock:
+        _drain_pending_locked()
+        out: Dict[str, Tuple[List[float], float, float]] = {}
+        for b, base in _retired_bucket_hist.items():
+            out[str(b)] = ([float(c) for c in base],
+                           _retired_bucket_sum.get(b, 0.0),
+                           float(_retired_bucket_n.get(b, 0)))
+        for st in _live_stats:
+            for b, (bc, bs, bn) in st.bucket_histograms().items():
+                key = str(b)
+                if key in out:
+                    cum, s, n = out[key]
+                    for i, c in enumerate(bc):
+                        cum[i] += c
+                    out[key] = (cum, s + bs, n + bn)
+                else:
+                    out[key] = ([float(c) for c in bc], float(bs),
+                                float(bn))
+    return out
+
+
 def _dispatch_buckets() -> Dict[str, float]:
     with _retired_lock:
         _drain_pending_locked()
@@ -397,6 +466,9 @@ SERVE_DISPATCHES = REGISTRY.register(
                    _dispatch_buckets))
 SERVE_LATENCY = REGISTRY.register(
     Histogram("dlrm_serve_latency_us", LATENCY_BUCKETS_US, _latency_hist))
+SERVE_BUCKET_LATENCY = REGISTRY.register(
+    LabeledHistogram("dlrm_serve_bucket_latency_us", "bucket",
+                     LATENCY_BUCKETS_US, _bucket_latency_hists))
 TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
 TRAIN_SAMPLES_PER_S = REGISTRY.register(
     Gauge("dlrm_train_samples_per_s"))

@@ -6,9 +6,11 @@
 Diffs the HEADLINE metrics two bench artifacts share — throughput
 (samples/s or requests/s), busy-equivalent throughput (samples per
 device-busy second, the queue-lottery-proof number PERF.md trusts),
-and MFU — and exits nonzero naming each metric whose new value fell
-more than ``tolerance`` percent below the baseline.  Every compared
-metric is higher-is-better by construction.
+MFU, and the serving tail-latency headline (``dlrm_serving_p99_ms``)
+— and exits nonzero naming each metric that regressed more than
+``tolerance`` percent.  Throughput metrics regress DOWNWARD; latency
+metrics (``*_ms``/``*_us``/percentile names, :func:`lower_is_better`)
+regress UPWARD.
 
 Accepted file shapes (auto-detected):
 
@@ -28,13 +30,32 @@ from typing import Dict, List, Tuple
 
 def _history_metric_name(entry: dict) -> str:
     """The one-line-protocol metric name a history entry was emitted
-    under (bench.py: main() vs bench_app() vs bench_serving())."""
+    under.  Newer entries carry it explicitly (``"metric"`` — bench.py
+    records it for headlines beyond the app's historical one, e.g. the
+    serving p99); older entries map from the app name (bench.py:
+    main() vs bench_app() vs bench_serving())."""
+    m = entry.get("metric")
+    if m:
+        return str(m)
     app = entry.get("app", "dlrm")
     if app == "dlrm":
         return "dlrm_synthetic_samples_per_sec"
     if app == "dlrm_serving":
         return "dlrm_serving_qps"
     return f"{app}_samples_per_sec"
+
+
+def lower_is_better(name: str) -> bool:
+    """Latency-style headlines regress UPWARD: ``dlrm_serving_p99_ms``
+    and friends gate on the new value RISING past tolerance, where the
+    throughput metrics gate on falling.  Checked per ``:``-qualifier
+    segment (names may carry suffixes like ``:quantize=int8``)."""
+    for seg in name.lower().split(":"):
+        if (seg.endswith("_ms") or seg.endswith("_us")
+                or "latency" in seg or "_p99" in seg or "_p95" in seg
+                or "_p50" in seg):
+            return True
+    return False
 
 
 def _history_metrics(entries: List[dict]) -> Dict[str, float]:
@@ -48,10 +69,24 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         if not h.get("fenced"):
             continue  # pre-fence-fix methodology: never comparable
         name = _history_metric_name(h)
-        # later entries overwrite: the NEWEST anchors the gate
-        for k in list(out):
-            if k == name or k.startswith(name + ":"):
-                del out[k]
+        # quantized serving entries anchor separately in bench.py's key
+        # (numerics differ); keep them apart here too, or an int8 run
+        # would gate against the newest f32 entry of the same metric
+        q = h.get("quantize")
+        if q and q != "off":
+            name = f"{name}:quantize={q}"
+        # per-bucket latency headlines likewise: the largest dispatched
+        # bucket is load-dependent, and a bucket-8 p99 must never
+        # anchor a bucket-64 run (bench.py keys the entry the same way)
+        b = h.get("bucket")
+        if b is not None:
+            name = f"{name}:bucket={b}"
+        # later entries overwrite: the NEWEST anchors the gate.  Only
+        # THIS entry's own derived riders are replaced — a plain-name
+        # prefix sweep would also delete the ":quantize=..." anchors a
+        # newer unquantized entry must never touch
+        for suffix in ("", ":mfu_pct", ":busy_samples_per_s"):
+            out.pop(name + suffix, None)
         out[name] = float(h["value"])
         if h.get("mfu_pct"):
             out[f"{name}:mfu_pct"] = float(h["mfu_pct"])
@@ -87,9 +122,11 @@ def compare(base: Dict[str, float], new: Dict[str, float],
             ) -> Tuple[List[Tuple[str, float, float, float]],
                        List[Tuple[str, float, float, float]]]:
     """(all shared rows, regressed rows) as (metric, base, new,
-    delta_pct).  A metric regresses when the new value is more than
-    ``tolerance_pct`` percent BELOW the baseline; improvements of any
-    size pass."""
+    delta_pct).  A throughput metric regresses when the new value is
+    more than ``tolerance_pct`` percent BELOW the baseline; a latency
+    metric (:func:`lower_is_better`) regresses when it rises more than
+    ``tolerance_pct`` percent ABOVE it.  Improvements of any size
+    pass."""
     rows, regressions = [], []
     for name in sorted(set(base) & set(new)):
         b, n = float(base[name]), float(new[name])
@@ -98,7 +135,10 @@ def compare(base: Dict[str, float], new: Dict[str, float],
         delta_pct = 100.0 * (n - b) / b
         row = (name, b, n, delta_pct)
         rows.append(row)
-        if delta_pct < -float(tolerance_pct):
+        if lower_is_better(name):
+            if delta_pct > float(tolerance_pct):
+                regressions.append(row)
+        elif delta_pct < -float(tolerance_pct):
             regressions.append(row)
     return rows, regressions
 

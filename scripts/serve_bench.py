@@ -179,6 +179,11 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint", default="",
                    help="CheckpointManager dir (or one ckpt dir) to "
                         "load params from (inference-only restore)")
+    p.add_argument("--quantize", default="off",
+                   choices=("off", "int8", "bf16"),
+                   help="row-quantize the embedding tables at engine "
+                        "load (docs/serving.md; tolerance-pinned "
+                        "outputs, ~4x/2x smaller table sweep)")
     p.add_argument("--telemetry",
                    default=os.path.join(REPO, "artifacts",
                                         "telemetry_serving.jsonl"))
@@ -203,9 +208,15 @@ def main(argv=None) -> int:
     cfg, model = build_model(args)
     with event_log(args.telemetry, mode="w"):
         if args.checkpoint:
-            engine = InferenceEngine.from_checkpoint(model, args.checkpoint)
+            engine = InferenceEngine.from_checkpoint(
+                model, args.checkpoint, quantize=args.quantize)
         else:
-            engine = InferenceEngine(model, model.init(seed=args.seed))
+            engine = InferenceEngine(model, model.init(seed=args.seed),
+                                     quantize=args.quantize)
+        if engine.quantization["mode"] != "off":
+            q = engine.quantization
+            print(f"serve_bench: quantized tables ({q['mode']}): "
+                  f"{q['bytes_before']:,} -> {q['bytes_after']:,} bytes")
         pool = request_pool(cfg, args)
         batcher = DynamicBatcher(engine)
         if args.mode == "closed":

@@ -278,6 +278,51 @@ class TestTracePurity:
         )}, TracePurityPass)
         assert fs == []
 
+    def test_fires_print_in_pallas_kernel_via_partial_binding(
+            self, tmp_path):
+        # pallas kernel bodies are jit-reachable; the kern =
+        # functools.partial(...) binding idiom must resolve
+        fs = _run_pass(tmp_path, {"pkg/g.py": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "def _kern(x_ref, o_ref, *, n):\n"
+            "    print('trace-time only')\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def run(x):\n"
+            "    kern = functools.partial(_kern, n=4)\n"
+            "    return pl.pallas_call(kern, out_shape=x)(x)\n"
+        )}, TracePurityPass)
+        assert _codes(fs) == ["side-effect-in-trace"]
+        assert "_kern" in fs[0].detail
+
+    def test_fires_emit_in_pallas_kernel_inline_partial(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/h.py": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "from x import emit\n"
+            "def _kern(x_ref, o_ref):\n"
+            "    emit('step', wall_s=0.0)\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def run(x):\n"
+            "    return pl.pallas_call(functools.partial(_kern),\n"
+            "                          out_shape=x)(x)\n"
+        )}, TracePurityPass)
+        assert _codes(fs) == ["emit-in-trace"]
+
+    def test_silent_clean_pallas_kernel(self, tmp_path):
+        # a pure kernel (loads/stores/arithmetic) raises nothing, and
+        # the driver's own host prints stay out of the closure
+        fs = _run_pass(tmp_path, {"pkg/i.py": (
+            "from jax.experimental import pallas as pl\n"
+            "def _kern(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * 2\n"
+            "def run(x):\n"
+            "    out = pl.pallas_call(_kern, out_shape=x)(x)\n"
+            "    print('host side is fine')\n"
+            "    return out\n"
+        )}, TracePurityPass)
+        assert fs == []
+
 
 # ----------------------------------------------------------- donation-safety
 class TestDonationSafety:
