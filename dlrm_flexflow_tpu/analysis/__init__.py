@@ -3,24 +3,29 @@
     python -m dlrm_flexflow_tpu.analysis [--pass NAME] [--format text|json]
 
 Multi-pass AST analysis enforcing the invariants the framework's
-correctness rests on: lock discipline, trace purity, donation safety,
-and import layering.  The shared engine (module loader, scoped symbol
-index, stable waiver keys, committed ``ANALYSIS_WAIVERS.txt``
-baseline) lives in :mod:`engine`; the pass catalog in :mod:`passes`;
+correctness rests on: lock discipline, trace purity, trace staleness,
+donation safety, cross-thread shared state, recompile hazards, and
+import layering.  The shared engine (module loader, scoped symbol
+index, interprocedural :class:`~engine.CallGraph` fixed point, stable
+waiver keys, committed ``ANALYSIS_WAIVERS.txt`` baseline) lives in
+:mod:`engine`; the pass catalog in :mod:`passes`;
 ``scripts/check_analysis.py`` smokes the whole suite in tier-1.
 
 Stdlib-only on purpose: the analyzer runs before jax imports, in CI,
 and anywhere the source tree exists.
 """
 
-from .engine import (AnalysisPass, AnalysisResult, Finding,
-                     FunctionIndex, Module, Waivers, WaiverError,
-                     all_passes, default_waivers, load_modules,
-                     repo_root, run_analysis, write_json)
+from .engine import (AnalysisPass, AnalysisResult, BaselineError,
+                     CallGraph, Finding, FunctionIndex, Module, Waivers,
+                     WaiverError, all_passes, default_waivers,
+                     get_callgraph, load_modules, repo_root,
+                     run_analysis, to_sarif, update_baseline,
+                     write_json, write_sarif)
 
 __all__ = [
-    "AnalysisPass", "AnalysisResult", "Finding", "FunctionIndex",
-    "Module", "Waivers", "WaiverError", "all_passes",
-    "default_waivers", "load_modules", "repo_root", "run_analysis",
-    "write_json",
+    "AnalysisPass", "AnalysisResult", "BaselineError", "CallGraph",
+    "Finding", "FunctionIndex", "Module", "Waivers", "WaiverError",
+    "all_passes", "default_waivers", "get_callgraph", "load_modules",
+    "repo_root", "run_analysis", "to_sarif", "update_baseline",
+    "write_json", "write_sarif",
 ]

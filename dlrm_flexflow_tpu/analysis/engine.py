@@ -15,7 +15,14 @@ spine every pass (``analysis/passes/``) builds on:
   :class:`Module` records with repo-relative paths;
 * :class:`FunctionIndex` — lexically-scoped function/method lookup so
   passes resolve ``f(...)`` / ``self.m(...)`` call targets the way the
-  interpreter would, not by grepping names;
+  interpreter would, not by grepping names; ambiguous ``obj.m`` calls
+  are narrowed by call-signature compatibility (arity + keyword names)
+  before giving up;
+* :class:`CallGraph` — the resolved call edges of the whole project
+  plus the ONE interprocedural machinery every pass shares: a bounded-
+  depth, cycle-safe fixed-point :meth:`~CallGraph.propagate` (function
+  summaries union through helper layers) and a note-carrying
+  :meth:`~CallGraph.reachable` closure (entry-point reachability);
 * :class:`Finding` — ``path:line`` + pass + code + a STABLE waiver key
   (no line numbers — waivers survive unrelated edits);
 * :class:`Waivers` — the committed baseline (``ANALYSIS_WAIVERS.txt``):
@@ -39,6 +46,12 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 #: default roots the analyzer covers, relative to the repo root: the
 #: package itself, the ops/CI scripts, and the bench entry points.
 DEFAULT_ROOTS = ("dlrm_flexflow_tpu", "scripts", "bench.py")
+
+#: jax.lax control-flow combinators whose function arguments run as part
+#: of the surrounding call (scan bodies etc.) — shared by every pass
+#: that walks the call graph.
+LAX_COMBINATORS = frozenset({"scan", "cond", "while_loop", "fori_loop",
+                             "switch", "associative_scan", "map"})
 
 #: the committed waiver/baseline file, at the repo root next to the
 #: package (absent == no waivers, e.g. for an installed wheel).
@@ -158,11 +171,15 @@ class FunctionIndex:
     resolves only when exactly one class in the project defines ``m``
     (ambiguity -> None, never a guess)."""
 
-    #: attribute names too generic to resolve by project-wide uniqueness
+    #: attribute names too generic to resolve by project-wide
+    #: uniqueness — including the threading/re surface (Event.set/
+    #: clear/wait, re.match) that would otherwise ghost-resolve onto
+    #: whatever project class happens to share the name
     GENERIC = frozenset({
         "get", "put", "pop", "append", "add", "items", "keys", "values",
         "update", "copy", "close", "open", "read", "write", "start",
         "end", "run", "join", "split", "strip", "format", "emit",
+        "set", "match", "clear", "wait",
         "__init__", "__enter__", "__exit__"})
 
     def __init__(self, modules: Iterable[Module]):
@@ -199,13 +216,62 @@ class FunctionIndex:
                             name: str) -> Optional[ast.AST]:
         return self._class_methods.get((module.name, classname, name))
 
-    def resolve_unique_method(self, name: str) -> Optional[ast.AST]:
+    def resolve_unique_method(self, name: str,
+                              call: Optional[ast.Call] = None
+                              ) -> Optional[ast.AST]:
+        """The project's one definition of method ``name`` — or, when
+        several classes define it and the CALL is given, the one
+        definition whose signature accepts the call (arity + keyword
+        names); still-ambiguous stays None, never a guess."""
         if name in self.GENERIC:
             return None
         cands = self._methods.get(name, ())
         if len(cands) == 1:
             return cands[0][2]
+        if call is not None and len(cands) > 1:
+            fits = [n for _m, _c, n in cands
+                    if self._call_compatible(call, n)]
+            if len(fits) == 1:
+                return fits[0]
         return None
+
+    @staticmethod
+    def _call_compatible(call: ast.Call, node: ast.AST) -> bool:
+        """Could this call site bind against this def's signature?  A
+        purely syntactic check (positional arity, keyword names,
+        required parameters) that narrows ambiguous ``obj.m`` targets —
+        e.g. ``predict(x, queue_wait_us=...)`` picks the one ``predict``
+        that takes ``queue_wait_us``.  Splats at the call site make the
+        check vacuously true (no exclusion without evidence)."""
+        args = getattr(node, "args", None)
+        if args is None:
+            return False
+        if any(isinstance(a, ast.Starred) for a in call.args) \
+                or any(k.arg is None for k in call.keywords):
+            return True
+        params = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        npos = len(call.args)
+        if npos > len(params) and args.vararg is None:
+            return False
+        kwnames = {k.arg for k in call.keywords}
+        kwonly = [a.arg for a in args.kwonlyargs]
+        if args.kwarg is None:
+            for k in kwnames:
+                if k not in params and k not in kwonly:
+                    return False
+        # every parameter without a default must be bound
+        required = params[:len(params) - len(args.defaults)]
+        for i, p in enumerate(required):
+            if i >= npos and p not in kwnames:
+                return False
+        if kwnames & set(params[:npos]):
+            return False  # keyword repeats a positionally-bound param
+        for p, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is None and p.arg not in kwnames:
+                return False
+        return True
 
     def resolve_call(self, call: ast.Call, module: Module,
                      scope: Tuple[str, ...],
@@ -221,8 +287,160 @@ class FunctionIndex:
                                                  fn.attr)
                 if found is not None:
                     return found
-            return self.resolve_unique_method(fn.attr)
+            return self.resolve_unique_method(fn.attr, call)
         return None
+
+
+# -------------------------------------------------------------- call graph
+def iter_calls(fn_node: ast.AST):
+    """Call nodes belonging to THIS function — nested function/lambda
+    bodies excluded (they run in their own right; passes decide whether
+    a nested def "happens" at the parent's call time)."""
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            yield from visit(child)
+
+    yield from visit(fn_node)
+
+
+def call_display(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return "<call>"
+
+
+class CallGraph:
+    """Resolved call edges over the whole project plus the shared
+    interprocedural machinery (docs/analysis.md).
+
+    Edges are the :class:`FunctionIndex`'s best-effort resolutions of
+    every call in every function body, PLUS the function arguments of
+    ``jax.lax`` control-flow combinators (a scan body runs as part of
+    the scan call).  Nested function *definitions* are a separate
+    relation (:attr:`nested`) because whether a nested def's body runs
+    at the parent's call time is pass-specific: a trace walk follows it
+    (closures run in-graph), a lock walk must not (a callback bound
+    under a lock runs later, lock released).
+
+    Two shared algorithms replace the old per-pass one-level
+    resolution:
+
+    * :meth:`propagate` — bounded-depth fixed point: ``summary[f]`` is
+      the union of per-function local facts over everything ``f`` can
+      reach in at most ``depth`` call hops.  Monotone set union over a
+      finite domain, so cycles (recursion, mutual recursion) converge
+      instead of recursing forever; the depth bound is the documented
+      "helper layers, not whole-program" intent.
+    * :meth:`reachable` — note-carrying closure from entry points
+      (jit sites, thread targets), each reached function remembering
+      HOW it was reached for the finding message.
+    """
+
+    #: default propagation/reachability depth: deep enough to see
+    #: through any real helper stack in this tree, small enough that a
+    #: pathological chain cannot drag every fact everywhere.
+    DEFAULT_DEPTH = 10
+
+    def __init__(self, modules: List[Module], index: FunctionIndex):
+        self.modules = modules
+        self.index = index
+        # fn node -> [(callee node, lineno, display name)]
+        self.edges: Dict[ast.AST, List[Tuple[ast.AST, int, str]]] = {}
+        # fn node -> directly nested def nodes
+        self.nested: Dict[ast.AST, List[ast.AST]] = {}
+        for node, (mod, qual, cls, def_scope) in index.owner.items():
+            scope = def_scope + (qual.split(".")[-1],)
+            edges: List[Tuple[ast.AST, int, str]] = []
+            for call in iter_calls(node):
+                target = index.resolve_call(call, mod, scope, cls)
+                if target is not None and target is not node:
+                    edges.append((target, call.lineno,
+                                  call_display(call)))
+                fn = call.func
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr in LAX_COMBINATORS:
+                    for arg in call.args:
+                        if isinstance(arg, ast.Name):
+                            t = index.resolve_name(mod, scope, arg.id)
+                            if t is not None and t is not node:
+                                edges.append(
+                                    (t, call.lineno,
+                                     f"jax.lax.{fn.attr}"))
+            self.edges[node] = edges
+            # every def nested anywhere inside (they are index-owned
+            # functions themselves, so reachability recurses from them)
+            self.nested[node] = [
+                child for child in ast.walk(node)
+                if child is not node
+                and isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+
+    def propagate(self, local: Dict[ast.AST, set],
+                  depth: Optional[int] = None) -> Dict[ast.AST, set]:
+        """``summary[f] = local[f] ∪ ⋃ summary[callee]`` iterated to a
+        fixed point (or ``depth`` rounds, whichever first).  Round k
+        sees exactly k call hops, so the bound has a crisp meaning:
+        facts more than ``depth`` helper layers down stay invisible —
+        and a cycle simply stops changing the union."""
+        depth = self.DEFAULT_DEPTH if depth is None else depth
+        summary = {n: frozenset(local.get(n, ()))
+                   for n in self.index.owner}
+        for _ in range(max(0, depth)):
+            changed = False
+            nxt: Dict[ast.AST, frozenset] = {}
+            for n, edges in self.edges.items():
+                s = summary[n]
+                acc = set(local.get(n, ()))
+                for callee, _ln, _nm in edges:
+                    acc.update(summary.get(callee, ()))
+                fs = frozenset(acc)
+                nxt[n] = fs
+                if fs != s:
+                    changed = True
+            summary = nxt
+            if not changed:
+                break
+        return {n: set(s) for n, s in summary.items()}
+
+    def reachable(self, entries: Dict[ast.AST, str],
+                  depth: Optional[int] = None,
+                  follow_nested: bool = True) -> Dict[ast.AST, str]:
+        """Everything callable within ``depth`` hops of the entry
+        points; values are human-readable "how we got here" notes
+        (first discovery wins — BFS keeps them shortest)."""
+        depth = self.DEFAULT_DEPTH if depth is None else depth
+        reach: Dict[ast.AST, str] = {}
+        frontier = [(n, note) for n, note in entries.items()
+                    if n in self.index.owner]
+        for n, note in frontier:
+            reach.setdefault(n, note)
+        for _ in range(max(0, depth)):
+            nxt: List[Tuple[ast.AST, str]] = []
+            for n, note in frontier:
+                for callee, _ln, name in self.edges.get(n, ()):
+                    if callee not in reach:
+                        reach[callee] = f"{note} via {name}()"
+                        nxt.append((callee, reach[callee]))
+                if follow_nested:
+                    for kid in self.nested.get(n, ()):
+                        if kid in reach:
+                            continue
+                        kname = getattr(kid, "name", "<nested>")
+                        reach[kid] = f"{note} via nested {kname}"
+                        nxt.append((kid, reach[kid]))
+            if not nxt:
+                break
+            frontier = nxt
+        return reach
 
 
 # --------------------------------------------------------------- findings
@@ -292,6 +510,17 @@ def all_passes() -> Dict[str, type]:
     return {p.name: p for p in PASSES}
 
 
+def get_callgraph(modules: List[Module],
+                  index: FunctionIndex) -> CallGraph:
+    """The run's one :class:`CallGraph`, built lazily and cached on the
+    index — seven passes share one edge walk, not seven."""
+    cg = getattr(index, "_callgraph", None)
+    if cg is None:
+        cg = CallGraph(modules, index)
+        index._callgraph = cg
+    return cg
+
+
 # ---------------------------------------------------------------- waivers
 class WaiverError(ValueError):
     """The waiver file itself is malformed (fail loudly: a silently
@@ -305,19 +534,29 @@ class Waivers:
     (:meth:`unused` feeds the stale-waiver failure)."""
 
     def __init__(self, entries: Optional[List[Tuple[str, str, int]]] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None,
+                 comments: Optional[Dict[str, List[str]]] = None):
         self.path = path
         self.entries = entries or []   # (key, justification, lineno)
         self._used: Dict[str, bool] = {k: False for k, _, _ in self.entries}
+        # key -> the '#' block right above the entry (regenerated
+        # baselines keep the prose next to the exemption it explains)
+        self.comments: Dict[str, List[str]] = comments or {}
 
     @classmethod
     def load(cls, path: str) -> "Waivers":
         entries: List[Tuple[str, str, int]] = []
         seen: Dict[str, int] = {}
+        comments: Dict[str, List[str]] = {}
+        block: List[str] = []
         with open(path, encoding="utf-8") as f:
             for i, raw in enumerate(f, 1):
                 line = raw.strip()
-                if not line or line.startswith("#"):
+                if not line:
+                    block = []
+                    continue
+                if line.startswith("#"):
+                    block.append(line)
                     continue
                 if "|" not in line:
                     raise WaiverError(
@@ -338,7 +577,10 @@ class Waivers:
                         f"(first at line {seen[key]})")
                 seen[key] = i
                 entries.append((key, just, i))
-        return cls(entries, path=path)
+                if block:
+                    comments[key] = block
+                    block = []
+        return cls(entries, path=path, comments=comments)
 
     def match(self, finding: Finding) -> Optional[str]:
         """The justification when ``finding`` is waived (marking the
@@ -363,19 +605,36 @@ class AnalysisResult:
     def __init__(self, pass_names: List[str], n_modules: int,
                  findings: List[Finding],
                  waived: List[Tuple[Finding, str]],
-                 unused_waivers: List[Tuple[str, str, int]]):
+                 unused_waivers: List[Tuple[str, str, int]],
+                 only_paths: Optional[Sequence[str]] = None):
         self.pass_names = pass_names
         self.n_modules = n_modules
         self.findings = findings
         self.waived = waived
         self.unused_waivers = unused_waivers
+        # --changed-only scope: the paths findings were restricted to
+        # (None = whole tree)
+        self.only_paths = sorted(only_paths) if only_paths is not None \
+            else None
 
     @property
     def ok(self) -> bool:
         return not self.findings and not self.unused_waivers
 
+    def by_pass(self) -> Dict[str, Dict[str, int]]:
+        """Per-pass finding/waived counts (zero-filled for every pass
+        that ran — the report CLI's delta needs stable keys)."""
+        out = {n: {"findings": 0, "waived": 0} for n in self.pass_names}
+        for f in self.findings:
+            out.setdefault(f.pass_name,
+                           {"findings": 0, "waived": 0})["findings"] += 1
+        for f, _j in self.waived:
+            out.setdefault(f.pass_name,
+                           {"findings": 0, "waived": 0})["waived"] += 1
+        return out
+
     def to_dict(self) -> dict:
-        return {
+        doc = {
             "version": 1,
             "tool": "ffcheck",
             "passes": list(self.pass_names),
@@ -385,11 +644,15 @@ class AnalysisResult:
                        for f, j in self.waived],
             "unused_waivers": [{"key": k, "justification": j, "line": ln}
                                for k, j, ln in self.unused_waivers],
+            "by_pass": self.by_pass(),
             "summary": {"findings": len(self.findings),
                         "waived": len(self.waived),
                         "unused_waivers": len(self.unused_waivers),
                         "ok": self.ok},
         }
+        if self.only_paths is not None:
+            doc["changed_only"] = list(self.only_paths)
+        return doc
 
     def format_text(self) -> str:
         lines: List[str] = []
@@ -401,12 +664,16 @@ class AnalysisResult:
                          f"{k!r} matches no finding — remove it "
                          f"(was: {j})")
         status = "OK" if self.ok else "FAIL"
+        scope = ""
+        if self.only_paths is not None:
+            scope = (f" [changed-only: {len(self.only_paths)} "
+                     f"file(s) in scope]")
         lines.append(
             f"ffcheck: {status} — {len(self.findings)} finding(s), "
             f"{len(self.waived)} waived, "
             f"{len(self.unused_waivers)} stale waiver(s); "
             f"{len(self.pass_names)} pass(es) over "
-            f"{self.n_modules} modules")
+            f"{self.n_modules} modules{scope}")
         return "\n".join(lines)
 
     waivers_path: Optional[str] = None
@@ -416,9 +683,16 @@ def run_analysis(modules: Optional[List[Module]] = None,
                  pass_names: Optional[Sequence[str]] = None,
                  waivers: Optional[Waivers] = None,
                  repo: Optional[str] = None,
-                 roots: Optional[Sequence[str]] = None) -> AnalysisResult:
+                 roots: Optional[Sequence[str]] = None,
+                 only_paths: Optional[Sequence[str]] = None
+                 ) -> AnalysisResult:
     """Load (unless given), run the requested passes (default: all),
-    apply waivers.  Raises KeyError on an unknown pass name."""
+    apply waivers.  ``only_paths`` (the CLI's ``--changed-only`` mode)
+    still ANALYZES the whole tree — interprocedural passes need the
+    whole program — but reports only findings in those repo-relative
+    paths; waiver matching and the stale-waiver check stay global, so a
+    changed-only run cannot silently retire a baseline entry.  Raises
+    ValueError on an unknown pass name."""
     if modules is None:
         modules = load_modules(roots=roots, repo=repo)
     registry = all_passes()
@@ -441,7 +715,12 @@ def run_analysis(modules: Optional[List[Module]] = None,
         else:
             waived.append((f, just))
     unused = waivers.unused() if waivers is not None else []
-    res = AnalysisResult(names, len(modules), active, waived, unused)
+    if only_paths is not None:
+        scope = {p.replace(os.sep, "/") for p in only_paths}
+        active = [f for f in active if f.path in scope]
+        waived = [(f, j) for f, j in waived if f.path in scope]
+    res = AnalysisResult(names, len(modules), active, waived, unused,
+                         only_paths=only_paths)
     res.waivers_path = waivers.path if waivers is not None else None
     return res
 
@@ -461,3 +740,115 @@ def write_json(result: AnalysisResult, path: str) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(result.to_dict(), f, indent=1)
         f.write("\n")
+
+
+# ------------------------------------------------------------------- SARIF
+def to_sarif(result: AnalysisResult) -> dict:
+    """The findings as one SARIF 2.1.0 run, the interchange shape CI
+    annotators (GitHub code scanning, Gerrit checks) consume: each
+    active finding becomes a ``result`` with a ``ruleId`` of
+    ``<pass>/<code>``, a ``path:line`` physical location, and the
+    ffcheck waiver key as a stable ``partialFingerprints`` entry so an
+    annotator can track a finding across rebases the same way the
+    baseline does.  Waived findings are emitted with
+    ``suppressions`` so the annotation shows WHY it is quiet."""
+    rules: Dict[str, dict] = {}
+    results: List[dict] = []
+
+    def one(f: Finding, suppression: Optional[str]) -> dict:
+        rid = f"{f.pass_name}/{f.code}"
+        rules.setdefault(rid, {
+            "id": rid,
+            "shortDescription": {"text": f.code.replace("-", " ")}})
+        r = {
+            "ruleId": rid,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line}}}],
+            "partialFingerprints": {"ffcheckWaiverKey/v1": f.waiver_key},
+        }
+        if suppression is not None:
+            r["suppressions"] = [{"kind": "external",
+                                  "justification": suppression}]
+        return r
+
+    for f in result.findings:
+        results.append(one(f, None))
+    for f, just in result.waived:
+        results.append(one(f, just))
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ffcheck",
+                "informationUri": "docs/analysis.md",
+                "rules": [rules[k] for k in sorted(rules)]}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(result: AnalysisResult, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_sarif(result), f, indent=1)
+        f.write("\n")
+
+
+# --------------------------------------------------------- baseline update
+BASELINE_HEADER = """\
+# ffcheck waiver baseline (docs/analysis.md).
+#
+# Format: one `<waiver-key> | <justification>` per line; the key is
+# printed with every finding (pass:path:detail:code — line-number-free,
+# so entries survive unrelated edits).  Every entry MUST carry a
+# justification, and an entry that matches no finding FAILS the run
+# (stale waivers rot into blanket exemptions).  Shrink this file when
+# you can; grow it only with a reason the next reader will accept.
+# Regenerate with `python -m dlrm_flexflow_tpu.analysis
+# --update-baseline` — it preserves justifications, drops stale
+# entries, and REFUSES to invent a waiver for a new finding.
+"""
+
+
+class BaselineError(ValueError):
+    """--update-baseline cannot proceed (typically: new findings with
+    no justification — waiving is a deliberate act, never generated)."""
+
+
+def update_baseline(result: AnalysisResult, waivers: Optional[Waivers],
+                    path: str) -> List[str]:
+    """Rewrite the waiver file from a finished run: every entry that
+    still matches a finding is kept with its justification (and its
+    explanatory comment block) VERBATIM; stale entries are dropped;
+    and any ACTIVE finding makes the update refuse with
+    :class:`BaselineError` — a regeneration must never mint an
+    unjustified exemption (the hand-edit era's typo'd-key failure mode,
+    inverted).  Returns the kept keys, sorted as written."""
+    if result.findings:
+        keys = sorted({f.waiver_key for f in result.findings})
+        raise BaselineError(
+            "refusing to regenerate the baseline over "
+            f"{len(result.findings)} unwaived finding(s) — fix them or "
+            "add a justified waiver line first:\n  " + "\n  ".join(keys))
+    kept: Dict[str, str] = {}
+    for f, just in result.waived:
+        kept.setdefault(f.waiver_key, just)
+    comments = waivers.comments if waivers is not None else {}
+    lines = [BASELINE_HEADER]
+    for key in sorted(kept):
+        block = comments.get(key)
+        if block:
+            lines.append("\n".join(block))
+        lines.append(f"{key} | {kept[key]}")
+        lines.append("")
+    text = "\n".join(lines).rstrip("\n") + "\n"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    return sorted(kept)

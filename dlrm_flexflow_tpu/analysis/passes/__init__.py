@@ -3,31 +3,51 @@
 Each pass is one :class:`~..engine.AnalysisPass` subclass grounded in a
 real hazard this codebase has already hit in review:
 
-* ``lock-discipline`` — telemetry emits / blocking I/O / future
+* ``lock-discipline``   — telemetry emits / blocking I/O / future
   completion under a held lock, and inconsistent pairwise lock
   acquisition order (deadlock potential);
-* ``trace-purity``    — host syncs, side effects, and telemetry emits
+* ``trace-purity``      — host syncs, side effects, and telemetry emits
   inside functions reachable from jit/AOT-compiled entry points;
-* ``donation-safety`` — arguments donated to a compiled callable
+* ``trace-staleness``   — mutable state (self attrs, rebindable
+  globals, os.environ) read inside traced code and mutated outside it:
+  the mutation silently no-ops after the first trace (the PR-6
+  ``op._interpret`` bug class);
+* ``shared-state``      — attributes shared between
+  ``threading.Thread`` bodies and the public API with no common lock;
+* ``recompile-hazard``  — jit entry points whose Python-level
+  arguments vary per call (fresh wrappers, data-derived statics,
+  unhashable statics, shape-varying slices): retrace storms;
+* ``donation-safety``   — arguments donated to a compiled callable
   referenced again after the call;
-* ``import-layering`` — module-level imports that climb the subsystem
-  DAG upward.
+* ``import-layering``   — module-level imports that climb the
+  subsystem DAG upward.
 
 Adding a pass: subclass AnalysisPass in a new module here, set
 ``name``/``description``, implement ``run``, append to ``PASSES``.
+The engine hands every pass the shared parsed modules, the
+FunctionIndex, and (via ``engine.get_callgraph``) the interprocedural
+CallGraph fixed point — build on those instead of re-walking.
 """
 
 from .donation import DonationSafetyPass
 from .layering import ImportLayeringPass
 from .locks import LockDisciplinePass
 from .purity import TracePurityPass
+from .recompile import RecompileHazardPass
+from .sharedstate import SharedStatePass
+from .staleness import TraceStalenessPass
 
 PASSES = [
     LockDisciplinePass,
     TracePurityPass,
+    TraceStalenessPass,
+    SharedStatePass,
+    RecompileHazardPass,
     DonationSafetyPass,
     ImportLayeringPass,
 ]
 
 __all__ = ["PASSES", "LockDisciplinePass", "TracePurityPass",
-           "DonationSafetyPass", "ImportLayeringPass"]
+           "TraceStalenessPass", "SharedStatePass",
+           "RecompileHazardPass", "DonationSafetyPass",
+           "ImportLayeringPass"]

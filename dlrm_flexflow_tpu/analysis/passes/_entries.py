@@ -1,0 +1,148 @@
+"""Trace-entry discovery shared by the trace-facing passes.
+
+``trace-purity`` and ``trace-staleness`` agree on what "runs under a
+tracer": everything reachable from a ``jax.jit(f)`` site, from a
+``pl.pallas_call(kernel)`` site (a pallas kernel body IS jit-traced
+code — Mosaic lowers it inside the surrounding program), and — for the
+staleness pass — every ``forward`` method of an op class (``ops/``
+unit), because ``FFModel.compile`` composes op forwards into its jitted
+train/eval/forward programs without a resolvable call edge (the
+composition loops over ``self.layers``, so no static target exists).
+This module is that agreement, written once.
+
+Kernel arguments resolve like the jit case (a bare name, lexically)
+plus the two idioms this codebase's kernels use: an inline
+``functools.partial(kernel, ...)`` first argument, and a local
+``kern = functools.partial(kernel, ...)`` binding whose name the call
+site passes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from ..engine import FunctionIndex, Module, iter_calls
+
+
+def _is_partial(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Name) and f.id == "partial") or \
+        (isinstance(f, ast.Attribute) and f.attr == "partial")
+
+
+def _partial_arg(call: ast.Call, module: Module, index: FunctionIndex,
+                 scope: Tuple[str, ...]) -> Optional[ast.AST]:
+    """The wrapped function of a ``functools.partial(f, ...)`` call,
+    resolved lexically; None for anything else."""
+    if _is_partial(call) and call.args \
+            and isinstance(call.args[0], ast.Name):
+        return index.resolve_name(module, scope, call.args[0].id)
+    return None
+
+
+def _partial_binding(encl: ast.AST, module: Module, index: FunctionIndex,
+                     scope: Tuple[str, ...],
+                     var: str) -> Optional[ast.AST]:
+    """Resolve ``var`` through a local ``var = functools.partial(f,
+    ...)`` assignment in the enclosing function — the standard
+    kernel-construction idiom (pallas_scatter/_embedding)."""
+    for child in ast.walk(encl):
+        if isinstance(child, ast.Assign) \
+                and len(child.targets) == 1 \
+                and isinstance(child.targets[0], ast.Name) \
+                and child.targets[0].id == var \
+                and isinstance(child.value, ast.Call):
+            t = _partial_arg(child.value, module, index, scope)
+            if t is not None:
+                return t
+    return None
+
+
+def _maybe_jit(node: ast.Call, module: Module, index: FunctionIndex,
+               scope: Tuple[str, ...],
+               entries: Dict[ast.AST, str]) -> None:
+    if not node.args:
+        return
+    fn = node.func
+    is_jit = (isinstance(fn, ast.Attribute) and fn.attr == "jit") \
+        or (isinstance(fn, ast.Name) and fn.id == "jit")
+    if not is_jit:
+        return
+    first = node.args[0]
+    if isinstance(first, ast.Name):
+        target = index.resolve_name(module, scope, first.id)
+        if target is not None:
+            entries.setdefault(target, f"jax.jit at line {node.lineno}")
+
+
+def _maybe_pallas(node: ast.Call, module: Module, index: FunctionIndex,
+                  scope: Tuple[str, ...], entries: Dict[ast.AST, str],
+                  encl: ast.AST) -> None:
+    """``pl.pallas_call(kernel, ...)`` / ``pallas_call(kernel)``: the
+    kernel body is jit-reachable.  ``encl`` is the enclosing function
+    (or module) node, scanned for the local partial-binding idiom."""
+    if not node.args:
+        return
+    fn = node.func
+    is_pc = (isinstance(fn, ast.Attribute) and fn.attr == "pallas_call") \
+        or (isinstance(fn, ast.Name) and fn.id == "pallas_call")
+    if not is_pc:
+        return
+    note = f"pl.pallas_call at line {node.lineno}"
+    first = node.args[0]
+    target = None
+    if isinstance(first, ast.Name):
+        target = index.resolve_name(module, scope, first.id)
+        if target is None:
+            target = _partial_binding(encl, module, index, scope,
+                                      first.id)
+    elif isinstance(first, ast.Call):
+        target = _partial_arg(first, module, index, scope)
+    if target is not None:
+        entries.setdefault(target, note)
+
+
+def all_jit_entries(modules, index: FunctionIndex) -> Dict[ast.AST, str]:
+    """Every module's jit/pallas entries, annotated with the defining
+    file (cross-module reachability needs to say where the entry was).
+    One pass over the function index, cached on it — trace-purity and
+    trace-staleness share the discovery instead of re-walking."""
+    cached = getattr(index, "_jit_entries_cache", None)
+    if cached is not None:
+        return dict(cached)
+    entries: Dict[ast.AST, str] = {}
+    for node, (mod, qual, _cls, def_scope) in index.owner.items():
+        scope = def_scope + (qual.split(".")[-1],)
+        found: Dict[ast.AST, str] = {}
+        for call in iter_calls(node):
+            _maybe_jit(call, mod, index, scope, found)
+            _maybe_pallas(call, mod, index, scope, found, node)
+        for t, note in found.items():
+            entries.setdefault(t, f"{note} in {mod.relpath}")
+    for m in modules:
+        found = {}
+        for call in iter_calls(m.tree):
+            _maybe_jit(call, m, index, (), found)
+            _maybe_pallas(call, m, index, (), found, m.tree)
+        for t, note in found.items():
+            entries.setdefault(t, f"{note} in {m.relpath}")
+    index._jit_entries_cache = entries
+    return dict(entries)
+
+
+def ops_forward_entries(modules, index: FunctionIndex
+                        ) -> Dict[ast.AST, str]:
+    """Every ``forward`` method of an op class (``ops/`` unit) as a
+    trace entry: the model composes op forwards into its jitted
+    programs by iterating ``self.layers``, an edge no static resolver
+    can see — so the staleness pass seeds them directly (ops/base.py's
+    ``__init_subclass__`` wraps exactly these methods in
+    ``jax.named_scope`` for the same reason)."""
+    entries: Dict[ast.AST, str] = {}
+    for node, (mod, qual, cls, _scope) in index.owner.items():
+        if cls is not None and qual.endswith(".forward") \
+                and mod.top == "ops":
+            entries.setdefault(
+                node, f"op forward ({qual}, traced via model.compile)")
+    return entries

@@ -20,7 +20,13 @@ convention — earned through review fixes, see serving/batcher.py's
 * **consistent pairwise acquisition order** (``lock-order``): if one
   code path takes A then B and another takes B then A, two threads can
   deadlock; the pass builds the acquired-while-holding graph (direct
-  nesting AND one-level-resolved calls) and flags inverted pairs.
+  nesting AND resolved calls) and flags inverted pairs.
+
+Effects propagate through the engine's interprocedural
+:class:`~..engine.CallGraph` fixed point (bounded depth, cycle-safe):
+holding a lock while calling a helper whose helper's helper emits is
+the same bug as emitting inline, and is flagged at the outermost call
+site where the lock is held.
 
 Lock identity: module-level locks are ``<module>.<name>``, instance
 locks are ``<Class>.<attr>`` (resolved via the enclosing class, or by
@@ -36,7 +42,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..engine import AnalysisPass, Finding, FunctionIndex, Module
+from ..engine import (AnalysisPass, Finding, FunctionIndex, Module,
+                      get_callgraph)
 
 #: call names that mean "telemetry is being emitted"
 EMIT_NAMES = frozenset({"emit", "emit_summary", "sample_memory",
@@ -51,8 +58,6 @@ BLOCKING_ATTRS = frozenset({"sleep", "write", "flush", "read", "join",
                             "serve_forever", "block_until_ready",
                             "readline"})
 
-_MAX_DEPTH = 3  # transitive effect propagation through resolved calls
-
 
 def _short(modname: str) -> str:
     return modname[len("dlrm_flexflow_tpu."):] \
@@ -66,6 +71,17 @@ def _is_lock_ctor(call: ast.Call) -> bool:
     if isinstance(fn, ast.Name):
         return fn.id in ("Lock", "RLock")
     return False
+
+
+def get_lock_table(modules: List[Module], index: FunctionIndex
+                   ) -> "_LockTable":
+    """The run's one lock table, cached on the index — lock-discipline
+    and shared-state share the discovery walk."""
+    table = getattr(index, "_lock_table_cache", None)
+    if table is None:
+        table = _LockTable(modules)
+        index._lock_table_cache = table
+    return table
 
 
 class _LockTable:
@@ -166,7 +182,7 @@ class LockDisciplinePass(AnalysisPass):
 
     def run(self, modules: List[Module],
             index: FunctionIndex) -> List[Finding]:
-        locks = _LockTable(modules)
+        locks = get_lock_table(modules, index)
         effects: Dict[ast.AST, _Effects] = {}
         for node in index.owner:
             effects[node] = self._analyze(node, index, locks)
@@ -175,21 +191,25 @@ class LockDisciplinePass(AnalysisPass):
         # (outer, inner) -> [(path, line)]
         order: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
 
-        def transitive(node: ast.AST, depth: int,
-                       seen: Set[ast.AST]) -> Tuple[List[Tuple[str, str]],
-                                                    Set[str]]:
-            """(events, acquired locks) of ``node`` and its resolved
-            callees, depth-limited; events as (kind, what)."""
-            if depth > _MAX_DEPTH or node in seen or node not in effects:
-                return [], set()
-            seen = seen | {node}
-            eff = effects[node]
-            evs = [(k, w) for k, w, _ln, _held in eff.events]
-            acq = set(eff.acquires)
-            for callee, _name, _ln, _held in eff.calls:
-                sub_evs, sub_acq = transitive(callee, depth + 1, seen)
-                evs.extend(sub_evs)
-                acq.update(sub_acq)
+        # interprocedural summaries via the engine's bounded fixed
+        # point: each function's events (kind, what) and acquired locks
+        # union over everything it can reach, cycle-safe — replacing
+        # the old hand-rolled depth-3 recursion so deep helper stacks
+        # (and recursion) resolve like any other call
+        local: Dict[ast.AST, set] = {}
+        for node, eff in effects.items():
+            facts = {("evt", k, w) for k, w, _ln, _held in eff.events}
+            facts |= {("acq", lid) for lid in eff.acquires}
+            local[node] = facts
+        summary = get_callgraph(modules, index).propagate(local)
+
+        def transitive(node: ast.AST) -> Tuple[List[Tuple[str, str]],
+                                               Set[str]]:
+            """(events, acquired locks) of ``node`` and everything it
+            reaches; events as (kind, what)."""
+            facts = summary.get(node, set())
+            evs = sorted((f[1], f[2]) for f in facts if f[0] == "evt")
+            acq = {f[1] for f in facts if f[0] == "acq"}
             return evs, acq
 
         for node, (mod, qual, _cls, _scope) in sorted(
@@ -209,7 +229,7 @@ class LockDisciplinePass(AnalysisPass):
                     f"{what} while {lock} is held in {qual}",
                     detail=qual))
             for callee, cname, line, held in eff.calls:
-                sub_evs, sub_acq = transitive(callee, 1, {node})
+                sub_evs, sub_acq = transitive(callee)
                 for a in sub_acq:
                     for h in held:
                         if h != a:

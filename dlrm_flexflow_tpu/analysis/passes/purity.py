@@ -10,22 +10,22 @@ telemetry ``emit``) fires at TRACE time only — once per compile, never
 per step — which is almost never what the author meant; a host clock
 read bakes trace-time wall time into the graph as a constant.
 
-Entry points are discovered, not configured: every ``jax.jit(f, ...)``
-call whose first argument resolves lexically to a function definition
-seeds the walk, and so does every ``pl.pallas_call(kernel, ...)`` —
-a pallas kernel body IS jit-traced code (Mosaic lowers it inside the
-surrounding program), so a host sync or emit inside one is exactly as
-wrong as in any jitted function.  The kernel argument resolves like
-the jit case (a bare name, lexically), plus the two idioms this
-codebase's kernels use: ``functools.partial(kernel, ...)`` inline as
-the first argument, and a local ``kern = functools.partial(kernel,
-...)`` binding whose name the call site passes.  Reachability follows
-bare-name calls (lexical resolution), ``self.method`` calls, function
-arguments to the ``jax.lax`` control-flow combinators (scan/cond/
-while_loop/fori_loop/switch), and nested function definitions (scan
-bodies and closures run in-graph).  Attribute calls on unknown objects
-are NOT followed — this pass prefers silence to guessing (documented
-in docs/analysis.md).
+Entry points are discovered, not configured (``passes/_entries.py``):
+every ``jax.jit(f, ...)`` call whose first argument resolves lexically
+to a function definition seeds the walk, and so does every
+``pl.pallas_call(kernel, ...)`` — a pallas kernel body IS jit-traced
+code (Mosaic lowers it inside the surrounding program); the kernel
+argument resolves as a bare name, an inline ``functools.partial``, or
+the local ``kern = functools.partial(...)`` binding idiom.
+
+Reachability is the engine's interprocedural
+:class:`~..engine.CallGraph` closure — bare-name calls (lexical
+resolution), ``self.method`` calls, ``obj.method`` calls when unique
+(or signature-narrowed) project-wide, ``jax.lax`` combinator function
+args, and nested defs (scan bodies and closures run in-graph) — so a
+host sync buried two helper modules below the jit site is found where
+it lives.  Attribute calls on unknown objects are still NOT followed —
+this pass prefers silence to guessing (docs/analysis.md).
 
 Codes: ``host-sync-in-trace``, ``side-effect-in-trace``,
 ``emit-in-trace``, ``host-clock-in-trace``.
@@ -36,7 +36,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..engine import AnalysisPass, Finding, FunctionIndex, Module
+from ..engine import (AnalysisPass, Finding, FunctionIndex, Module,
+                      get_callgraph, iter_calls)
+from ._entries import all_jit_entries
 
 #: attribute calls that force a device->host sync
 SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
@@ -51,9 +53,6 @@ EMIT_NAMES = frozenset({"emit", "emit_summary", "sample_memory",
 #: host clock reads (through a name bound to the ``time`` module)
 CLOCK_ATTRS = frozenset({"time", "perf_counter", "monotonic",
                          "process_time"})
-#: jax.lax control-flow combinators whose function args run in-trace
-LAX_COMBINATORS = frozenset({"scan", "cond", "while_loop", "fori_loop",
-                             "switch", "associative_scan", "map"})
 
 
 def _module_aliases(module: Module) -> Tuple[Set[str], Set[str], Set[str]]:
@@ -83,177 +82,20 @@ class TracePurityPass(AnalysisPass):
 
     def run(self, modules: List[Module],
             index: FunctionIndex) -> List[Finding]:
-        findings: List[Finding] = []
-        # entry discovery + closure is per module: jitted programs are
-        # built from locally visible functions in this codebase
-        for m in modules:
-            findings.extend(self._run_module(m, index))
-        return findings
-
-    # --------------------------------------------------------- discovery
-    def _jit_entries(self, module: Module,
-                     index: FunctionIndex) -> Dict[ast.AST, str]:
-        """def node -> jit-site description, for every ``jax.jit(f)``/
-        ``jit(f)`` whose first arg resolves to a local function; the
-        jit site's own lexical scope resolves the name, so a nested
-        ``train_step`` shadows any same-named method."""
-        entries: Dict[ast.AST, str] = {}
-        for node, (mod, qual, _cls, def_scope) in index.owner.items():
-            if mod is not module:
-                continue
-            scope = def_scope + (qual.split(".")[-1],)
-            for call in self._own_calls(node):
-                self._maybe_jit(call, module, index, scope, entries)
-                self._maybe_pallas(call, module, index, scope, entries,
-                                   node)
-        # module/class level (not inside any function): same walker,
-        # rooted at the module
-        for call in self._own_calls(module.tree):
-            self._maybe_jit(call, module, index, (), entries)
-            self._maybe_pallas(call, module, index, (), entries,
-                               module.tree)
-        return entries
-
-    @staticmethod
-    def _maybe_jit(node: ast.Call, module: Module, index: FunctionIndex,
-                   scope: Tuple[str, ...],
-                   entries: Dict[ast.AST, str]) -> None:
-        if not node.args:
-            return
-        fn = node.func
-        is_jit = (isinstance(fn, ast.Attribute) and fn.attr == "jit") \
-            or (isinstance(fn, ast.Name) and fn.id == "jit")
-        if not is_jit:
-            return
-        first = node.args[0]
-        if isinstance(first, ast.Name):
-            target = index.resolve_name(module, scope, first.id)
-            if target is not None:
-                entries.setdefault(target,
-                                   f"jax.jit at line {node.lineno}")
-
-    @classmethod
-    def _maybe_pallas(cls, node: ast.Call, module: Module,
-                      index: FunctionIndex, scope: Tuple[str, ...],
-                      entries: Dict[ast.AST, str],
-                      encl: ast.AST) -> None:
-        """``pl.pallas_call(kernel, ...)`` / ``pallas_call(kernel)``:
-        the kernel body is jit-reachable.  ``encl`` is the enclosing
-        function (or module) node, scanned for the local
-        ``kern = functools.partial(kernel, ...)`` binding idiom."""
-        if not node.args:
-            return
-        fn = node.func
-        is_pc = (isinstance(fn, ast.Attribute)
-                 and fn.attr == "pallas_call") \
-            or (isinstance(fn, ast.Name) and fn.id == "pallas_call")
-        if not is_pc:
-            return
-        note = f"pl.pallas_call at line {node.lineno}"
-        first = node.args[0]
-        target = None
-        if isinstance(first, ast.Name):
-            target = index.resolve_name(module, scope, first.id)
-            if target is None:
-                target = cls._partial_binding(encl, module, index, scope,
-                                              first.id)
-        elif isinstance(first, ast.Call):
-            target = cls._partial_arg(first, module, index, scope)
-        if target is not None:
-            entries.setdefault(target, note)
-
-    @staticmethod
-    def _is_partial(call: ast.Call) -> bool:
-        f = call.func
-        return (isinstance(f, ast.Name) and f.id == "partial") or \
-            (isinstance(f, ast.Attribute) and f.attr == "partial")
-
-    @classmethod
-    def _partial_arg(cls, call: ast.Call, module: Module,
-                     index: FunctionIndex,
-                     scope: Tuple[str, ...]) -> Optional[ast.AST]:
-        """The wrapped function of a ``functools.partial(f, ...)``
-        call, resolved lexically; None for anything else."""
-        if cls._is_partial(call) and call.args \
-                and isinstance(call.args[0], ast.Name):
-            return index.resolve_name(module, scope, call.args[0].id)
-        return None
-
-    @classmethod
-    def _partial_binding(cls, encl: ast.AST, module: Module,
-                         index: FunctionIndex, scope: Tuple[str, ...],
-                         var: str) -> Optional[ast.AST]:
-        """Resolve ``var`` through a local ``var = functools.partial(f,
-        ...)`` assignment in the enclosing function — the standard
-        kernel-construction idiom (pallas_scatter/_embedding)."""
-        for child in ast.walk(encl):
-            if isinstance(child, ast.Assign) \
-                    and len(child.targets) == 1 \
-                    and isinstance(child.targets[0], ast.Name) \
-                    and child.targets[0].id == var \
-                    and isinstance(child.value, ast.Call):
-                t = cls._partial_arg(child.value, module, index, scope)
-                if t is not None:
-                    return t
-        return None
-
-    def _reachable(self, entries: Dict[ast.AST, str], module: Module,
-                   index: FunctionIndex) -> Dict[ast.AST, str]:
-        """Transitive closure over in-trace calls; node -> entry note."""
-        reach: Dict[ast.AST, str] = {}
-        work = [(n, note) for n, note in entries.items()]
-        while work:
-            node, note = work.pop()
-            if node in reach:
-                continue
-            reach[node] = note
-            _mod, qual, cls, def_scope = index.owner[node]
-            scope = def_scope + (qual.split(".")[-1],)
-            # nested defs run in-graph (scan bodies, closures)
-            for child in ast.walk(node):
-                if child is node:
-                    continue
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    work.append((child, f"{note} via nested "
-                                        f"{child.name}"))
-            for call in self._own_calls(node):
-                fn = call.func
-                if isinstance(fn, ast.Name):
-                    t = index.resolve_name(module, scope, fn.id)
-                    if t is not None:
-                        work.append((t, f"{note} via {fn.id}()"))
-                elif isinstance(fn, ast.Attribute):
-                    if isinstance(fn.value, ast.Name) \
-                            and fn.value.id == "self" and cls is not None:
-                        t = index.resolve_self_method(module, cls,
-                                                      fn.attr)
-                        if t is not None:
-                            work.append(
-                                (t, f"{note} via self.{fn.attr}()"))
-                    if fn.attr in LAX_COMBINATORS:
-                        for arg in call.args:
-                            if isinstance(arg, ast.Name):
-                                t = index.resolve_name(module, scope,
-                                                       arg.id)
-                                if t is not None:
-                                    work.append(
-                                        (t, f"{note} via jax.lax."
-                                            f"{fn.attr}"))
-        return reach
-
-    # ----------------------------------------------------------- flagging
-    def _run_module(self, module: Module,
-                    index: FunctionIndex) -> List[Finding]:
-        entries = self._jit_entries(module, index)
+        entries = all_jit_entries(modules, index)
         if not entries:
             return []
-        reach = self._reachable(entries, module, index)
-        np_names, jax_names, time_names = _module_aliases(module)
+        reach = get_callgraph(modules, index).reachable(
+            entries, follow_nested=True)
+        alias_cache: Dict[str, Tuple[Set[str], Set[str], Set[str]]] = {}
         findings: List[Finding] = []
         for node, note in reach.items():
             mod, qual, _cls, _scope = index.owner[node]
-            for call in self._own_calls(node):
+            aliases = alias_cache.get(mod.name)
+            if aliases is None:
+                aliases = alias_cache[mod.name] = _module_aliases(mod)
+            np_names, jax_names, time_names = aliases
+            for call in iter_calls(node):
                 hit = self._classify(call, np_names, jax_names,
                                      time_names)
                 if hit is None:
@@ -264,22 +106,6 @@ class TracePurityPass(AnalysisPass):
                     f"{what} inside traced {qual} ({note})",
                     detail=qual))
         return findings
-
-    @staticmethod
-    def _own_calls(fn_node: ast.AST):
-        """Call nodes of this function EXCLUDING nested defs (those are
-        reachable in their own right — no double reporting)."""
-
-        def visit(node):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.Lambda)):
-                    continue
-                if isinstance(child, ast.Call):
-                    yield child
-                yield from visit(child)
-
-        yield from visit(fn_node)
 
     @staticmethod
     def _classify(call: ast.Call, np_names: Set[str],

@@ -1,0 +1,352 @@
+"""recompile-hazard pass: jit entry points must not retrace per call.
+
+Serving earned its "steady state never recompiles" contract the hard
+way: bucketed shapes, AOT builds, dtype coercion at intake.  Training
+holds the same line (one trace per epoch program).  The ways that
+contract quietly dies are all visible in the source:
+
+* ``jit-per-call`` — ``jax.jit(f)(x)`` immediately invoked: every call
+  builds a FRESH wrapper with its own empty cache, so every call
+  retraces.  The wrapper must be built once and reused.
+* ``jit-in-loop`` — ``g = jax.jit(f, ...)`` inside a ``for``/``while``
+  body rebinding a plain name: a new wrapper (and cache) per
+  iteration.  Building per-key programs into a dict
+  (``fns[b] = jax.jit(...)``) is the sanctioned warmup idiom and stays
+  silent.
+* ``data-derived-static`` — a static argument (``static_argnums`` /
+  ``static_argnames``) fed from per-call data (``len(...)``,
+  ``x.shape[...]``, ``int(...)``/``float(...)``, ``.item()``): each
+  distinct value is a new cache key — a retrace storm keyed on
+  traffic.  Static args exist for genuine configuration, not data.
+* ``unhashable-static`` — a static position receiving a list/dict/set
+  (literal at the call site, or as the wrapped function's default):
+  raises ``TypeError: unhashable type`` at the first real call.
+* ``varying-shape-arg`` — a jitted callable invoked in a loop with a
+  slice whose bounds are data-derived (``x[lo:min(lo+b, n)]``,
+  ``x[i:len(y)]``): the final partial chunk has a different shape, so
+  the loop compiles one extra program per distinct remainder — the
+  exact failure serving's zero-pad-to-bucket exists to prevent.
+
+Jitted callables are discovered like the donation pass discovers
+donating ones: ``g = jax.jit(f, ...)`` locals, ``self._step =
+jax.jit(f, ...)`` attributes (project-wide — the compiled program is
+stored on self and driven from another module), each with its static-
+argument spec resolved from literals.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import AnalysisPass, Finding, FunctionIndex, Module
+
+#: call-site expressions that mean "this value came from data"
+_DATA_FNS = frozenset({"len", "int", "float", "bool"})
+
+
+def _is_jit(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "jit") \
+        or (isinstance(fn, ast.Name) and fn.id == "jit")
+
+
+class _JitSpec:
+    """Static-argument spec of one jit site."""
+
+    __slots__ = ("argnums", "argnames", "line", "fn_node")
+
+    def __init__(self, argnums: Set[int], argnames: Set[str], line: int,
+                 fn_node: Optional[ast.AST]):
+        self.argnums = argnums
+        self.argnames = argnames
+        self.line = line
+        self.fn_node = fn_node   # the wrapped def, when resolvable
+
+
+def _literal_ints(node: ast.expr) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+        return out
+    return set()
+
+
+def _literal_strs(node: ast.expr) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)}
+    return set()
+
+
+def _jit_spec(call: ast.Call, module: Module, index: FunctionIndex,
+              scope: Tuple[str, ...]) -> Optional[_JitSpec]:
+    if not _is_jit(call):
+        return None
+    argnums: Set[int] = set()
+    argnames: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            argnums |= _literal_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            argnames |= _literal_strs(kw.value)
+    fn_node = None
+    if call.args and isinstance(call.args[0], ast.Name):
+        fn_node = index.resolve_name(module, scope, call.args[0].id)
+    return _JitSpec(argnums, argnames, call.lineno, fn_node)
+
+
+def _data_derived(expr: ast.expr) -> Optional[str]:
+    """Why this expression varies per call, or None."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _DATA_FNS:
+                return f"{f.id}(...)"
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                return ".item()"
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            return ".shape"
+    return None
+
+
+def _unhashable(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return "set"
+    return None
+
+
+def _varying_slice(expr: ast.expr) -> bool:
+    """A subscript slice whose bounds are data-derived."""
+    if not (isinstance(expr, ast.Subscript)
+            and isinstance(expr.slice, ast.Slice)):
+        return False
+    for bound in (expr.slice.lower, expr.slice.upper):
+        if bound is None:
+            continue
+        for node in ast.walk(bound):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in ("min", "max",
+                                                        "len"):
+                    return True
+            if isinstance(node, ast.Attribute) and node.attr == "shape":
+                return True
+    return False
+
+
+class RecompileHazardPass(AnalysisPass):
+    name = "recompile-hazard"
+    description = ("jit entry points whose Python-level arguments can "
+                   "vary per call (fresh wrappers, data-derived "
+                   "statics, unhashable statics, shape-varying slices) "
+                   "retrace instead of replaying")
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        # jit callables stored on self: attr -> spec (project-wide,
+        # same rationale as the donation pass)
+        attr_specs: Dict[str, _JitSpec] = {}
+        for node, (mod, qual, _cls, def_scope) in index.owner.items():
+            scope = def_scope + (qual.split(".")[-1],)
+            for child in ast.walk(node):
+                if not (isinstance(child, ast.Assign)
+                        and isinstance(child.value, ast.Call)):
+                    continue
+                spec = _jit_spec(child.value, mod, index, scope)
+                if spec is None:
+                    continue
+                for t in child.targets:
+                    if isinstance(t, ast.Attribute):
+                        attr_specs[t.attr] = spec
+
+        for node, (mod, qual, _cls, def_scope) in sorted(
+                index.owner.items(),
+                key=lambda kv: (kv[1][0].relpath,
+                                getattr(kv[0], "lineno", 0))):
+            scope = def_scope + (qual.split(".")[-1],)
+            findings.extend(self._check_function(
+                node, mod, qual, scope, index, attr_specs))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+    # ------------------------------------------------------------ per-fn
+    def _check_function(self, fn_node: ast.AST, module: Module,
+                        qual: str, scope: Tuple[str, ...],
+                        index: FunctionIndex,
+                        attr_specs: Dict[str, _JitSpec]
+                        ) -> List[Finding]:
+        findings: List[Finding] = []
+        local_specs: Dict[str, _JitSpec] = {}
+
+        def handle_jit_site(call: ast.Call, in_loop: bool,
+                            parent_assign: Optional[ast.Assign]):
+            spec = _jit_spec(call, module, index, scope)
+            if spec is None:
+                return
+            # jit(f)(x): the wrapper dies with the expression
+            # (flagged where invoked, below)
+            if parent_assign is not None:
+                tgt = parent_assign.targets[0] \
+                    if len(parent_assign.targets) == 1 else None
+                if isinstance(tgt, ast.Name):
+                    local_specs[tgt.id] = spec
+                    if in_loop:
+                        findings.append(self.finding(
+                            module.relpath, call.lineno, "jit-in-loop",
+                            f"jax.jit(...) rebuilt every iteration and "
+                            f"bound to {tgt.id!r} in {qual} — each "
+                            f"wrapper starts with an empty cache, so "
+                            f"every iteration retraces; build it once "
+                            f"outside the loop (keyed dict stores are "
+                            f"the warmup idiom and are fine)",
+                            detail=qual))
+            # mutable default in a static position of the wrapped def
+            if spec.fn_node is not None and (spec.argnums
+                                             or spec.argnames):
+                self._check_static_defaults(spec, module, qual,
+                                            findings)
+
+        def check_call_through(call: ast.Call):
+            fn = call.func
+            spec = None
+            cname = None
+            if isinstance(fn, ast.Name):
+                spec = local_specs.get(fn.id)
+                cname = fn.id
+            elif isinstance(fn, ast.Attribute):
+                spec = attr_specs.get(fn.attr)
+                cname = f".{fn.attr}"
+            if spec is None:
+                return
+            for i, arg in enumerate(call.args):
+                static = i in spec.argnums
+                if static:
+                    why = _data_derived(arg)
+                    if why is not None:
+                        findings.append(self.finding(
+                            module.relpath, call.lineno,
+                            "data-derived-static",
+                            f"static argnum {i} of {cname}() receives "
+                            f"{why} in {qual} — every distinct value "
+                            f"is a new jit cache key (retrace storm "
+                            f"keyed on data)",
+                            detail=qual))
+                    uh = _unhashable(arg)
+                    if uh is not None:
+                        findings.append(self.finding(
+                            module.relpath, call.lineno,
+                            "unhashable-static",
+                            f"static argnum {i} of {cname}() receives "
+                            f"a {uh} literal in {qual} — static args "
+                            f"are cache keys and must be hashable "
+                            f"(TypeError at the first call)",
+                            detail=qual))
+            for kw in call.keywords:
+                if kw.arg in spec.argnames:
+                    why = _data_derived(kw.value)
+                    if why is not None:
+                        findings.append(self.finding(
+                            module.relpath, call.lineno,
+                            "data-derived-static",
+                            f"static arg {kw.arg!r} of {cname}() "
+                            f"receives {why} in {qual} — every "
+                            f"distinct value is a new jit cache key",
+                            detail=qual))
+                    uh = _unhashable(kw.value)
+                    if uh is not None:
+                        findings.append(self.finding(
+                            module.relpath, call.lineno,
+                            "unhashable-static",
+                            f"static arg {kw.arg!r} of {cname}() "
+                            f"receives a {uh} literal in {qual}",
+                            detail=qual))
+
+        def check_varying_shape(call: ast.Call, in_loop: bool):
+            if not in_loop:
+                return
+            fn = call.func
+            known = (isinstance(fn, ast.Name) and fn.id in local_specs) \
+                or (isinstance(fn, ast.Attribute)
+                    and fn.attr in attr_specs)
+            if not known:
+                return
+            for arg in call.args:
+                if _varying_slice(arg):
+                    findings.append(self.finding(
+                        module.relpath, call.lineno,
+                        "varying-shape-arg",
+                        f"jitted callable invoked in a loop in {qual} "
+                        f"with a data-derived slice — the final "
+                        f"partial chunk changes shape and forces an "
+                        f"extra compile per distinct remainder; pad to "
+                        f"a bucket instead (serving's zero-pad "
+                        f"contract)",
+                        detail=qual))
+
+        def visit(node, in_loop: bool):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested defs get their own linear check
+            if isinstance(node, (ast.For, ast.While)):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, True)
+                return
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                handle_jit_site(node.value, in_loop, node)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Call) \
+                        and _is_jit(node.func):
+                    findings.append(self.finding(
+                        module.relpath, node.lineno, "jit-per-call",
+                        f"jax.jit(f)(...) immediately invoked in "
+                        f"{qual} — a fresh wrapper (and empty cache) "
+                        f"per call means a retrace per call; build "
+                        f"the wrapper once and reuse it",
+                        detail=qual))
+                check_call_through(node)
+                check_varying_shape(node, in_loop)
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_loop)
+
+        for child in ast.iter_child_nodes(fn_node):
+            visit(child, False)
+        return findings
+
+    def _check_static_defaults(self, spec: _JitSpec, module: Module,
+                               qual: str,
+                               findings: List[Finding]) -> None:
+        args = getattr(spec.fn_node, "args", None)
+        if args is None:
+            return
+        params = list(args.posonlyargs) + list(args.args)
+        names = [a.arg for a in params]
+        defaults = list(args.defaults)
+        # defaults align to the tail of the positional params
+        offset = len(params) - len(defaults)
+        for i, d in enumerate(defaults):
+            pidx = offset + i
+            pname = names[pidx] if pidx < len(names) else "?"
+            if pidx in spec.argnums or pname in spec.argnames:
+                uh = _unhashable(d)
+                if uh is not None:
+                    findings.append(self.finding(
+                        module.relpath, spec.line, "unhashable-static",
+                        f"jit static parameter {pname!r} defaults to a "
+                        f"{uh} in the wrapped function — the default "
+                        f"becomes an unhashable cache key (TypeError) "
+                        f"the first time the caller omits it",
+                        detail=qual))

@@ -1,0 +1,282 @@
+"""shared-state pass: cross-thread attribute access needs a common lock.
+
+The serving/telemetry side of the framework is multi-threaded by
+design: the DynamicBatcher dispatcher, the /metrics scrape threads, and
+(ROADMAP 4) the parameter hot-swap path all touch objects that client
+threads touch through the public API.  The working convention — earned
+through PR-5's two real serving lock bugs — is that every instance
+attribute shared between a thread body and the public API is either
+
+* written only during construction (immutable after ``__init__``),
+* a thread-safe primitive (``queue.Queue``, ``threading.Event``, ...),
+* or protected by ONE lock both sides hold.
+
+This pass machine-checks that: thread entry points are discovered from
+``threading.Thread(target=...)`` constructor sites (the target resolves
+like any call — ``self._loop``, a bare name, or a unique/signature-
+narrowed method), the attribute read/write sets reachable from them
+(interprocedural, lock-held sets carried through calls, reusing
+``locks.py``'s lock discovery) are compared against the sets reachable
+from the same classes' public methods, and an attribute touched on both
+sides — with at least one write — where some thread-side access and
+some public-side access hold NO common lock is a finding.
+
+Code: ``unlocked-shared-attr``.  The deliberate exceptions (the
+engine's double-checked bucket-cache read, GIL-atomic by construction)
+live in the waiver baseline with their justification, exactly like the
+lock-discipline ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import (AnalysisPass, Finding, FunctionIndex, Module,
+                      get_callgraph)
+from .locks import get_lock_table
+
+#: constructor callees whose instances are thread-safe by design — an
+#: attribute initialized to one of these never needs an external lock.
+THREADSAFE_CTORS = frozenset({
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event",
+    "Condition", "Semaphore", "BoundedSemaphore", "Barrier", "Lock",
+    "RLock", "local", "deque", "ThreadPoolExecutor"})
+
+#: method calls that mutate a container in place — counted as writes to
+#: the attribute holding the container.
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "update", "setdefault", "pop",
+    "popleft", "clear", "extend", "remove", "discard", "insert",
+    "sort"})
+
+_MAX_DEPTH = 8
+
+
+class _Access:
+    __slots__ = ("cls", "attr", "kind", "path", "line", "qual", "held")
+
+    def __init__(self, cls: str, attr: str, kind: str, path: str,
+                 line: int, qual: str, held: frozenset):
+        self.cls = cls
+        self.attr = attr
+        self.kind = kind        # "read" | "write"
+        self.path = path
+        self.line = line
+        self.qual = qual
+        self.held = held
+
+
+class SharedStatePass(AnalysisPass):
+    name = "shared-state"
+    description = ("attributes shared between thread bodies and the "
+                   "public API must be immutable, thread-safe, or "
+                   "guarded by a common lock")
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        self._index = index
+        self._locks = get_lock_table(modules, index)
+        self._cg = get_callgraph(modules, index)
+
+        thread_entries = self._thread_entries(modules, index)
+        if not thread_entries:
+            return []
+
+        # accesses reachable from the thread targets
+        thread_acc: List[_Access] = []
+        seen: Set[Tuple[ast.AST, frozenset]] = set()
+        for entry in thread_entries:
+            self._collect(entry, frozenset(), 0, thread_acc, seen)
+
+        # the classes a thread touches; their public surface is the
+        # other side of the race
+        classes = {a.cls for a in thread_acc}
+        public_entries = [
+            node for node, (mod, qual, cls, _s) in index.owner.items()
+            if cls in classes and not qual.split(".")[-1].startswith("_")
+            and node not in thread_entries]
+        public_acc: List[_Access] = []
+        seen = set()
+        for entry in public_entries:
+            self._collect(entry, frozenset(), 0, public_acc, seen)
+
+        exempt = self._exempt_attrs(modules)
+        by_key_t: Dict[Tuple[str, str], List[_Access]] = {}
+        for a in thread_acc:
+            by_key_t.setdefault((a.cls, a.attr), []).append(a)
+        by_key_p: Dict[Tuple[str, str], List[_Access]] = {}
+        for a in public_acc:
+            by_key_p.setdefault((a.cls, a.attr), []).append(a)
+
+        findings: List[Finding] = []
+        for key in sorted(set(by_key_t) & set(by_key_p)):
+            cls, attr = key
+            if key in exempt or attr in self._locks.attr_classes:
+                continue
+            ts, ps = by_key_t[key], by_key_p[key]
+            if not any(a.kind == "write" for a in ts + ps):
+                continue  # read-only on both sides: immutable config
+            worst: Optional[Tuple[_Access, _Access]] = None
+            for t in ts:
+                for p in ps:
+                    if t.kind != "write" and p.kind != "write":
+                        continue
+                    if t.held & p.held:
+                        continue  # a common lock covers this pair
+                    if worst is None:
+                        worst = (t, p)
+            if worst is None:
+                continue
+            t, p = worst
+            site = t if t.kind == "write" or p.kind != "write" else p
+            other = p if site is t else t
+            findings.append(self.finding(
+                site.path, site.line, "unlocked-shared-attr",
+                f"self.{attr} is {site.kind[:4]}{'ten' if site.kind == 'write' else ''} "
+                f"in {site.qual} "
+                f"({'no lock held' if not site.held else 'holding ' + '/'.join(sorted(site.held))}) "
+                f"and {other.kind} by the other side in {other.qual} at "
+                f"{other.path}:{other.line} with no common lock — "
+                f"dispatcher thread and public API race on {cls}.{attr}",
+                detail=f"{cls}.{attr}"))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+    # ------------------------------------------------------------ discovery
+    @staticmethod
+    def _is_thread_ctor(call: ast.Call) -> bool:
+        fn = call.func
+        return (isinstance(fn, ast.Attribute) and fn.attr == "Thread") \
+            or (isinstance(fn, ast.Name) and fn.id == "Thread")
+
+    def _thread_entries(self, modules: List[Module],
+                        index: FunctionIndex) -> Set[ast.AST]:
+        """Targets of every ``threading.Thread(target=...)`` site."""
+        entries: Set[ast.AST] = set()
+        for node, (mod, qual, cls, def_scope) in index.owner.items():
+            scope = def_scope + (qual.split(".")[-1],)
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) \
+                        or not self._is_thread_ctor(call):
+                    continue
+                target = None
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and call.args:
+                    target = call.args[0]
+                if target is None:
+                    continue
+                t = None
+                if isinstance(target, ast.Name):
+                    t = index.resolve_name(mod, scope, target.id)
+                elif isinstance(target, ast.Attribute):
+                    if isinstance(target.value, ast.Name) \
+                            and target.value.id == "self" \
+                            and cls is not None:
+                        t = index.resolve_self_method(mod, cls,
+                                                      target.attr)
+                    if t is None:
+                        t = index.resolve_unique_method(target.attr)
+                if t is not None:
+                    entries.add(t)
+        return entries
+
+    def _exempt_attrs(self, modules: List[Module]
+                      ) -> Set[Tuple[str, str]]:
+        """(class, attr) initialized to a thread-safe primitive."""
+        out: Set[Tuple[str, str]] = set()
+        for m in modules:
+            for cls in ast.walk(m.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                for node in ast.walk(cls):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    fn = node.value.func
+                    ctor = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                    if ctor not in THREADSAFE_CTORS:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            out.add((cls.name, t.attr))
+        return out
+
+    # ----------------------------------------------------------- collection
+    def _collect(self, fn_node: ast.AST, inherited: frozenset,
+                 depth: int, out: List[_Access],
+                 seen: Set[Tuple[ast.AST, frozenset]]) -> None:
+        """Record every ``self.X`` access reachable from ``fn_node``
+        with the lock set held at that point (caller-held locks carried
+        into callees — that is what makes the InferenceEngine's
+        under-lock write visible as locked even when the lock was taken
+        one frame up)."""
+        if depth > _MAX_DEPTH or (fn_node, inherited) in seen \
+                or fn_node not in self._index.owner:
+            return
+        seen.add((fn_node, inherited))
+        mod, qual, cls, def_scope = self._index.owner[fn_node]
+        if qual.split(".")[-1] in ("__init__", "__new__"):
+            return  # construction runs before any thread exists
+        scope = def_scope + (qual.split(".")[-1],)
+
+        def visit(node, held: frozenset):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # deferred body: runs later, locks released
+            if isinstance(node, ast.With):
+                cur = held
+                for item in node.items:
+                    lid = self._locks.resolve(item.context_expr, mod,
+                                              cls)
+                    if lid is not None:
+                        cur = cur | {lid}
+                    else:
+                        visit(item.context_expr, cur)
+                for stmt in node.body:
+                    visit(stmt, cur)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and cls is not None:
+                kind = "write" if isinstance(node.ctx,
+                                             (ast.Store, ast.Del)) \
+                    else "read"
+                out.append(_Access(cls, node.attr, kind, mod.relpath,
+                                   node.lineno, qual, held))
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and isinstance(node.value.value, ast.Name) \
+                    and node.value.value.id == "self" \
+                    and cls is not None:
+                # self._cache[k] = v mutates the container
+                out.append(_Access(cls, node.value.attr, "write",
+                                   mod.relpath, node.lineno, qual,
+                                   held))
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr in MUTATORS \
+                        and isinstance(fn.value, ast.Attribute) \
+                        and isinstance(fn.value.value, ast.Name) \
+                        and fn.value.value.id == "self" \
+                        and cls is not None:
+                    # self._buf.append(x) mutates the container
+                    out.append(_Access(cls, fn.value.attr, "write",
+                                       mod.relpath, node.lineno, qual,
+                                       held))
+                target = self._index.resolve_call(node, mod, scope, cls)
+                if target is not None and target is not fn_node:
+                    self._collect(target, held, depth + 1, out, seen)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(fn_node):
+            visit(child, inherited)

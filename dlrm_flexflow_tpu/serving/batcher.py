@@ -77,9 +77,14 @@ class ServeFuture:
     def result(self, timeout: Optional[float] = None):
         if not self._ev.wait(timeout):
             raise TimeoutError("serve result not ready")
-        if self._exc is not None:
-            raise self._exc
-        return self._value
+        # read under the same lock the writers hold (ffcheck
+        # shared-state): Event.wait's happens-before already makes the
+        # unlocked read correct today, but the lock states the contract
+        # in code and costs one uncontended acquire per request
+        with self._lk:
+            if self._exc is not None:
+                raise self._exc
+            return self._value
 
 
 class _Request:
@@ -464,9 +469,14 @@ class DynamicBatcher:
         if self._thread is None or not self._thread.is_alive():
             # never started (autostart=False): with drain, bring the
             # dispatcher up so close() keeps its deliver-everything
-            # contract
-            if drain and (self._carry is not None
-                          or not self._q.empty()):
+            # contract.  The carry peek takes the intake lock like every
+            # other _carry access (ffcheck shared-state): with no
+            # dispatcher alive nobody races it today, but an unlocked
+            # read is exactly the idiom that rots when the code around
+            # it moves
+            with self._intake_lock:
+                has_carry = self._carry is not None
+            if drain and (has_carry or not self._q.empty()):
                 self.start()
         if self._thread is not None and self._thread.is_alive():
             self._q.put(_STOP)

@@ -376,21 +376,34 @@ def span_summary(events: List[dict]) -> List[str]:
     return lines
 
 
-def find_analysis_artifact(near: str = ".") -> Optional[str]:
-    """The newest ``artifacts/analysis_*.json`` sink (ffcheck output,
-    ``python -m dlrm_flexflow_tpu.analysis -o ...``) near a run:
-    looked up under ``<near>/artifacts`` and ``./artifacts``; None when
-    no analyzer run left one."""
+def find_analysis_artifacts(near: str = ".") -> List[str]:
+    """Every ``artifacts/analysis_*.json`` sink (ffcheck output,
+    ``python -m dlrm_flexflow_tpu.analysis -o ...``) near a run —
+    looked up under ``<near>/artifacts`` and ``./artifacts`` — newest
+    first.  Index 0 is the run to report; index 1 (when present) is
+    the previous run the ``== analysis ==`` delta compares against."""
     import glob
 
     cands: List[str] = []
+    seen = set()
     for base in dict.fromkeys((near or ".", ".")):
-        cands.extend(glob.glob(os.path.join(base, "artifacts",
-                                            "analysis_*.json")))
-    cands = [p for p in cands if os.path.isfile(p)]
-    if not cands:
-        return None
-    return max(cands, key=os.path.getmtime)
+        for p in glob.glob(os.path.join(base, "artifacts",
+                                        "analysis_*.json")):
+            # dedupe by REAL path: `near` spelled absolutely while
+            # CWD is the same directory must not list (and delta
+            # against) the same sink twice under two spellings
+            real = os.path.realpath(p)
+            if real in seen or not os.path.isfile(p):
+                continue
+            seen.add(real)
+            cands.append(p)
+    return sorted(cands, key=os.path.getmtime, reverse=True)
+
+
+def find_analysis_artifact(near: str = ".") -> Optional[str]:
+    """The newest sink, or None when no analyzer run left one."""
+    found = find_analysis_artifacts(near)
+    return found[0] if found else None
 
 
 def load_analysis(path: str) -> Optional[dict]:
@@ -405,9 +418,63 @@ def load_analysis(path: str) -> Optional[dict]:
         else None
 
 
-def analysis_summary(doc: dict, src: str) -> List[str]:
-    """The ``== analysis ==`` section: one ffcheck headline plus the
-    first few findings/stale waivers when the run was not clean."""
+def _per_pass_counts(doc: dict) -> Dict[str, Dict[str, int]]:
+    """``by_pass`` from the sink (ffcheck v2 writes it), reconstructed
+    from the finding lists for pre-v2 sinks so the delta still works."""
+    bp = doc.get("by_pass")
+    if isinstance(bp, dict) and bp:
+        return {k: {"findings": int(v.get("findings", 0)),
+                    "waived": int(v.get("waived", 0))}
+                for k, v in bp.items()}
+    out: Dict[str, Dict[str, int]] = {
+        p: {"findings": 0, "waived": 0} for p in doc.get("passes", [])}
+    for f in doc.get("findings", []):
+        out.setdefault(f.get("pass", "?"),
+                       {"findings": 0, "waived": 0})["findings"] += 1
+    for f in doc.get("waived", []):
+        out.setdefault(f.get("pass", "?"),
+                       {"findings": 0, "waived": 0})["waived"] += 1
+    return out
+
+
+def comparable_sinks(doc: dict, prev: dict) -> bool:
+    """Two sinks delta meaningfully only when they cover the same
+    scope: a ``--changed-only`` run's counts are filtered by the diff,
+    so comparing it against a full-tree run (or a differently-scoped
+    one) reports movement that is pure scope, not change."""
+    return doc.get("changed_only") == prev.get("changed_only")
+
+
+def analysis_delta(doc: dict, prev: dict) -> Dict[str, object]:
+    """This run vs the previous sink: total finding/waived deltas plus
+    the per-pass breakdown for passes whose counts moved (a pass absent
+    from one side counts as zero — a NEW pass's findings are a delta,
+    not a blind spot).  Callers gate on :func:`comparable_sinks` —
+    scoped and full-tree runs must not delta against each other."""
+    cur, old = _per_pass_counts(doc), _per_pass_counts(prev)
+    per_pass: Dict[str, Dict[str, int]] = {}
+    for name in sorted(set(cur) | set(old)):
+        c = cur.get(name, {"findings": 0, "waived": 0})
+        o = old.get(name, {"findings": 0, "waived": 0})
+        df = c["findings"] - o["findings"]
+        dw = c["waived"] - o["waived"]
+        if df or dw:
+            per_pass[name] = {"findings": df, "waived": dw}
+    cs, os_ = doc.get("summary", {}), prev.get("summary", {})
+    return {
+        "findings": int(cs.get("findings", 0)) - int(os_.get("findings", 0)),
+        "waived": int(cs.get("waived", 0)) - int(os_.get("waived", 0)),
+        "per_pass": per_pass,
+    }
+
+
+def analysis_summary(doc: dict, src: str,
+                     prev: Optional[Tuple[dict, str]] = None
+                     ) -> List[str]:
+    """The ``== analysis ==`` section: one ffcheck headline, per-pass
+    finding counts, the delta vs the previous sink (when one exists),
+    plus the first few findings/stale waivers when the run was not
+    clean."""
     s = doc.get("summary", {})
     lines = ["== analysis =="]
     status = "OK" if s.get("ok") else "FAIL"
@@ -416,6 +483,22 @@ def analysis_summary(doc: dict, src: str) -> List[str]:
                  f"{s.get('unused_waivers', 0)} stale waiver(s); "
                  f"{len(doc.get('passes', []))} passes over "
                  f"{doc.get('modules', '?')} modules ({src})")
+    per = _per_pass_counts(doc)
+    if per:
+        lines.append("per-pass: " + ", ".join(
+            f"{name} {c['findings']}"
+            + (f" (+{c['waived']} waived)" if c["waived"] else "")
+            for name, c in sorted(per.items())))
+    if prev is not None:
+        pdoc, psrc = prev
+        d = analysis_delta(doc, pdoc)
+        moved = ", ".join(
+            f"{name} {v['findings']:+d}/{v['waived']:+d}"
+            for name, v in d["per_pass"].items())
+        lines.append(
+            f"delta vs {os.path.basename(psrc)}: "
+            f"findings {d['findings']:+d}, waived {d['waived']:+d}"
+            + (f" ({moved})" if moved else ""))
     shown = 0
     for f in doc.get("findings", []):
         if shown >= 8:
@@ -446,7 +529,7 @@ SECTIONS = (
 
 
 def format_report(events: List[dict],
-                  analysis: Optional[Tuple[dict, str]] = None) -> str:
+                  analysis: Optional[Tuple] = None) -> str:
     if not events and analysis is None:
         return "(no events)"
     by = _by_type(events)
@@ -471,17 +554,26 @@ def format_report(events: List[dict],
 
 
 def _attach_analysis(out: Dict[str, object],
-                     analysis: Optional[Tuple[dict, str]]) -> None:
+                     analysis: Optional[Tuple]) -> None:
     """THE analysis-key attach (both report_data exits use it, so the
-    shape cannot drift between the empty- and populated-run paths)."""
+    shape cannot drift between the empty- and populated-run paths).
+    ``analysis`` is ``(doc, src)`` or ``(doc, src, (prev_doc,
+    prev_src))`` — same tuple the text renderer takes, so the JSON
+    form carries the identical per-pass counts and delta."""
     if analysis is not None:
-        doc, src = analysis
-        out["analysis"] = {**doc.get("summary", {}), "source": src,
-                           "lines": analysis_summary(doc, src)[1:]}
+        doc, src = analysis[0], analysis[1]
+        prev = analysis[2] if len(analysis) > 2 else None
+        data = {**doc.get("summary", {}), "source": src,
+                "per_pass": _per_pass_counts(doc),
+                "lines": analysis_summary(doc, src, prev)[1:]}
+        if prev is not None:
+            data["delta"] = {**analysis_delta(doc, prev[0]),
+                             "previous": prev[1]}
+        out["analysis"] = data
 
 
 def report_data(events: List[dict],
-                analysis: Optional[Tuple[dict, str]] = None
+                analysis: Optional[Tuple] = None
                 ) -> Dict[str, object]:
     """The ``--format json`` object: one ``run`` header plus, for every
     section the text report would print, that section's lines as
@@ -597,13 +689,22 @@ def main(argv=None) -> int:
     if args.cmd == "report":
         events = load_events(args.path, strict=args.strict)
         # the == analysis == section rides along when an ffcheck sink
-        # (artifacts/analysis_*.json) sits next to the run or the CWD
+        # (artifacts/analysis_*.json) sits next to the run or the CWD;
+        # the second-newest sink (when present) feeds the delta line
         analysis = None
-        apath = find_analysis_artifact(os.path.dirname(args.path) or ".")
-        if apath is not None:
-            doc = load_analysis(apath)
+        sinks = find_analysis_artifacts(os.path.dirname(args.path)
+                                        or ".")
+        if sinks:
+            doc = load_analysis(sinks[0])
             if doc is not None:
-                analysis = (doc, apath)
+                prev = None
+                for p in sinks[1:]:
+                    pdoc = load_analysis(p)
+                    if pdoc is not None and comparable_sinks(doc, pdoc):
+                        prev = (pdoc, p)
+                        break
+                analysis = (doc, sinks[0], prev) if prev is not None \
+                    else (doc, sinks[0])
         if args.format == "json":
             print(json.dumps(report_data(events, analysis=analysis),
                              indent=1, default=str))

@@ -4,7 +4,7 @@ End-to-end scenarios for the static-analysis suite — the analysis
 analogue of ``check_serving.py``/``check_observability.py``
 (docs/analysis.md):
 
-  1. repo clean-or-waived — all passes over the real tree with the
+  1. repo clean-or-waived — all 7 passes over the real tree with the
      committed ``ANALYSIS_WAIVERS.txt`` report zero unwaived findings
      and zero stale waivers (the CI gate);
   2. injected violation — an emit-under-lock snippet seeded into a
@@ -13,7 +13,12 @@ analogue of ``check_serving.py``/``check_observability.py``
      (exemptions must not outlive their findings);
   4. JSON round-trip — the ``--format json`` object reconstructs the
      same findings (``Finding.from_dict``) with identical waiver keys,
-     and its summary agrees with the result.
+     and its summary agrees with the result;
+  5. changed-only scope — the same seeded violation reports when its
+     file is in scope and stays silent when only the clean file is
+     (the CI annotate-the-diff path);
+  6. baseline update — regeneration keeps justifications verbatim,
+     and REFUSES over an active unwaived finding.
 
 Exit 0 when every scenario passes; prints one line per scenario and
 exits 1 otherwise.
@@ -28,8 +33,10 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from dlrm_flexflow_tpu.analysis import (Finding, Waivers,  # noqa: E402
-                                        default_waivers, run_analysis)
+from dlrm_flexflow_tpu.analysis import (BaselineError,  # noqa: E402
+                                        Finding, Waivers,
+                                        default_waivers, run_analysis,
+                                        update_baseline)
 
 #: a lock-discipline violation, byte-for-byte what a careless producer
 #: would write: telemetry emitted while the instance lock is held
@@ -140,11 +147,64 @@ def scenario_json_roundtrip() -> str:
     return ""
 
 
+def scenario_changed_only() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, BAD_SNIPPET)
+        clean = "dlrm_flexflow_tpu/serving/clean.py"
+        with open(os.path.join(root, clean), "w") as f:
+            f.write("x = 1\n")
+        out = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"],
+                           only_paths=[clean])
+        if not out.ok or out.findings:
+            return ("violation outside the changed set still "
+                    "reported — scope filter leaks")
+        out = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"],
+                           only_paths=[rel])
+        if out.ok or not out.findings:
+            return "violation in the changed set was filtered away"
+        if out.to_dict().get("changed_only") != [rel]:
+            return "sink JSON does not record the changed-only scope"
+    return ""
+
+
+def scenario_update_baseline() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, BAD_SNIPPET)
+        key = f"lock-discipline:{rel}:Broken.bump:emit-under-lock"
+        wfile = os.path.join(root, "W.txt")
+        with open(wfile, "w") as f:
+            f.write(f"# why\n{key} | deliberate smoke fixture\n")
+        waivers = Waivers.load(wfile)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"],
+                           waivers=waivers)
+        kept = update_baseline(res, waivers, wfile)
+        if kept != [key]:
+            return f"regeneration kept {kept}, wanted [{key}]"
+        text = open(wfile).read()
+        if "deliberate smoke fixture" not in text or "# why" not in text:
+            return "justification/comment not preserved verbatim"
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["lock-discipline"])
+        try:
+            update_baseline(res, None, wfile)
+        except BaselineError:
+            pass  # refusal over the unwaived finding: correct
+        else:
+            return ("update over an unwaived finding minted a waiver "
+                    "line instead of refusing")
+    return ""
+
+
 SCENARIOS = [
     ("repo clean or waived", scenario_repo_clean),
     ("injected violation fires", scenario_injected_violation),
     ("stale waiver fails", scenario_stale_waiver),
     ("json round-trip", scenario_json_roundtrip),
+    ("changed-only scope", scenario_changed_only),
+    ("baseline update", scenario_update_baseline),
 ]
 
 

@@ -20,20 +20,32 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from dlrm_flexflow_tpu.analysis import (Finding, FunctionIndex,  # noqa: E402
-                                        Waivers, WaiverError,
-                                        default_waivers, load_modules,
-                                        run_analysis)
+from dlrm_flexflow_tpu.analysis import (BaselineError,  # noqa: E402
+                                        CallGraph, Finding,
+                                        FunctionIndex, Waivers,
+                                        WaiverError, default_waivers,
+                                        get_callgraph, load_modules,
+                                        run_analysis, to_sarif,
+                                        update_baseline)
 from dlrm_flexflow_tpu.analysis.__main__ import main as cli_main  # noqa: E402
 from dlrm_flexflow_tpu.analysis.passes import (DonationSafetyPass,  # noqa: E402
                                                ImportLayeringPass,
                                                LockDisciplinePass,
-                                               TracePurityPass)
-from dlrm_flexflow_tpu.telemetry.report import (analysis_summary,  # noqa: E402
+                                               RecompileHazardPass,
+                                               SharedStatePass,
+                                               TracePurityPass,
+                                               TraceStalenessPass)
+from dlrm_flexflow_tpu.telemetry.report import (analysis_delta,  # noqa: E402
+                                                analysis_summary,
                                                 find_analysis_artifact,
+                                                find_analysis_artifacts,
                                                 format_report,
                                                 load_analysis,
                                                 report_data)
+
+ALL_PASSES = ["donation-safety", "import-layering", "lock-discipline",
+              "recompile-hazard", "shared-state", "trace-purity",
+              "trace-staleness"]
 
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
 
@@ -467,6 +479,504 @@ class TestImportLayering:
         assert [f for f in fs if f.code == "unmapped-module"] == []
 
 
+# -------------------------------------------------- interprocedural engine
+class TestCallGraphFixedPoint:
+    def _graph(self, tmp_path, files):
+        root = _tree(tmp_path, files)
+        roots = sorted({rel.split("/")[0] for rel in files})
+        modules = load_modules(roots=roots, repo=root)
+        index = FunctionIndex(modules)
+        return index, get_callgraph(modules, index)
+
+    @staticmethod
+    def _nodes(index):
+        return {qual: node
+                for node, (_m, qual, _c, _s) in index.owner.items()}
+
+    def test_diamond_propagates_union_once(self, tmp_path):
+        index, cg = self._graph(tmp_path, {"pkg/a.py": (
+            "def d():\n    pass\n"
+            "def b():\n    d()\n"
+            "def c():\n    d()\n"
+            "def a():\n    b()\n    c()\n")})
+        n = self._nodes(index)
+        s = cg.propagate({n["d"]: {"X"}, n["b"]: {"B"}})
+        assert s[n["a"]] == {"X", "B"}   # both arms, fact X only once
+        assert s[n["b"]] == {"X", "B"}
+        assert s[n["c"]] == {"X"}
+        assert s[n["d"]] == {"X"}
+
+    def test_mutual_recursion_converges(self, tmp_path):
+        index, cg = self._graph(tmp_path, {"pkg/r.py": (
+            "def a(n):\n    return b(n)\n"
+            "def b(n):\n    return a(n - 1)\n"
+            "def lone():\n    pass\n")})
+        n = self._nodes(index)
+        s = cg.propagate({n["a"]: {"A"}, n["b"]: {"B"},
+                          n["lone"]: {"L"}})
+        assert s[n["a"]] == {"A", "B"}
+        assert s[n["b"]] == {"A", "B"}
+        assert s[n["lone"]] == {"L"}  # the cycle stays contained
+
+    def test_depth_bound_is_call_hops(self, tmp_path):
+        src = "def f5():\n    pass\n" + "".join(
+            f"def f{i}():\n    f{i + 1}()\n" for i in range(4, -1, -1))
+        index, cg = self._graph(tmp_path, {"pkg/chain.py": src})
+        n = self._nodes(index)
+        local = {n["f5"]: {"X"}}
+        shallow = cg.propagate(local, depth=3)
+        assert "X" not in shallow[n["f0"]]   # 5 hops away, bound 3
+        assert "X" in shallow[n["f2"]]       # exactly 3 hops
+        deep = cg.propagate(local, depth=5)
+        assert "X" in deep[n["f0"]]
+
+    def test_reachable_depth_and_notes(self, tmp_path):
+        index, cg = self._graph(tmp_path, {"pkg/c.py": (
+            "def h():\n    pass\n"
+            "def g():\n    h()\n"
+            "def f():\n    g()\n")})
+        n = self._nodes(index)
+        reach = cg.reachable({n["f"]: "entry"}, depth=1)
+        assert n["g"] in reach and n["h"] not in reach
+        reach = cg.reachable({n["f"]: "entry"}, depth=5)
+        assert reach[n["h"]] == "entry via g() via h()"
+
+    def test_signature_narrowed_method_resolution(self, tmp_path):
+        # two classes define ping(); only one accepts the call's
+        # keyword — ambiguity resolves instead of giving up
+        index, cg = self._graph(tmp_path, {"pkg/m.py": (
+            "class A:\n"
+            "    def ping(self, x, q=0):\n"
+            "        return x\n"
+            "class B:\n"
+            "    def ping(self):\n"
+            "        return 0\n"
+            "def drive(obj):\n"
+            "    return obj.ping(1, q=2)\n")})
+        n = self._nodes(index)
+        targets = [t for t, _ln, _nm in cg.edges[n["drive"]]]
+        assert targets == [n["A.ping"]]
+
+
+# ------------------------------------------------------------ trace-staleness
+class TestTraceStaleness:
+    def test_pr6_interpret_after_trace_idiom_fires(self, tmp_path):
+        # THE PR-6 round-4 bug, as a named fixture: a dispatch flag
+        # read at trace time inside an op forward, toggled by script
+        # code after the fact — the toggle silently no-ops against the
+        # jit cache, so the A/B compared the emitter to itself
+        fs = _run_pass(tmp_path, {
+            "dlrm_flexflow_tpu/ops/fake.py": (
+                "class FakeOp:\n"
+                "    def __init__(self):\n"
+                "        self._interpret = False\n"
+                "    def forward(self, params, xs):\n"
+                "        if self._interpret:\n"
+                "            return [xs]\n"
+                "        return [xs]\n"),
+            "scripts/toggle.py": (
+                "def check(op, x):\n"
+                "    a = op.forward(None, x)\n"
+                "    op._interpret = True\n"
+                "    b = op.forward(None, x)\n"
+                "    return a, b\n")},
+            TraceStalenessPass)
+        hits = [f for f in fs if f.code == "stale-attr-read"]
+        assert len(hits) == 1
+        assert hits[0].path == "dlrm_flexflow_tpu/ops/fake.py"
+        assert hits[0].line == 5
+        assert "_interpret" in hits[0].message
+        assert "scripts/toggle.py:3" in hits[0].message
+
+    def test_fires_env_read_in_jitted(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/a.py": (
+            "import jax\n"
+            "import os\n"
+            "def step(x):\n"
+            "    if os.environ.get('K'):\n"
+            "        return x\n"
+            "    return x + 1\n"
+            "f = jax.jit(step)\n")}, TraceStalenessPass)
+        assert _codes(fs) == ["env-read-in-trace"]
+        assert fs[0].line == 4
+
+    def test_fires_env_derived_module_constant(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "import jax\n"
+            "import os\n"
+            "_IMPL = os.environ.get('I', 'auto')\n"
+            "def step(x):\n"
+            "    return x if _IMPL == 'auto' else -x\n"
+            "f = jax.jit(step)\n")}, TraceStalenessPass)
+        assert _codes(fs) == ["env-read-in-trace"]
+        assert "_IMPL" in fs[0].message
+
+    def test_fires_rebound_global(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import jax\n"
+            "_MODE = 'a'\n"
+            "def set_mode(m):\n"
+            "    global _MODE\n"
+            "    _MODE = m\n"
+            "def step(x):\n"
+            "    return x if _MODE == 'a' else -x\n"
+            "f = jax.jit(step)\n")}, TraceStalenessPass)
+        assert _codes(fs) == ["stale-global-read"]
+        assert fs[0].line == 7 and "_MODE" in fs[0].message
+
+    def test_silent_init_only_attr(self, tmp_path):
+        # an attribute assigned only during construction is the value
+        # the trace is SUPPOSED to capture
+        fs = _run_pass(tmp_path, {"dlrm_flexflow_tpu/ops/ok.py": (
+            "class NiceOp:\n"
+            "    def __init__(self, dim):\n"
+            "        self.dim = dim\n"
+            "    def forward(self, params, xs):\n"
+            "        return [xs[: self.dim]]\n")},
+            TraceStalenessPass)
+        assert fs == []
+
+    def test_silent_env_read_on_host_side(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/h.py": (
+            "import jax\n"
+            "import os\n"
+            "def step(x):\n"
+            "    return x + 1\n"
+            "f = jax.jit(step)\n"
+            "def driver(x):\n"
+            "    if os.environ.get('DEBUG'):\n"
+            "        return f(x)\n"
+            "    return None\n")}, TraceStalenessPass)
+        assert fs == []
+
+    def test_silent_setup_phase_writer(self, tmp_path):
+        # compile()-phase assignment is pre-trace by contract
+        fs = _run_pass(tmp_path, {"dlrm_flexflow_tpu/ops/s.py": (
+            "class TuneOp:\n"
+            "    def __init__(self):\n"
+            "        self._plan = None\n"
+            "    def compile(self, plan):\n"
+            "        self._plan = plan\n"
+            "    def forward(self, params, xs):\n"
+            "        return [xs] if self._plan is None else [xs]\n")},
+            TraceStalenessPass)
+        assert fs == []
+
+    def test_silent_stable_global(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/g.py": (
+            "import jax\n"
+            "_SCALE = 4\n"
+            "def step(x):\n"
+            "    return x * _SCALE\n"
+            "f = jax.jit(step)\n")}, TraceStalenessPass)
+        assert fs == []
+
+
+# -------------------------------------------------------------- shared-state
+class TestSharedState:
+    def test_fires_unlocked_counter(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/w.py": (
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.n += 1\n"
+            "    def count(self):\n"
+            "        return self.n\n")}, SharedStatePass)
+        assert _codes(fs) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "W.n"
+
+    def test_fires_one_sided_lock(self, tmp_path):
+        # locking the writer but not the public reader is half a lock
+        fs = _run_pass(tmp_path, {"pkg/v.py": (
+            "import threading\n"
+            "class V:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.buf = []\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.buf = self.buf + [1]\n"
+            "    def snapshot(self):\n"
+            "        return list(self.buf)\n")}, SharedStatePass)
+        assert _codes(fs) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "V.buf"
+        assert "V._lock" in fs[0].message
+
+    def test_silent_common_lock(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.buf = []\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.buf = self.buf + [1]\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return list(self.buf)\n")}, SharedStatePass)
+        assert fs == []
+
+    def test_silent_threadsafe_queue_and_readonly_config(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/q.py": (
+            "import queue\n"
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self, depth):\n"
+            "        self.depth = depth\n"
+            "        self._q = queue.Queue(maxsize=depth)\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        while True:\n"
+            "            item = self._q.get()\n"
+            "            if item is None or self.depth == 0:\n"
+            "                return\n"
+            "    def submit(self, item):\n"
+            "        if self.depth > 0:\n"
+            "            self._q.put(item)\n")}, SharedStatePass)
+        assert fs == []
+
+    def test_lock_held_through_call_chain(self, tmp_path):
+        # the lock taken one frame up still covers the helper's access
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import threading\n"
+            "class D:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = {}\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _apply(self, k):\n"
+            "        self.state[k] = 1\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._apply('x')\n"
+            "    def write(self, k):\n"
+            "        with self._lock:\n"
+            "            self._apply(k)\n")}, SharedStatePass)
+        assert fs == []
+
+
+# ----------------------------------------------------------- recompile-hazard
+class TestRecompileHazard:
+    def test_fires_jit_per_call(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/a.py": (
+            "import jax\n"
+            "def run(g, x):\n"
+            "    return jax.jit(g)(x)\n")}, RecompileHazardPass)
+        assert _codes(fs) == ["jit-per-call"]
+        assert fs[0].line == 3
+
+    def test_fires_jit_in_loop(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import jax\n"
+            "def run(h, xs):\n"
+            "    out = []\n"
+            "    for x in xs:\n"
+            "        g = jax.jit(h)\n"
+            "        out.append(g(x))\n"
+            "    return out\n")}, RecompileHazardPass)
+        assert _codes(fs) == ["jit-in-loop"]
+
+    def test_fires_data_derived_and_unhashable_static(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "import jax\n"
+            "def g(x, n, cfg=None):\n"
+            "    return x\n"
+            "def drive(x, data):\n"
+            "    f = jax.jit(g, static_argnums=(1, 2))\n"
+            "    a = f(x, len(data), 3)\n"
+            "    b = f(x, 4, [1, 2])\n"
+            "    return a, b\n")}, RecompileHazardPass)
+        assert _codes(fs) == ["data-derived-static",
+                              "unhashable-static"]
+        by_code = {f.code: f for f in fs}
+        assert by_code["data-derived-static"].line == 6
+        assert by_code["unhashable-static"].line == 7
+
+    def test_fires_static_attr_call_from_other_module(self, tmp_path):
+        # the model.py idiom: jitted program stored on self, driven
+        # elsewhere — the static spec travels with the attribute
+        fs = _run_pass(tmp_path, {
+            "pkg/m.py": (
+                "import jax\n"
+                "def g(s, x, n):\n"
+                "    return s\n"
+                "class M:\n"
+                "    def compile(self):\n"
+                "        self._step = jax.jit(g, static_argnums=(2,))\n"),
+            "pkg/loop.py": (
+                "def drive(model, s, xs):\n"
+                "    return model._step(s, xs, xs.shape[0])\n")},
+            RecompileHazardPass)
+        assert _codes(fs) == ["data-derived-static"]
+        assert fs[0].path == "pkg/loop.py"
+
+    def test_fires_varying_slice_in_loop(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "def g(x):\n"
+            "    return x\n"
+            "def drive(x, n, b):\n"
+            "    f = jax.jit(g)\n"
+            "    out = []\n"
+            "    for lo in range(0, n, b):\n"
+            "        out.append(f(x[lo:min(lo + b, n)]))\n"
+            "    return out\n")}, RecompileHazardPass)
+        assert _codes(fs) == ["varying-shape-arg"]
+
+    def test_silent_warmup_dict_and_constant_static(self, tmp_path):
+        # per-bucket warmup stores into a keyed dict — the sanctioned
+        # idiom; constant statics and constant-bound slices are stable
+        fs = _run_pass(tmp_path, {"pkg/e.py": (
+            "import jax\n"
+            "def g(x, n):\n"
+            "    return x\n"
+            "def warmup(buckets):\n"
+            "    fns = {}\n"
+            "    for b in buckets:\n"
+            "        fns[b] = jax.jit(g, static_argnums=(1,))\n"
+            "    return fns\n"
+            "def drive(x):\n"
+            "    f = jax.jit(g, static_argnums=(1,))\n"
+            "    for _ in range(3):\n"
+            "        x = f(x[0:8], 4)\n"
+            "    return x\n")}, RecompileHazardPass)
+        assert fs == []
+
+    def test_silent_nonstatic_data_arg(self, tmp_path):
+        # len() into a TRACED position is fine — it is an array value
+        fs = _run_pass(tmp_path, {"pkg/f.py": (
+            "import jax\n"
+            "def g(x, n):\n"
+            "    return x * n\n"
+            "def drive(x, data):\n"
+            "    f = jax.jit(g)\n"
+            "    return f(x, len(data))\n")}, RecompileHazardPass)
+        assert fs == []
+
+
+# --------------------------------------------------------- baseline + sarif
+class TestBaselineAndSarif:
+    def test_update_baseline_preserves_and_prunes(self, tmp_path):
+        root = _tree(tmp_path, TestWaivers.BAD)
+        live = TestWaivers.KEY
+        stale = "lock-discipline:pkg/gone.py:D.g:emit-under-lock"
+        wfile = tmp_path / "W.txt"
+        wfile.write_text(
+            f"# live entry comment\n{live} | fixture: deliberate\n\n"
+            f"{stale} | long gone\n")
+        waivers = Waivers.load(str(wfile))
+        res = run_analysis(repo=root, roots=["pkg"],
+                           pass_names=["lock-discipline"],
+                           waivers=waivers)
+        kept = update_baseline(res, waivers, str(wfile))
+        assert kept == [live]
+        text = wfile.read_text()
+        assert f"{live} | fixture: deliberate" in text
+        assert "# live entry comment" in text
+        assert stale not in text
+        # the regenerated file parses and still waives the finding
+        res2 = run_analysis(repo=root, roots=["pkg"],
+                            pass_names=["lock-discipline"],
+                            waivers=Waivers.load(str(wfile)))
+        assert res2.ok and len(res2.waived) == 1
+
+    def test_update_baseline_refuses_unwaived(self, tmp_path):
+        root = _tree(tmp_path, TestWaivers.BAD)
+        res = run_analysis(repo=root, roots=["pkg"],
+                           pass_names=["lock-discipline"])
+        with pytest.raises(BaselineError) as ei:
+            update_baseline(res, None, str(tmp_path / "W.txt"))
+        assert TestWaivers.KEY in str(ei.value)
+        assert not (tmp_path / "W.txt").exists()
+
+    def test_sarif_shape(self, repo_result):
+        doc = to_sarif(repo_result)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "ffcheck"
+        results = run["results"]
+        assert len(results) == (len(repo_result.findings)
+                                + len(repo_result.waived))
+        keys = {f.waiver_key for f, _j in repo_result.waived}
+        for r in results:
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].endswith(".py")
+            assert loc["region"]["startLine"] >= 1
+            assert "/" in r["ruleId"]
+            fp = r["partialFingerprints"]["ffcheckWaiverKey/v1"]
+            if "suppressions" in r:
+                assert fp in keys
+                assert r["suppressions"][0]["justification"]
+        rule_ids = [x["id"] for x in run["tool"]["driver"]["rules"]]
+        assert rule_ids == sorted(rule_ids)
+
+    def test_changed_only_filter(self, tmp_path):
+        files = dict(TestWaivers.BAD)
+        files["pkg/clean.py"] = "x = 1\n"
+        root = _tree(tmp_path, files)
+        res = run_analysis(repo=root, roots=["pkg"],
+                           pass_names=["lock-discipline"],
+                           only_paths=["pkg/clean.py"])
+        assert res.ok and res.findings == []
+        assert res.to_dict()["changed_only"] == ["pkg/clean.py"]
+        assert "changed-only" in res.format_text()
+        res = run_analysis(repo=root, roots=["pkg"],
+                           pass_names=["lock-discipline"],
+                           only_paths=["pkg/a.py"])
+        assert not res.ok and len(res.findings) == 1
+
+    def test_cli_update_baseline_refuses_subset_run(self, tmp_path,
+                                                    capsys):
+        # a --pass (or roots) subset sees a subset of findings: every
+        # other pass's waivers would read as stale and be dropped —
+        # the curated baseline must survive a fat-fingered invocation
+        wcopy = tmp_path / "w.txt"
+        wcopy.write_text(open(os.path.join(
+            REPO, "ANALYSIS_WAIVERS.txt")).read())
+        rc = cli_main(["--waivers", str(wcopy), "--update-baseline",
+                       "--pass", "lock-discipline"])
+        assert rc == 2
+        assert "full all-pass" in capsys.readouterr().err
+        rc = cli_main(["--waivers", str(wcopy), "--update-baseline",
+                       "dlrm_flexflow_tpu/serving"])
+        assert rc == 2
+        capsys.readouterr()
+        assert wcopy.read_text() == open(os.path.join(
+            REPO, "ANALYSIS_WAIVERS.txt")).read()  # untouched
+
+    def test_cli_changed_only_vs_head(self):
+        # the real repo is a git checkout: whatever is currently
+        # changed vs HEAD is clean-or-waived, so the gate passes and
+        # the text names the scope
+        rc = cli_main(["--changed-only"])
+        assert rc == 0
+
+    def test_cli_update_baseline_roundtrip(self, tmp_path, capsys):
+        # regenerating against the committed tree is a no-op fixpoint:
+        # same keys, same justifications (one full run — the content
+        # comparison below proves the rewrite without a second one)
+        committed = open(os.path.join(REPO,
+                                      "ANALYSIS_WAIVERS.txt")).read()
+        wcopy = tmp_path / "w.txt"
+        wcopy.write_text(committed)
+        rc = cli_main(["--waivers", str(wcopy), "--update-baseline"])
+        out = capsys.readouterr()
+        assert rc == 0, out.err
+        assert "baseline rewritten" in out.out
+
+        def entries(text):
+            return sorted(ln for ln in text.splitlines()
+                          if ln and not ln.startswith("#"))
+
+        assert entries(wcopy.read_text()) == entries(committed)
+
+
 # ------------------------------------------------------------------- waivers
 class TestWaivers:
     BAD = {"pkg/a.py": (
@@ -579,9 +1089,11 @@ class TestCLI:
         assert rc == 0
         doc = json.loads(capsys.readouterr().out)
         assert doc["summary"]["ok"] is True
-        assert sorted(doc["passes"]) == [
-            "donation-safety", "import-layering", "lock-discipline",
-            "trace-purity"]
+        assert sorted(doc["passes"]) == ALL_PASSES
+        # the v2 sink carries per-pass counts for the report delta
+        assert sorted(doc["by_pass"]) == ALL_PASSES
+        assert all(set(v) == {"findings", "waived"}
+                   for v in doc["by_pass"].values())
 
     def test_cli_output_sink_and_text(self, tmp_path, capsys):
         sink = tmp_path / "artifacts" / "analysis_1.json"
@@ -618,7 +1130,7 @@ class TestCLI:
              os.path.join(REPO, "scripts", "check_analysis.py")],
             capture_output=True, text=True, env=ENV)
         assert r.returncode == 0, r.stdout + r.stderr
-        assert "OK (4 analysis paths)" in r.stdout
+        assert "OK (6 analysis paths)" in r.stdout
 
 
 # ------------------------------------------------- telemetry report section
@@ -669,6 +1181,84 @@ class TestReportSection:
         # without a sink, no section — same rule as the text report
         assert "analysis" not in report_data(events)
         assert "== analysis ==" not in format_report(events)
+
+    def test_per_pass_and_delta_text_json_presence(self, tmp_path,
+                                                   repo_result):
+        path, doc = self._sink(tmp_path, repo_result)
+        prev = json.loads(json.dumps(doc))
+        prev["by_pass"] = {**prev["by_pass"],
+                           "lock-discipline": {"findings": 2,
+                                               "waived": 0}}
+        prev["summary"] = {**prev["summary"], "findings": 2}
+        ppath = str(tmp_path / "artifacts" / "analysis_0.json")
+        with open(ppath, "w") as f:
+            json.dump(prev, f)
+        events = [{"type": "step", "ts": 1.0, "wall_s": 1.0,
+                   "samples": 8, "fenced": True, "phase": "fit"}]
+        text = format_report(events, analysis=(doc, path, (prev, ppath)))
+        assert "per-pass:" in text
+        assert "delta vs analysis_0.json:" in text
+        assert "findings -2" in text
+        data = report_data(events, analysis=(doc, path, (prev, ppath)))
+        d = data["analysis"]["delta"]
+        assert d["findings"] == -2 and d["previous"] == ppath
+        assert d["per_pass"]["lock-discipline"]["findings"] == -2
+        assert data["analysis"]["per_pass"].keys() == \
+            doc["by_pass"].keys()
+        # without a previous sink: per-pass stays, delta absent — in
+        # BOTH forms (presence-identical, the pinned invariant)
+        text = format_report(events, analysis=(doc, path))
+        assert "per-pass:" in text and "delta vs" not in text
+        data = report_data(events, analysis=(doc, path))
+        assert "delta" not in data["analysis"]
+        assert "per_pass" in data["analysis"]
+
+    def test_analysis_delta_tolerates_v1_sink(self, repo_result):
+        # a pre-v2 sink has no by_pass: counts reconstruct from the
+        # finding lists, so the first post-upgrade report still deltas
+        doc = repo_result.to_dict()
+        old = {k: v for k, v in doc.items() if k != "by_pass"}
+        d = analysis_delta(doc, old)
+        assert d["findings"] == 0 and d["per_pass"] == {}
+
+    def test_artifact_discovery_order(self, tmp_path):
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        a = art / "analysis_1.json"
+        b = art / "analysis_2.json"
+        a.write_text("{}")
+        b.write_text("{}")
+        now = time.time()
+        os.utime(a, (now - 10, now - 10))
+        os.utime(b, (now, now))
+        found = find_analysis_artifacts(str(tmp_path))
+        assert found == [str(b), str(a)]
+        assert find_analysis_artifact(str(tmp_path)) == str(b)
+
+    def test_artifact_discovery_dedupes_cwd_spellings(self, tmp_path,
+                                                      monkeypatch):
+        # `near` spelled absolutely while CWD is the same directory
+        # must not list each sink twice (the delta would compare the
+        # newest run against itself)
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        (art / "analysis_1.json").write_text("{}")
+        (art / "analysis_2.json").write_text("{}")
+        monkeypatch.chdir(tmp_path)
+        found = find_analysis_artifacts(str(tmp_path))
+        assert len(found) == 2
+        assert len({os.path.realpath(p) for p in found}) == 2
+
+    def test_delta_skips_scope_mismatched_sinks(self, repo_result):
+        # a --changed-only sink's counts are scope-filtered: it must
+        # not serve as the delta baseline for a full-tree run
+        from dlrm_flexflow_tpu.telemetry.report import comparable_sinks
+        full = repo_result.to_dict()
+        scoped = {**json.loads(json.dumps(full)),
+                  "changed_only": ["pkg/a.py"]}
+        assert comparable_sinks(full, full)
+        assert comparable_sinks(scoped, scoped)
+        assert not comparable_sinks(full, scoped)
 
     def test_absent_sink_no_section(self, tmp_path, monkeypatch):
         # no artifacts/ anywhere near: discovery returns None
