@@ -412,10 +412,38 @@ def main():
     ffconfig = ff.FFConfig(batch_size=batch, compute_dtype=dtype,
                            embedding_dtype=emb_dtype)
     model = build_dlrm(cfg, ffconfig)
+    # BENCH_STRATEGY=<strategy artifact>: run the headline under a
+    # search-tune winner (sim/tune.py, docs/tuning.md).  The artifact is
+    # schema-checked before it can steer a measurement; its version is
+    # recorded as provenance (a strategy remaps execution, it does not
+    # change numerics — like BENCH_FUSED it is not part of the anchor
+    # key).
+    strategy, strategy_version = None, None
+    sp = os.environ.get("BENCH_STRATEGY", "").strip()
+    if sp and sp.lower() not in ("0", "off", "none", "false", "no"):
+        from dlrm_flexflow_tpu.sim.tune import (load_strategy_artifact,
+                                                strategy_from_artifact)
+        sdoc = load_strategy_artifact(sp)
+        if sdoc["app"] != "dlrm" \
+                or sdoc["num_devices"] != jax.device_count():
+            # strategies are scoped per (app, device count) — the
+            # reason sim/tune.py topology-scopes incumbents; refusing a
+            # mismatch here keeps strategy_version provenance honest: a
+            # recorded version really steered the measurement it
+            # annotates (a foreign app's op names would silently match
+            # nothing)
+            raise SystemExit(
+                f"BENCH_STRATEGY {sp} targets "
+                f"{sdoc['app']}/{sdoc['num_devices']}dev but this "
+                f"bench runs dlrm on {jax.device_count()} device(s) — "
+                f"re-tune for this topology")
+        strategy = strategy_from_artifact(sdoc)
+        strategy_version = sdoc["version"]
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                   loss_type="mean_squared_error",
                   metrics=("accuracy", "mean_squared_error"),
-                  mesh=False if jax.device_count() == 1 else None)
+                  mesh=False if jax.device_count() == 1 else None,
+                  strategy=strategy)
     state = model.init(seed=0)
 
     rng = np.random.default_rng(0)
@@ -448,6 +476,8 @@ def main():
            "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype},
           extra={"dtype": dtype, "fused": cfg.fused_interaction,
                  "probe_us": round(probe_us, 1), **prov,
+                 **({"strategy_version": strategy_version}
+                    if strategy_version is not None else {}),
                  **_mfu_extras(model, batch, epochs * num_batches, prov)})
 
 

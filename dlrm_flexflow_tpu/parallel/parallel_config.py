@@ -119,6 +119,19 @@ class Strategy:
             return load_strategy_pb(path)
         with open(path) as f:
             data = json.load(f)
+        if data.get("kind") == "strategy" and "strategy" in data:
+            # a search-tune strategy artifact (sim/tune.py) nests the
+            # op list under provenance — accept it here so the artifact
+            # doubles as a loadable strategy file (docs/tuning.md), but
+            # through the artifact validator: an unknown schema version
+            # or doctored artifact is refused, never misread
+            from ..sim.tune import validate_strategy_artifact
+
+            errs = validate_strategy_artifact(data)
+            if errs:
+                raise ValueError(f"{path}: invalid strategy artifact: "
+                                 + "; ".join(errs))
+            data = data["strategy"]
         s = Strategy()
         for op in data["ops"]:
             s.configs[op["name"]] = ParallelConfig.from_json(op)

@@ -7,11 +7,16 @@ GPU<->DRAM 16 MB/ms, simulator.cu:27-29); memoized real-kernel timing
 ``measure_op_forward/backward_time`` simulator.cc:235-273 calling each op's
 ``measure_compute_time`` e.g. linear.cu:973-1049).
 
-Two cost sources, both memoized:
-  * measured  — jit-compile the op's forward/backward on the real device
-                and wall-clock it (the reference's approach);
-  * analytic  — roofline estimate max(FLOPs/peak, bytes/HBM-bw), used on
-                CPU test meshes and as a fast fallback.
+Three cost sources, all memoized:
+  * measured   — jit-compile the op's forward/backward on the real device
+                 and wall-clock it (the reference's approach);
+  * analytic   — roofline estimate max(FLOPs/peak, bytes/HBM-bw), used on
+                 CPU test meshes and as a fast fallback;
+  * calibrated — the analytic roofline corrected by per-op-class factors
+                 fitted from a recorded run's measured-vs-predicted
+                 ``op_time`` telemetry (sim/tune.py::Calibration) — the
+                 chip-free cost source the ``search-tune`` closed loop
+                 re-searches under (docs/tuning.md).
 
 The machine model replaces the GPU constants with TPU numbers: per-chip
 HBM bandwidth, MXU peak, ICI link bandwidth (bidirectional ring per mesh
@@ -89,9 +94,13 @@ class CostModel:
 
     def __init__(self, machine: Optional[TPUMachineModel] = None,
                  measure: bool = False, measure_iters: int = 24,
-                 measure_budget_s: float = 300.0):
+                 measure_budget_s: float = 300.0, calibration=None):
         self.machine = machine or TPUMachineModel()
         self.measure = measure
+        # telemetry-backed correction (sim/tune.py::Calibration): per
+        # op-class multipliers applied on top of the ANALYTIC estimate
+        # only — measured times are already real and stay untouched
+        self.calibration = calibration
         self.measure_iters = measure_iters
         # wall-clock budget for ALL measurement (each distinct op shape
         # costs a compile, ~2-10 s; a big graph could otherwise stall a
@@ -160,6 +169,9 @@ class CostModel:
                 self._measure_spent += time.perf_counter() - t0
         else:
             fwd, bwd = self._analytic_op(op, num_parts)
+            if self.calibration is not None:
+                sf, sb = self.calibration.scale_for(op)
+                fwd, bwd = fwd * sf, bwd * sb
         self._cache[key] = (fwd, bwd)
         return fwd, bwd
 

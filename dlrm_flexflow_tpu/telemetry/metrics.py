@@ -70,6 +70,15 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
         "gauge", "seconds since the last committed checkpoint"),
     "dlrm_sentinel_rollbacks_total": (
         "counter", "dispatches the NaN sentinel rejected and rolled back"),
+    "dlrm_sim_calibration_error_pct": (
+        "gauge", "mean per-op sim-vs-measured relative error of the "
+                 "newest calibration fit, percent"),
+    "dlrm_strategy_age_s": (
+        "gauge", "seconds since the incumbent SOAP strategy artifact "
+                 "was created (strategy freshness)"),
+    "dlrm_strategy_version": (
+        "gauge", "version number of the incumbent SOAP strategy "
+                 "artifact"),
 }
 
 
@@ -448,6 +457,33 @@ def _ckpt_age() -> Optional[float]:
     return None if _last_ckpt_ts is None else time.time() - _last_ckpt_ts
 
 
+# ----------------------------------------------------- tuning-loop gauges
+_strategy_promoted_ts: Optional[float] = None
+
+
+def note_calibration(mae_pct: float) -> None:
+    """Called by ``sim.tune.fit_calibration`` on every fit: the
+    simulator-accuracy gauge tracks the NEWEST calibration's residual
+    error (docs/tuning.md)."""
+    SIM_CALIBRATION_ERROR.set(float(mae_pct))
+
+
+def note_strategy_promotion(version: int,
+                            ts: Optional[float] = None) -> None:
+    """Called by ``sim.tune.promote`` on every incumbent move (and by
+    consumers loading an incumbent at startup): the freshness gauge
+    ages from the artifact's ``created_ts`` so a server running a
+    week-old strategy shows a week, not its own uptime."""
+    global _strategy_promoted_ts
+    _strategy_promoted_ts = time.time() if ts is None else float(ts)
+    STRATEGY_VERSION.set(int(version))
+
+
+def _strategy_age() -> Optional[float]:
+    return (None if _strategy_promoted_ts is None
+            else time.time() - _strategy_promoted_ts)
+
+
 # ------------------------------------------------------- the default registry
 REGISTRY = MetricsRegistry()
 
@@ -478,3 +514,8 @@ CHECKPOINT_AGE = REGISTRY.register(
     Gauge("dlrm_checkpoint_age_s", fn=_ckpt_age))
 SENTINEL_ROLLBACKS = REGISTRY.register(
     Counter("dlrm_sentinel_rollbacks_total"))
+SIM_CALIBRATION_ERROR = REGISTRY.register(
+    Gauge("dlrm_sim_calibration_error_pct"))
+STRATEGY_AGE = REGISTRY.register(
+    Gauge("dlrm_strategy_age_s", fn=_strategy_age))
+STRATEGY_VERSION = REGISTRY.register(Gauge("dlrm_strategy_version"))

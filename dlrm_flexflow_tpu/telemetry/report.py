@@ -13,6 +13,9 @@ Prints (sections appear only when the run emitted the matching events):
   * memory watermarks         — from ``memory`` events, per device
   * search trajectory         — from ``search`` events (MCMC proposals,
     acceptance rate, best-cost trajectory, calibration fits)
+  * tuning loop               — from ``calibration`` + ``search``
+    phase=promote events (sim/tune.py: calibration error before/after,
+    candidate-vs-incumbent verdicts, strategy lineage — docs/tuning.md)
   * span summary              — from ``span`` events (telemetry/trace.py)
 
 ``--format json`` emits the same sections as ONE machine-readable
@@ -103,19 +106,54 @@ def throughput_summary(events: List[dict]) -> List[str]:
     return lines
 
 
-def per_op_table(events: List[dict]) -> List[str]:
-    ops = [e for e in events if e.get("type") == "op_time"]
-    if not ops:
-        return []
-    # last emission per op wins (a rerun within one log supersedes)
+def _op_err_pct(e: dict) -> Optional[float]:
+    """Measured-vs-predicted relative error of one op_time event,
+    percent; None when the event carries no sim prediction."""
+    sf = e.get("sim_forward_s")
+    if sf is None:
+        return None
+    return 100.0 * abs(sf - e["forward_s"]) / max(e["forward_s"], 1e-12)
+
+
+def latest_op_times(events: List[dict]) -> Dict[str, dict]:
+    """THE newest-``op_time``-event-per-op selection (a rerun within
+    one log supersedes) — the per-op table here and the calibration
+    fit (sim/tune.py::pair_op_times) share it, so the error an op is
+    reported with and the measurement it is calibrated by can never
+    come from different events."""
     latest: Dict[str, dict] = {}
-    for e in ops:
-        latest[e["op"]] = e
-    rows = sorted(latest.values(), key=lambda e: -e["forward_s"])
+    for e in events:
+        if e.get("type") == "op_time":
+            latest[e["op"]] = e
+    return latest
+
+
+def _per_op_rows(events: List[dict]) -> List[dict]:
+    """THE per-op row selection + ranking (text table and
+    ``report_data`` share it so the two forms can never order
+    differently): newest event per op wins; rows carrying a sim
+    prediction rank by percent error WORST-FIRST (calibration drift is
+    what the table exists to surface), rows without one follow by
+    measured forward time."""
+    latest = latest_op_times(events)
+
+    def rank(e: dict):
+        err = _op_err_pct(e)
+        if err is None:
+            return (1, -e["forward_s"], 0.0)
+        return (0, -err, -e["forward_s"])
+
+    return sorted(latest.values(), key=rank)
+
+
+def per_op_table(events: List[dict]) -> List[str]:
+    rows = _per_op_rows(events)
+    if not rows:
+        return []
     has_sim = any("sim_forward_s" in e for e in rows)
     head = f"{'op':28s} {'fwd(us)':>10s} {'bwd(us)':>10s}"
     if has_sim:
-        head += f" {'sim fwd(us)':>12s} {'sim/meas':>9s}"
+        head += f" {'sim fwd(us)':>12s} {'sim/meas':>9s} {'err%':>8s}"
     lines = ["== per-op time table ==", head]
     for e in rows:
         line = (f"{e['op']:28s} {e['forward_s'] * 1e6:10.1f} "
@@ -124,9 +162,10 @@ def per_op_table(events: List[dict]) -> List[str]:
             sf = e.get("sim_forward_s")
             if sf is not None:
                 ratio = sf / max(e["forward_s"], 1e-12)
-                line += f" {sf * 1e6:12.1f} {ratio:9.2f}"
+                line += (f" {sf * 1e6:12.1f} {ratio:9.2f} "
+                         f"{_op_err_pct(e):8.1f}")
             else:
-                line += f" {'-':>12s} {'-':>9s}"
+                line += f" {'-':>12s} {'-':>9s} {'-':>8s}"
         lines.append(line)
     return lines
 
@@ -232,6 +271,73 @@ def search_summary(events: List[dict]) -> List[str]:
         if "backend" in e:
             line += f" [{e['backend']}]"
         lines.append(line)
+    return lines
+
+
+def tuning_summary(events: List[dict]) -> List[str]:
+    """The ``== tuning ==`` section (sim/tune.py closed loop,
+    docs/tuning.md): calibration error before/after each fit,
+    whole-step real-vs-sim measurements, candidate-vs-incumbent
+    promotion verdicts, and the strategy version lineage the promote
+    events record."""
+    cals = [e for e in events if e.get("type") == "calibration"]
+    promos = [e for e in events
+              if e.get("type") == "search" and e.get("phase") == "promote"]
+    if not cals and not promos:
+        return []
+    lines = ["== tuning =="]
+    for e in cals:
+        ph = e.get("phase")
+        if ph == "fit":
+            line = f"calibration fit: {e['ops']} ops"
+            if "op_classes" in e:
+                line += f" ({e['op_classes']} classes)"
+            line += (f", mean error {e['mae_pct_before']:.1f}% -> "
+                     f"{e['mae_pct_after']:.1f}%")
+            if "source" in e:
+                line += f" [{e['source']}]"
+            lines.append(line)
+        elif ph == "measure":
+            line = (f"calibration measure: real {e['real_ms']:.3f} ms "
+                    f"vs sim {e['sim_ms']:.3f} ms "
+                    f"(ratio {e['ratio']:.3f})")
+            if "rows" in e and "batch" in e:
+                line += f" [rows={e['rows']}, batch={e['batch']}]"
+            lines.append(line)
+        elif ph == "persist":
+            lines.append(f"calibration artifact: {e['artifact']}")
+    for e in promos:
+        line = f"candidate v{e.get('version', '?')}"
+        if "app" in e and "num_devices" in e:
+            line += f" [{e['app']}/{e['num_devices']}dev]"
+        if "candidate_s" in e:
+            line += f" ({e['candidate_s'] * 1e3:.3f} ms)"
+        if "incumbent_version" in e:
+            line += f" vs incumbent v{e['incumbent_version']}"
+            if "incumbent_s" in e:
+                line += f" ({e['incumbent_s'] * 1e3:.3f} ms)"
+        line += f": {e.get('verdict', '?')}"
+        if "tolerance_pct" in e:
+            line += f" (tolerance {e['tolerance_pct']:.1f}%)"
+        lines.append(line)
+    # one lineage PER topology: incumbents are scoped per
+    # (app, num_devices) (sim/tune.py::incumbent_path), so chaining
+    # across topologies would invent successions that never happened —
+    # a shared append-mode sink holds parallel lineages
+    chains: Dict[object, List[int]] = {}
+    for e in promos:
+        if e.get("verdict") in ("first", "promoted") and "version" in e:
+            key = (e.get("app"), e.get("num_devices"))
+            chains.setdefault(key, []).append(e["version"])
+    for (app, ndev), chain in sorted(
+            chains.items(),
+            key=lambda kv: (str(kv[0][0]),
+                            kv[0][1] if isinstance(kv[0][1], int)
+                            else -1)):
+        scope = (f" [{app}/{ndev}dev]"
+                 if app is not None and ndev is not None else "")
+        lines.append(f"strategy lineage{scope}: "
+                     + " -> ".join(f"v{v}" for v in chain))
     return lines
 
 
@@ -522,6 +628,7 @@ SECTIONS = (
     ("compile", compile_timeline),
     ("memory", memory_summary),
     ("search", search_summary),
+    ("tuning", tuning_summary),
     ("resilience", resilience_summary),
     ("serving", serving_summary),
     ("spans", span_summary),
@@ -607,15 +714,16 @@ def report_data(events: List[dict],
             h["loss_first"], h["loss_last"] = losses[0], losses[-1]
     ops = by.get("op_time", [])
     if ops:
-        latest: Dict[str, dict] = {}
-        for e in ops:
-            latest[e["op"]] = e
-        headline["per_op"]["ops"] = [
-            {k: e[k] for k in ("op", "forward_s", "backward_s",
-                               "sim_forward_s", "sim_backward_s")
-             if k in e}
-            for e in sorted(latest.values(),
-                            key=lambda e: -e["forward_s"])]
+        per_rows = []
+        for e in _per_op_rows(ops):
+            row = {k: e[k] for k in ("op", "forward_s", "backward_s",
+                                     "sim_forward_s", "sim_backward_s")
+                   if k in e}
+            err = _op_err_pct(e)
+            if err is not None:
+                row["err_pct"] = err
+            per_rows.append(row)
+        headline["per_op"]["ops"] = per_rows
     comps = by.get("compile", [])
     if comps:
         misses = [e for e in comps if e["kind"] == "backend_compile"]
@@ -625,6 +733,21 @@ def report_data(events: List[dict],
             "backend_compile_s": sum(e["duration_s"] for e in misses),
             "aot_builds": len(aots),
             "aot_s": sum(e["duration_s"] for e in aots)}
+    fits = [e for e in by.get("calibration", [])
+            if e.get("phase") == "fit"]
+    promos = [e for e in by.get("search", [])
+              if e.get("phase") == "promote"]
+    if fits:
+        headline["tuning"].update(
+            {k: fits[-1][k] for k in ("mae_pct_before", "mae_pct_after",
+                                      "ops", "op_classes")
+             if k in fits[-1]})
+    if promos:
+        headline["tuning"].update(
+            {k: promos[-1][k]
+             for k in ("verdict", "version", "incumbent_version",
+                       "candidate_s", "incumbent_s")
+             if k in promos[-1]})
     serves = by.get("serve", [])
     sums = [e for e in serves if e.get("phase") == "summary"]
     if sums:

@@ -59,10 +59,11 @@ SCHEMA: Dict[str, dict] = {
         "required": {"device": str, "bytes_in_use": int},
         "optional": {"peak_bytes": int, "source": str, "phase": str},
     },
-    # MCMC strategy-search trajectory (sim/search.py) and simulator
-    # calibration (sim/simulator.py).  ``phase`` selects the sub-shape:
-    # per-iteration proposals, the end-of-search summary, or one
-    # sim-vs-measured calibration fit.
+    # MCMC strategy-search trajectory (sim/search.py), simulator
+    # calibration (sim/simulator.py), and gated strategy promotion
+    # (sim/tune.py).  ``phase`` selects the sub-shape: per-iteration
+    # proposals, the end-of-search summary, one sim-vs-measured
+    # calibration fit, or one candidate-vs-incumbent promotion verdict.
     "search": {
         "required": {"phase": str},
         "optional": {"it": int, "op": str, "dims": list, "accepted": bool,
@@ -70,11 +71,33 @@ SCHEMA: Dict[str, dict] = {
                      "iterations": int, "accepted_count": int,
                      "acceptance_rate": float, "backend": str,
                      "simulated_s": float, "measured_s": float,
-                     "scale": float},
+                     "scale": float, "verdict": str, "version": int,
+                     "incumbent_version": int, "candidate_s": float,
+                     "incumbent_s": float, "tolerance_pct": float,
+                     "metric": str, "app": str, "num_devices": int},
         "phases": {
             "iteration": ("it", "accepted", "current_s", "best_s"),
             "summary": ("iterations", "best_s"),
             "calibrate": ("simulated_s", "measured_s", "scale"),
+            "promote": ("verdict", "version", "candidate_s"),
+        },
+    },
+    # cost-model calibration against recorded reality (sim/tune.py,
+    # scripts/calibrate_sim.py — docs/tuning.md).  ``phase`` selects
+    # the sub-shape: one per-op-class fit from op_time telemetry, one
+    # whole-step real-vs-sim measurement, or one persisted calibration
+    # artifact.
+    "calibration": {
+        "required": {"phase": str},
+        "optional": {"source": str, "ops": int, "op_classes": int,
+                     "mae_pct_before": float, "mae_pct_after": float,
+                     "artifact": str, "real_ms": float, "sim_ms": float,
+                     "ratio": float, "rows": int, "batch": int,
+                     "scale": float},
+        "phases": {
+            "fit": ("ops", "mae_pct_before", "mae_pct_after"),
+            "measure": ("real_ms", "sim_ms", "ratio"),
+            "persist": ("artifact",),
         },
     },
     # one op's isolated forward/backward wall time (profiling.OpTimer)

@@ -8,7 +8,13 @@ fenced per-step time of the scanned epoch, and compares it with
 simulator.cc:235-273 times real kernels the same way).
 
 Prints one JSON line {"real_ms", "sim_ms", "ratio", "probe_us"}; the
-current ratio is recorded in PERF.md.  Run on the TPU:
+current ratio is recorded in PERF.md.  Each measured config ALSO lands
+as one ``calibration`` ``phase="measure"`` telemetry event: when run
+standalone the events append to ``artifacts/telemetry_calibration.jsonl``
+(mode "a" — the sink accumulates across runs, so the report CLI's
+``== tuning ==`` section and the search-tune loop can consume past
+calibration runs, docs/tuning.md); under an already-active EventLog
+they ride that log instead.  Run on the TPU:
 
     python scripts/calibrate_sim.py [rows] [batch]
 """
@@ -65,6 +71,12 @@ def measure_config(rows, batch, cost_model, nb=16, reps=3):
 
     sim = Simulator(model, 1, cost_model=cost_model)
     sim_step = sim.simulate(data_parallel_strategy(model, 1))
+    from dlrm_flexflow_tpu.telemetry import emit
+
+    emit("calibration", phase="measure", rows=rows, batch=batch,
+         real_ms=round(real_step * 1e3, 3),
+         sim_ms=round(sim_step * 1e3, 3),
+         ratio=round(sim_step / real_step, 4) if real_step else 0.0)
     return real_step, sim_step
 
 
@@ -104,16 +116,38 @@ def calibrate_and_validate(cal=(50_000, 128), val=(100_000, 256),
     }
 
 
+def _artifact_log():
+    """The standalone sink: calibration events append to
+    ``artifacts/telemetry_calibration.jsonl`` so past runs accumulate
+    for the report CLI and the search-tune loop; an already-active
+    EventLog (e.g. a bench run calling measure_config) wins instead."""
+    import contextlib
+
+    from dlrm_flexflow_tpu.telemetry import active_log, event_log
+
+    if active_log() is not None:
+        return contextlib.nullcontext()
+    d = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts")
+    os.makedirs(d, exist_ok=True)
+    return event_log(path=os.path.join(d, "telemetry_calibration.jsonl"),
+                     mode="a")
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 2:
         rows, batch = int(sys.argv[1]), int(sys.argv[2])
         from dlrm_flexflow_tpu.sim import CostModel
         budget = float(os.environ.get("FF_SIM_CAL_BUDGET", 900.0))
-        real, sim = measure_config(
-            rows, batch,
-            cost_model=CostModel(measure=True, measure_budget_s=budget))
+        with _artifact_log():
+            real, sim = measure_config(
+                rows, batch,
+                cost_model=CostModel(measure=True,
+                                     measure_budget_s=budget))
         print(json.dumps({"real_ms": round(real * 1e3, 3),
                           "sim_ms": round(sim * 1e3, 3),
                           "ratio": round(sim / real, 3)}))
     else:
-        print(json.dumps(calibrate_and_validate()))
+        with _artifact_log():
+            result = calibrate_and_validate()
+        print(json.dumps(result))

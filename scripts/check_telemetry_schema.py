@@ -23,7 +23,13 @@ field without the schema and the report CLI seeing it:
      must be valid Prometheus identifiers with counter families ending
      ``_total``, the rendered exposition must carry each family exactly
      once (no duplicates), and every family must be documented in
-     docs/telemetry.md.
+     docs/telemetry.md;
+  5. tuning-artifact contract — every field of the calibration and
+     strategy artifact schemas (``sim/tune.py``) must be documented in
+     docs/tuning.md, the example artifacts must validate, and the
+     promotion gate's metric name must gate UPWARD
+     (``regress.lower_is_better``) so a slower candidate can never
+     read as an improvement.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -206,12 +212,51 @@ def check_metrics_registry(doc_path: str) -> list:
     return errs
 
 
+def check_tuning_artifacts(doc_path: str) -> list:
+    """The tuning-artifact contract (sim/tune.py, docs/tuning.md):
+    artifact field tables documented, example artifacts valid, and the
+    gate metric latency-shaped."""
+    from dlrm_flexflow_tpu.sim import tune
+    from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+
+    errs = []
+    if not os.path.exists(doc_path):
+        return [f"missing {doc_path} (the documented tuning-artifact "
+                f"schema)"]
+    with open(doc_path) as f:
+        doc = f.read()
+    for table, fields in (("calibration", tune.CALIBRATION_FIELDS),
+                          ("strategy", tune.STRATEGY_FIELDS),
+                          ("provenance", tune.PROVENANCE_FIELDS)):
+        for name in fields:
+            if f"`{name}`" not in doc:
+                errs.append(f"docs/tuning.md does not document "
+                            f"{table} artifact field `{name}`")
+    for kind, example, validate in (
+            ("calibration", tune.example_calibration_artifact,
+             tune.validate_calibration_artifact),
+            ("strategy", tune.example_strategy_artifact,
+             tune.validate_strategy_artifact)):
+        for e in validate(example()):
+            errs.append(f"{kind} example artifact invalid: {e}")
+    if not lower_is_better(tune.TUNE_METRIC):
+        errs.append(f"tune.TUNE_METRIC {tune.TUNE_METRIC!r} is not "
+                    f"latency-shaped — the promotion gate would let a "
+                    f"slower candidate pass as an improvement")
+    if f"`{tune.TUNE_METRIC}`" not in doc:
+        errs.append(f"docs/tuning.md does not document the gate metric "
+                    f"`{tune.TUNE_METRIC}`")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
             + check_doc_sync(doc)
             + check_producers()
-            + check_metrics_registry(doc))
+            + check_metrics_registry(doc)
+            + check_tuning_artifacts(os.path.join(REPO, "docs",
+                                                  "tuning.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

@@ -1,0 +1,174 @@
+"""Closed-loop SOAP tuning driver (sim/tune.py — docs/tuning.md).
+
+Ingest a recorded run's ``op_time`` telemetry, fit per-op-class
+correction factors into the analytic cost model, re-run the MCMC
+strategy search under the recalibrated simulator, persist the winner as
+a versioned strategy artifact with full provenance, and promote it over
+the incumbent only when the regress gate passes:
+
+    python scripts/search_tune.py --telemetry artifacts/telemetry_dlrm.jsonl \\
+        [--devices 8] [--budget 300] [--seed 0] [--tolerance 5] \\
+        [--bench sim|real] [--artifacts artifacts] [--tiny]
+
+Every phase emits ``search``/``calibration`` telemetry into the tune
+sink (default ``artifacts/telemetry_tune.jsonl``, APPEND mode so the
+report CLI's ``== tuning ==`` section sees the whole strategy lineage
+across runs) and the run prints ONE JSON line:
+version, verdict, sim-predicted step time, calibration error
+before/after.
+
+``--bench sim`` (default) prices candidate and incumbent under the
+RECALIBRATED simulator — deterministic and chip-free; ``--bench real``
+times a short fenced training run per strategy on the attached backend
+(the strategies only execute differently under a multi-device mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_model(args):
+    """The DLRM under tuning: the run_random.sh architecture by
+    default, or the CPU-scale tiny config (``--tiny`` — what the
+    check_tuning smoke and the tests drive)."""
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+
+    if args.tiny:
+        cfg = DLRMConfig(sparse_feature_size=8,
+                         embedding_size=[args.rows or 64] * 2,
+                         embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                         mlp_top=[8 * 2 + 8, 8, 1])
+    else:
+        cfg = DLRMConfig()
+        if args.rows:
+            cfg.embedding_size = [args.rows] * len(cfg.embedding_size)
+    return cfg, build_dlrm(cfg, ff.FFConfig(batch_size=args.batch))
+
+
+def real_step_bench(args):
+    """``--bench real``: price one strategy artifact by a short fenced
+    training run — fresh model compiled UNDER the strategy, warmup
+    epoch, then best-of-``reps`` fenced windows (the bench.py timing
+    protocol at miniature scale)."""
+    import time
+
+    import numpy as np
+
+    def bench(doc: dict) -> float:
+        import jax
+
+        import dlrm_flexflow_tpu as ff
+        from dlrm_flexflow_tpu.profiling import device_fence
+        from dlrm_flexflow_tpu.sim.tune import strategy_from_artifact
+        from dlrm_flexflow_tpu.telemetry import suppressed
+
+        cfg, model = build_model(args)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
+                      loss_type="mean_squared_error", metrics=(),
+                      mesh=False if jax.device_count() == 1 else None,
+                      strategy=strategy_from_artifact(doc))
+        state = model.init(seed=0)
+        nb = args.bench_batches
+        rng = np.random.default_rng(0)
+        inputs = {
+            "dense": rng.standard_normal(
+                (nb, args.batch, cfg.mlp_bot[0])).astype(np.float32),
+            "sparse": rng.integers(
+                0, min(cfg.embedding_size),
+                size=(nb, args.batch, len(cfg.embedding_size),
+                      cfg.embedding_bag_size), dtype=np.int64),
+        }
+        labels = rng.integers(
+            0, 2, size=(nb, args.batch, 1)).astype(np.float32)
+        inputs, labels = model.place_dataset(inputs, labels)
+        with suppressed():  # emission must not land inside the walls
+            state, _ = model.train_epoch(state, inputs, labels)  # compile
+            device_fence(state.step)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                state, _ = model.train_epoch(state, inputs, labels)
+                device_fence(state.step)
+                best = min(best, time.perf_counter() - t0)
+        return best / nb
+
+    return bench
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python scripts/search_tune.py",
+        description=__doc__.split("\n")[0])
+    p.add_argument("--telemetry", required=True,
+                   help="op_time JSONL of a recorded run (OpTimer under "
+                        "an active EventLog — e.g. a bench.py sink)")
+    p.add_argument("--artifacts", default=os.path.join(REPO, "artifacts"),
+                   help="artifact dir for calibration/strategy versions "
+                        "and the incumbent pointer")
+    p.add_argument("--devices", type=int, default=0,
+                   help="device count the strategy targets "
+                        "(default: jax.device_count())")
+    p.add_argument("--budget", type=int, default=300,
+                   help="MCMC iteration budget")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--tolerance", type=float, default=5.0,
+                   help="promotion gate tolerance, percent")
+    p.add_argument("--bench", choices=("sim", "real"), default="sim",
+                   help="candidate-vs-incumbent pricing: recalibrated "
+                        "simulator (deterministic) or a real fenced run")
+    p.add_argument("--bench-batches", type=int, default=4,
+                   help="batches per fenced window (--bench real)")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--rows", type=int, default=0,
+                   help="embedding rows per table (0 = config default)")
+    p.add_argument("--tiny", action="store_true",
+                   help="CPU-scale DLRM (the smoke/test config)")
+    p.add_argument("--sink", default=None,
+                   help="tune-run telemetry JSONL (default "
+                        "<artifacts>/telemetry_tune.jsonl; 'off' "
+                        "disables)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from dlrm_flexflow_tpu.sim.tune import search_tune
+    from dlrm_flexflow_tpu.telemetry import event_log
+
+    num_devices = args.devices or jax.device_count()
+    _cfg, model = build_model(args)
+    bench_fn = real_step_bench(args) if args.bench == "real" else None
+
+    sink = args.sink
+    if sink is None:
+        os.makedirs(args.artifacts, exist_ok=True)
+        sink = os.path.join(args.artifacts, "telemetry_tune.jsonl")
+    import contextlib
+
+    # append, never truncate: the report's strategy-lineage line reads
+    # the promote events of PAST runs from this same sink (the same
+    # reason calibrate_sim.py's artifact sink appends)
+    ctx = (contextlib.nullcontext()
+           if sink.strip().lower() in ("off", "none", "0")
+           else event_log(path=sink, mode="a"))
+    with ctx:
+        result = search_tune(
+            model, num_devices, args.telemetry, args.artifacts,
+            app="dlrm", budget=args.budget, seed=args.seed,
+            alpha=args.alpha, bench_fn=bench_fn,
+            tolerance_pct=args.tolerance)
+    print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in result.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,471 @@
+"""Closed-loop SOAP tuning tests (sim/tune.py, scripts/search_tune.py —
+docs/tuning.md): calibration fitting strictly reduces sim-vs-measured
+error, the calibrated cost source scales the analytic estimates,
+``mcmc_search`` is deterministic under a pinned seed + cost model,
+strategy artifacts are versioned/schema-checked with provenance, the
+promotion gate refuses a doctored slower candidate, the report CLI's
+``== tuning ==`` section and worst-first per-op error column render
+identically in text and JSON, the freshness gauges expose, and the
+tier-1 smoke matrix.  All CPU, all fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.sim import tune
+from dlrm_flexflow_tpu.sim.cost_model import CostModel
+from dlrm_flexflow_tpu.sim.search import mcmc_search
+from dlrm_flexflow_tpu.telemetry import event_log
+from dlrm_flexflow_tpu.telemetry.report import (format_report,
+                                                per_op_table, report_data)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mlp_model(batch=16, widths=(16, 32, 8)):
+    m = ff.FFModel(ff.FFConfig(batch_size=batch))
+    t = m.create_tensor((batch, widths[0]), name="x")
+    for i, w in enumerate(widths[1:]):
+        t = m.dense(t, w, activation="relu", name=f"fc{i}")
+    return m
+
+
+def op_time_events(model, factor=3.0, offset=1.0):
+    """Synthetic op_time telemetry: measured = sim * (factor per class,
+    plus a per-op wobble) so a per-class fit improves but cannot zero
+    the error."""
+    cm = CostModel()
+    evs = []
+    for i, op in enumerate(model.layers):
+        sf, sb = cm.op_times(op, 1)
+        wob = 1.0 + 0.1 * (i % 3)
+        evs.append({"type": "op_time", "ts": float(i), "op": op.name,
+                    "forward_s": sf * factor * wob,
+                    "backward_s": sb * factor * wob * offset,
+                    "sim_forward_s": sf, "sim_backward_s": sb})
+    return evs
+
+
+# ------------------------------------------------------------- calibration
+
+class TestCalibration:
+    def test_best_scale_minimizes_and_never_hurts(self):
+        # global minimum of sum |s*sim - meas|/meas lies at a ratio kink
+        meas, sims = [2.0, 2.0, 8.0], [1.0, 1.0, 1.0]
+        s = tune._best_scale(meas, sims)
+        assert s == pytest.approx(2.0)  # the weighted-majority ratio
+        # when 1.0 is already optimal the fit must not move
+        assert tune._best_scale([1.0], [1.0]) == pytest.approx(1.0)
+        assert tune._best_scale([], []) == 1.0
+
+    def test_fit_strictly_reduces_error_and_emits(self):
+        m = mlp_model()
+        evs = op_time_events(m)
+        with event_log() as log:
+            cal = tune.fit_calibration(evs, m, source="syn.jsonl")
+        assert cal.mae_pct_after < cal.mae_pct_before
+        assert cal.ops == len(m.layers)
+        fits = [e for e in log.events("calibration")
+                if e["phase"] == "fit"]
+        assert len(fits) == 1
+        assert fits[0]["source"] == "syn.jsonl"
+        assert fits[0]["mae_pct_after"] == pytest.approx(
+            cal.mae_pct_after, abs=1e-3)
+        # every layer here is a Linear -> one fitted class
+        assert set(cal.scales) == {"Linear"}
+
+    def test_fit_without_pairs_raises(self):
+        m = mlp_model()
+        with pytest.raises(ValueError, match="no op_time events"):
+            tune.fit_calibration([{"type": "step"}], m)
+
+    def test_fit_skips_ops_foreign_to_the_model(self):
+        # a pair naming an op this model does not have can never be
+        # applied by scale_for — it must not participate in the fit or
+        # inflate the reported accuracy
+        m = mlp_model()
+        evs = op_time_events(m)
+        evs.append({"type": "op_time", "ts": 99.0, "op": "ghost_op",
+                    "forward_s": 1.0, "sim_forward_s": 1e-6})
+        cal = tune.fit_calibration(evs, m)
+        assert cal.ops == len(m.layers)  # ghost excluded
+        assert "ghost_op" not in cal.scales
+        # all-foreign telemetry is refused naming the cause
+        foreign = [dict(e, op=f"other_{i}")
+                   for i, e in enumerate(op_time_events(m))]
+        with pytest.raises(ValueError, match="different architecture"):
+            tune.fit_calibration(foreign, m)
+
+    def test_newest_event_per_op_wins_even_without_sim(self):
+        # an op whose LATEST rerun dropped the sim prediction is
+        # excluded — never calibrated against its stale older pair
+        m = mlp_model()
+        evs = op_time_events(m)
+        stale_op = evs[0]["op"]
+        evs.append({"type": "op_time", "ts": 1e9, "op": stale_op,
+                    "forward_s": 123.0})
+        pairs = tune.pair_op_times(evs, tune.op_class_map(m))
+        assert stale_op not in {p["op"] for p in pairs}
+        assert len(pairs) == len(m.layers) - 1
+
+    def test_calibrated_cost_model_scales_analytic(self):
+        m = mlp_model()
+        op = m.layers[0]
+        base = CostModel().op_times(op, 1)
+        cal = tune.Calibration(scales={"Linear": (3.0, 5.0)})
+        fwd, bwd = CostModel(calibration=cal).op_times(op, 1)
+        assert fwd == pytest.approx(base[0] * 3.0)
+        assert bwd == pytest.approx(base[1] * 5.0)
+        # unknown classes keep the raw roofline
+        other = tune.Calibration(scales={"Conv2D": (9.0, 9.0)})
+        assert CostModel(calibration=other).op_times(op, 1) == \
+            pytest.approx(base)
+
+    def test_artifact_roundtrip_and_versioning(self, tmp_path):
+        cal = tune.Calibration(scales={"Linear": (1.5, 2.5)},
+                               source="a.jsonl", fitted_ts=1.0, ops=3,
+                               mae_pct_before=40.0, mae_pct_after=4.0)
+        p1 = tune.save_calibration_artifact(str(tmp_path), cal)
+        p2 = tune.save_calibration_artifact(str(tmp_path), cal)
+        assert p1.endswith("calibration_v0001.json")
+        assert p2.endswith("calibration_v0002.json")
+        loaded = tune.Calibration.load(p1)
+        assert loaded.scales == cal.scales
+        assert loaded.mae_pct_before == cal.mae_pct_before
+
+    def test_validate_names_violations(self):
+        doc = tune.example_calibration_artifact()
+        assert tune.validate_calibration_artifact(doc) == []
+        bad = dict(doc)
+        del bad["scales"]
+        bad["extra"] = 1
+        errs = tune.validate_calibration_artifact(bad)
+        assert any("scales" in e for e in errs)
+        assert any("extra" in e for e in errs)
+        wrong = dict(doc, schema=99)
+        assert any("unsupported" in e
+                   for e in tune.validate_calibration_artifact(wrong))
+        # a truthy non-dict scales must come back as a NAMED violation,
+        # not crash the validator with AttributeError
+        listy = dict(doc, scales=[["Linear", 1.0]])
+        errs = tune.validate_calibration_artifact(listy)
+        assert any("scales" in e for e in errs)
+
+
+# ------------------------------------------------------ search determinism
+
+class TestSearchDeterminism:
+    def _run(self, seed):
+        m = mlp_model(batch=64, widths=(64, 128, 8))
+        with event_log() as log:
+            best = mcmc_search(m, 8, budget=25, seed=seed,
+                               backend="python", measure=False)
+        its = [{k: e[k] for k in ("it", "op", "dims", "accepted",
+                                  "current_s", "best_s")}
+               for e in log.events("search") if e["phase"] == "iteration"]
+        return best, its
+
+    def test_same_seed_same_trajectory_and_winner(self):
+        b1, t1 = self._run(seed=3)
+        b2, t2 = self._run(seed=3)
+        assert t1 == t2  # identical proposal/acceptance trajectory
+        assert {k: v.dims for k, v in b1.configs.items()} == \
+            {k: v.dims for k, v in b2.configs.items()}
+        assert b1.best_simulated_time == b2.best_simulated_time
+
+    def test_different_seed_changes_proposals(self):
+        _b1, t1 = self._run(seed=0)
+        _b2, t2 = self._run(seed=1)
+        assert [e["op"] for e in t1] != [e["op"] for e in t2] or \
+            [e["dims"] for e in t1] != [e["dims"] for e in t2]
+
+
+# -------------------------------------------------------- strategy artifact
+
+class TestStrategyArtifact:
+    def _strategy(self, m, n=8):
+        from dlrm_flexflow_tpu.sim.search import data_parallel_strategy
+
+        return data_parallel_strategy(m, n)
+
+    def test_save_validates_versions_and_provenance(self, tmp_path):
+        m = mlp_model()
+        p, doc = tune.save_strategy_artifact(
+            str(tmp_path), self._strategy(m), app="dlrm", num_devices=8,
+            sim_step_s=1e-3, seed=0, budget=50, telemetry="t.jsonl",
+            calibration="c.json", parent_version=None,
+            mae_pct_before=30.0, mae_pct_after=3.0)
+        assert doc["version"] == 1
+        assert tune.load_strategy_artifact(p) == doc
+        s = tune.strategy_from_artifact(doc)
+        assert {k: v.dims for k, v in s.configs.items()} == \
+            {k: v.dims for k, v in self._strategy(m).configs.items()}
+        _p2, doc2 = tune.save_strategy_artifact(
+            str(tmp_path), self._strategy(m), app="dlrm", num_devices=8,
+            sim_step_s=1e-3, seed=0, budget=50, parent_version=1)
+        assert doc2["version"] == 2
+        assert doc2["provenance"]["parent_version"] == 1
+
+    def test_artifact_loads_via_strategy_load(self, tmp_path):
+        # docs/tuning.md: the artifact doubles as a loadable strategy
+        # file — Strategy.load must accept the nested artifact shape
+        from dlrm_flexflow_tpu.parallel.parallel_config import Strategy
+
+        m = mlp_model()
+        p, doc = tune.save_strategy_artifact(
+            str(tmp_path), self._strategy(m), app="dlrm", num_devices=8,
+            sim_step_s=1e-3, seed=0, budget=50)
+        s = Strategy.load(p)
+        assert {k: v.dims for k, v in s.configs.items()} == \
+            {k: v.dims for k, v in self._strategy(m).configs.items()}
+        # this path validates too: an unknown-schema artifact is
+        # refused, never misread (same guarantee as load_strategy_artifact)
+        bad = dict(doc, schema=99)
+        bp = tmp_path / "future.json"
+        bp.write_text(json.dumps(bad))
+        with pytest.raises(ValueError, match="unsupported"):
+            Strategy.load(str(bp))
+
+    def test_version_claim_survives_a_race(self, tmp_path, monkeypatch):
+        # two concurrent runs that both scanned the same newest version
+        # must NOT overwrite each other: the loser's exclusive create
+        # fails and it retries with the next free slot
+        m = mlp_model()
+        p1, _ = tune.save_strategy_artifact(
+            str(tmp_path), self._strategy(m), app="dlrm", num_devices=8,
+            sim_step_s=1e-3, seed=0, budget=50)
+        first = open(p1).read()
+        real = tune.next_version
+        stale = iter([1])  # one stale scan, then the real answer
+
+        def racing_next_version(d, kind):
+            return next(stale, None) or real(d, kind)
+
+        monkeypatch.setattr(tune, "next_version", racing_next_version)
+        p2, doc2 = tune.save_strategy_artifact(
+            str(tmp_path), self._strategy(m), app="dlrm", num_devices=8,
+            sim_step_s=2e-3, seed=1, budget=50)
+        assert p2.endswith("strategy_v0002.json")
+        assert doc2["version"] == 2
+        assert open(p1).read() == first  # the winner was not destroyed
+
+    def test_examples_valid_and_doctored_refused(self, tmp_path):
+        assert tune.validate_strategy_artifact(
+            tune.example_strategy_artifact()) == []
+        bad = tune.example_strategy_artifact()
+        bad["strategy"] = {"ops": [{"dims": [1]}]}  # nameless op
+        errs = tune.validate_strategy_artifact(bad)
+        assert any("missing op name" in e for e in errs)
+        # a non-integer dims entry must come back as a NAMED violation,
+        # not escape the validator as a raw ValueError
+        bad2 = tune.example_strategy_artifact()
+        bad2["strategy"] = {"ops": [{"name": "x", "dims": ["x", 1]}]}
+        errs2 = tune.validate_strategy_artifact(bad2)
+        assert any("not a ParallelConfig" in e for e in errs2)
+        p = tmp_path / "s.json"
+        p.write_text(json.dumps(bad))
+        with pytest.raises(ValueError, match="invalid strategy"):
+            tune.load_strategy_artifact(str(p))
+
+    def test_promote_moves_incumbent_and_gauges(self, tmp_path):
+        from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+
+        assert tune.load_incumbent(str(tmp_path), "dlrm", 8) is None
+        doc = tune.example_strategy_artifact()
+        tune.promote(str(tmp_path), doc)
+        inc = tune.load_incumbent(str(tmp_path), "dlrm", 8)
+        assert inc["version"] == doc["version"]
+        assert tmetrics.STRATEGY_VERSION.value == doc["version"]
+        age = tmetrics.STRATEGY_AGE.value
+        assert age is not None and age > 0  # created_ts=1.0 -> ancient
+
+
+# --------------------------------------------------------------- promotion
+
+class TestGate:
+    def test_metric_is_latency_shaped(self):
+        from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+
+        assert lower_is_better(tune.TUNE_METRIC)
+
+    def test_first_promoted_rejected(self):
+        cand = dict(tune.example_strategy_artifact(), version=2)
+        inc = tune.example_strategy_artifact()
+        with event_log() as log:
+            v, c, i = tune.gate_candidate(cand, None, lambda d: 1e-3)
+            assert (v, i) == ("first", None)
+            v, _c, _i = tune.gate_candidate(
+                cand, inc, lambda d: 1e-3 if d["version"] == 2 else 2e-3)
+            assert v == "promoted"  # faster candidate
+            v, _c, _i = tune.gate_candidate(
+                cand, inc, lambda d: 3e-3 if d["version"] == 2 else 1e-3)
+            assert v == "rejected"  # doctored slower candidate
+        evs = [e for e in log.events("search") if e["phase"] == "promote"]
+        assert [e["verdict"] for e in evs] == ["first", "promoted",
+                                               "rejected"]
+        assert evs[-1]["version"] == 2
+        assert evs[-1]["incumbent_version"] == 1
+        assert evs[-1]["candidate_s"] == pytest.approx(3e-3)
+        assert evs[-1]["metric"] == tune.TUNE_METRIC
+
+    def test_incumbents_are_topology_scoped(self, tmp_path):
+        # an incumbent for a different device count would be mispriced
+        # by the simulator's modulo fold — each topology keeps its OWN
+        # incumbent pointer, so a 4-device run can neither gate against
+        # nor evict the 8-device production incumbent
+        m = mlp_model(batch=64, widths=(64, 128, 8))
+        tel = tmp_path / "rec.jsonl"
+        with open(tel, "w") as f:
+            for e in op_time_events(m):
+                f.write(json.dumps(e) + "\n")
+        art = str(tmp_path / "art")
+        r1 = tune.search_tune(m, 8, str(tel), art, budget=10)
+        assert (r1["verdict"], r1["version"]) == ("first", 1)
+        r2 = tune.search_tune(m, 4, str(tel), art, budget=10)
+        assert r2["verdict"] == "first"  # own topology, own lineage
+        assert r2["incumbent_s"] is None and r2["parent_version"] is None
+        # the 8-device incumbent was NOT evicted by the 4-device run
+        inc8 = tune.load_incumbent(art, "dlrm", 8)
+        inc4 = tune.load_incumbent(art, "dlrm", 4)
+        assert inc8["version"] == 1 and inc8["num_devices"] == 8
+        assert inc4["version"] == 2 and inc4["num_devices"] == 4
+        r3 = tune.search_tune(m, 4, str(tel), art, budget=10)
+        assert r3["verdict"] == "promoted"  # same-topology gate engages
+        assert r3["incumbent_s"] is not None
+        assert r3["parent_version"] == 2
+
+    def test_gate_fails_closed_on_nonpositive_bench(self):
+        # regress.compare skips non-positive baselines — without this
+        # guard an unmeasurable incumbent would FAIL OPEN and any
+        # candidate, however slow, would be auto-promoted
+        cand = dict(tune.example_strategy_artifact(), version=2)
+        inc = tune.example_strategy_artifact()
+        with pytest.raises(ValueError, match="non-positive baseline"):
+            tune.gate_candidate(
+                cand, inc, lambda d: 1.0 if d["version"] == 2 else 0.0)
+        with pytest.raises(ValueError, match="bench bug"):
+            tune.gate_candidate(cand, inc, lambda d: 0.0)
+
+    def test_within_tolerance_promotes(self):
+        cand = dict(tune.example_strategy_artifact(), version=2)
+        inc = tune.example_strategy_artifact()
+        v, _c, _i = tune.gate_candidate(
+            cand, inc,
+            lambda d: 1.03e-3 if d["version"] == 2 else 1e-3,
+            tolerance_pct=5.0)
+        assert v == "promoted"  # 3% slower is inside the 5% gate
+
+
+# ------------------------------------------------------------------ report
+
+class TestTuningReport:
+    def events(self):
+        return [
+            {"type": "calibration", "ts": 1.0, "phase": "fit", "ops": 6,
+             "op_classes": 2, "mae_pct_before": 40.0,
+             "mae_pct_after": 4.0, "source": "run.jsonl"},
+            {"type": "calibration", "ts": 2.0, "phase": "persist",
+             "artifact": "artifacts/calibration_v0001.json"},
+            {"type": "search", "ts": 3.0, "phase": "promote",
+             "verdict": "first", "version": 1, "candidate_s": 1e-3,
+             "tolerance_pct": 5.0, "metric": tune.TUNE_METRIC},
+            {"type": "search", "ts": 4.0, "phase": "promote",
+             "verdict": "promoted", "version": 2, "incumbent_version": 1,
+             "candidate_s": 0.9e-3, "incumbent_s": 1e-3,
+             "tolerance_pct": 5.0, "metric": tune.TUNE_METRIC},
+            {"type": "search", "ts": 5.0, "phase": "promote",
+             "verdict": "rejected", "version": 3, "incumbent_version": 2,
+             "candidate_s": 2e-3, "incumbent_s": 0.9e-3,
+             "tolerance_pct": 5.0, "metric": tune.TUNE_METRIC},
+        ]
+
+    def test_text_and_json_presence_identical(self):
+        evs = self.events()
+        text = format_report(evs)
+        data = report_data(evs)
+        assert "== tuning ==" in text
+        assert "tuning" in data
+        assert "40.0% -> 4.0%" in text
+        assert "strategy lineage: v1 -> v2" in text  # v3 was rejected
+        assert "rejected" in text
+        h = data["tuning"]
+        assert h["mae_pct_before"] == 40.0
+        assert h["verdict"] == "rejected"
+        assert h["version"] == 3
+        assert h["incumbent_version"] == 2
+        # section presence gates identically when no tuning events exist
+        other = [{"type": "step", "ts": 1.0, "wall_s": 1.0,
+                  "samples": 8}]
+        assert "== tuning ==" not in format_report(other)
+        assert "tuning" not in report_data(other)
+
+    def test_lineage_is_per_topology(self):
+        # a shared append-mode sink holds PARALLEL lineages: an
+        # 8-device v1 and a 4-device v2 are separate incumbents, never
+        # rendered as one cross-topology succession chain
+        evs = [
+            {"type": "search", "ts": 1.0, "phase": "promote",
+             "verdict": "first", "version": 1, "candidate_s": 1e-3,
+             "app": "dlrm", "num_devices": 8},
+            {"type": "search", "ts": 2.0, "phase": "promote",
+             "verdict": "first", "version": 2, "candidate_s": 1e-3,
+             "app": "dlrm", "num_devices": 4},
+            {"type": "search", "ts": 3.0, "phase": "promote",
+             "verdict": "promoted", "version": 3, "incumbent_version": 2,
+             "candidate_s": 0.9e-3, "incumbent_s": 1e-3,
+             "app": "dlrm", "num_devices": 4},
+        ]
+        text = format_report(evs)
+        assert "strategy lineage [dlrm/8dev]: v1" in text
+        assert "strategy lineage [dlrm/4dev]: v2 -> v3" in text
+        assert "v1 -> v2" not in text  # no cross-topology chain
+
+    def test_per_op_err_column_sorted_worst_first(self):
+        evs = [
+            {"type": "op_time", "ts": 1.0, "op": "small_err",
+             "forward_s": 1e-3, "backward_s": 2e-3,
+             "sim_forward_s": 1.1e-3},   # 10% error
+            {"type": "op_time", "ts": 2.0, "op": "big_err",
+             "forward_s": 1e-4, "backward_s": 2e-4,
+             "sim_forward_s": 5e-4},     # 400% error
+            {"type": "op_time", "ts": 3.0, "op": "no_sim",
+             "forward_s": 9e-3, "backward_s": 1e-3},
+        ]
+        lines = per_op_table(evs)
+        assert "err%" in lines[1]
+        order = [ln.split()[0] for ln in lines[2:]]
+        # worst error first; sim-less rows trail by forward time
+        assert order == ["big_err", "small_err", "no_sim"]
+        ops = report_data(evs)["per_op"]["ops"]
+        assert [o["op"] for o in ops] == order  # JSON order identical
+        assert ops[0]["err_pct"] == pytest.approx(400.0)
+        assert "err_pct" not in ops[2]
+
+    def test_per_op_without_sim_keeps_forward_sort(self):
+        evs = [
+            {"type": "op_time", "ts": 1.0, "op": "fast",
+             "forward_s": 1e-4},
+            {"type": "op_time", "ts": 2.0, "op": "slow",
+             "forward_s": 1e-2},
+        ]
+        lines = per_op_table(evs)
+        assert "err%" not in lines[1]
+        assert [ln.split()[0] for ln in lines[2:]] == ["slow", "fast"]
+
+
+# ------------------------------------------------------------- tier-1 smoke
+
+@pytest.mark.skipif(sys.platform == "win32", reason="posix paths")
+def test_check_tuning_smoke():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_tuning.py")],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "check_tuning: OK" in r.stdout
