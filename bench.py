@@ -73,6 +73,10 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
                     hv = "float32"  # records written before act_dtype
                 if k == "quantize" and hv is None:
                     hv = "off"  # records written before serve quantize
+                if k == "replicas" and hv is None:
+                    hv = 1  # records written before the replica router
+                if k == "mesh" and hv is None:
+                    hv = ""  # records written before mesh-native serving
                 if k == "metric" and hv is None:
                     # records written before the metric field carry the
                     # app's ONE historical headline — THE mapping lives
@@ -751,6 +755,12 @@ def bench_serving():
     # quantized runs never share an anchor.
     quantize = (os.environ.get("BENCH_QUANTIZE", "off")
                 .strip().lower() or "off")
+    # BENCH_REPLICAS: batcher replicas behind the least-loaded
+    # ReplicaRouter (docs/serving.md).  A 4-replica run measures a
+    # different serving topology, so like quantize it is PART of the
+    # anchor key — an N-replica QPS entry never gates against the
+    # single-replica baseline (regress keys ":replicas=N" the same way)
+    replicas = int(os.environ.get("BENCH_REPLICAS", 1))
     cfg = DLRMConfig()  # run_random.sh architecture — same as main()
     cfg.embedding_size = [rows] * 8
     cfg.fused_interaction = (os.environ.get("BENCH_FUSED", "off")
@@ -761,6 +771,12 @@ def bench_serving():
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                   loss_type="mean_squared_error", metrics=(),
                   mesh=False if jax.device_count() == 1 else None)
+    # the mesh shape (if any) rides the anchor key too: mesh-native
+    # serving shards the forward differently per topology, and an
+    # 8-chip entry must never anchor a 1-chip run
+    mesh_str = ("" if model.mesh is None else
+                ",".join(f"{a}={s}" for a, s in
+                         zip(model.mesh.axis_names, model.mesh.devices.shape)))
     engine = InferenceEngine(model, model.init(seed=0),
                              quantize=quantize)  # warmup: AOT all
     rng = np.random.default_rng(0)
@@ -773,7 +789,12 @@ def bench_serving():
                  0, rows, size=(req_rows, 8, cfg.embedding_bag_size),
                  dtype=np.int64)}
             for _ in range(128)]
-    batcher = DynamicBatcher(engine)
+    if replicas > 1:
+        from dlrm_flexflow_tpu.serving import ReplicaRouter
+
+        batcher = ReplicaRouter([engine] * replicas)
+    else:
+        batcher = DynamicBatcher(engine)
     wall, _rejected = closed_loop(batcher, pool, clients, requests)
     summary = batcher.close()  # drains + emits the serve summary event
     # SERVED requests only — shed (Rejected) submissions must not
@@ -785,7 +806,8 @@ def bench_serving():
     _emit("dlrm_serving_qps", qps,
           {"app": "dlrm_serving", "metric": "dlrm_serving_qps",
            "rows": rows, "clients": clients, "req_rows": req_rows,
-           "buckets": buckets, "quantize": quantize},
+           "buckets": buckets, "quantize": quantize,
+           "replicas": replicas, "mesh": mesh_str},
           extra=extra, unit="requests/s")
     # second serving headline: engine-forward p99 at the LARGEST bucket
     # the run dispatched (per-bucket histograms, LatencyStats) — the
@@ -803,7 +825,8 @@ def bench_serving():
                   {"app": "dlrm_serving", "metric": "dlrm_serving_p99_ms",
                    "rows": rows, "clients": clients, "req_rows": req_rows,
                    "buckets": buckets, "quantize": quantize,
-                   "bucket": top_bucket},
+                   "bucket": top_bucket, "replicas": replicas,
+                   "mesh": mesh_str},
                   extra={"dtype": dtype, "fused": cfg.fused_interaction},
                   unit="ms")
 

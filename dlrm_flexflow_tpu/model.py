@@ -107,6 +107,7 @@ class FFModel:
         self._train_step = None
         self._eval_step = None
         self._forward_fn = None
+        self._forward_raw = None
         self._hetero_ops: List[Op] = []
         self._last_metrics = MetricsAccumulator(())
         self._pending_lr: Optional[float] = None
@@ -1885,6 +1886,9 @@ class FFModel:
                                      static_argnums=(3,))
         self._eval_step = jax.jit(eval_step)
         self._forward_fn = jax.jit(forward)
+        # unjitted forward: the serving engine re-jits it with explicit
+        # out_shardings to AOT-compile bucket programs UNDER the mesh
+        self._forward_raw = forward
         return self
 
     # ------------------------------------------------------------------- init

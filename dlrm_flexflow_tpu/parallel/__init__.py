@@ -1,5 +1,6 @@
-from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, constrain, make_mesh,
-                   param_pspec, pspec_for_config, sharding)
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, apply_partition_rules,
+                   constrain, make_mesh, match_partition_rule, param_pspec,
+                   partition_rules, pspec_for_config, sharding)
 from .parallel_config import ParallelConfig, Strategy
 from .ring_attention import ring_attention, ring_attention_sharded
 from .table_exchange import table_parallel_lookup
@@ -8,6 +9,7 @@ from .ulysses import ulysses_attention, ulysses_attention_sharded
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "make_mesh", "pspec_for_config", "param_pspec", "sharding", "constrain",
+    "partition_rules", "match_partition_rule", "apply_partition_rules",
     "ParallelConfig", "Strategy",
     "ring_attention", "ring_attention_sharded",
     "table_parallel_lookup",

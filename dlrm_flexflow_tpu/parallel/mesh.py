@@ -20,7 +20,8 @@ Extra axes (e.g. "seq" for context parallelism, "expert") can be added via
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -133,3 +134,74 @@ def constrain(x, mesh: Optional[Mesh], spec: PartitionSpec):
     if mesh is None:
         return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# ------------------------------------------------- spec-driven partition rules
+#
+# The serving engine (serving/engine.py) and — roadmap item 3 — the
+# reshard-on-restore path both need the SAME answer the training side
+# computes at state placement (FFModel._param_shardings): which
+# PartitionSpec each "op/param" leaf of the tree gets.  Rules make that
+# answer portable: an ordered (regex, PartitionSpec) list over tree
+# paths, derived once from a compiled model and then applicable to any
+# structurally-compatible params tree (a fresh init, an inference-only
+# checkpoint restore, a quantized copy whose extra leaves — e.g. the
+# per-row "qscale" column — fall through to the replicated catch-all).
+# First match wins; the trailing (".*", replicated) rule makes the rule
+# set total, so applying it can never KeyError on an unexpected leaf.
+
+PartitionRules = List[Tuple[str, PartitionSpec]]
+
+
+def partition_rules(model) -> PartitionRules:
+    """Ordered ``(path-regex, PartitionSpec)`` rules for ``model``'s
+    param tree, one exact-path rule per parameter the training
+    placement shards plus a replicated catch-all.  Paths are
+    ``"<op>/<param>"``.  Requires a compiled model with an active mesh
+    (the specs come from each op's strategy via
+    ``FFModel._param_shardings``)."""
+    assert model.mesh is not None, "partition_rules needs a mesh"
+    rules: PartitionRules = []
+    for op_name, by_param in model._param_shardings().items():
+        for param_name, shd in by_param.items():
+            path = f"{re.escape(op_name)}/{re.escape(param_name)}"
+            rules.append((f"^{path}$", shd.spec))
+    rules.append((".*", PartitionSpec()))
+    return rules
+
+
+def match_partition_rule(rules: PartitionRules, path: str) -> PartitionSpec:
+    """The first rule whose regex matches ``path`` (a ``"<op>/<param>"``
+    key).  Raises ``ValueError`` only when the rule set has no
+    catch-all AND nothing matches — rule sets from
+    :func:`partition_rules` always end with one."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    raise ValueError(f"no partition rule matches {path!r}")
+
+
+def apply_partition_rules(rules: PartitionRules, tree: Dict[str, dict],
+                          mesh: Mesh) -> Dict[str, dict]:
+    """``device_put`` every leaf of a ``{op: {param: array}}`` tree
+    under the NamedSharding its first matching rule names.  A sharded
+    rule whose axis does not divide the leaf's dimension falls back to
+    replicated (e.g. a quantized scale column riding an embedding rule
+    written for the full-width table) rather than failing placement."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out: Dict[str, dict] = {}
+    for op_name, by_param in tree.items():
+        placed = {}
+        for param_name, leaf in by_param.items():
+            spec = match_partition_rule(rules, f"{op_name}/{param_name}")
+            ndim = getattr(leaf, "ndim", 0)
+            entries = tuple(spec)
+            entries = entries + (None,) * (ndim - len(entries))
+            ok = all(ax is None
+                     or (i < ndim and leaf.shape[i] % sizes.get(ax, 1) == 0)
+                     for i, ax in enumerate(entries))
+            spec = PartitionSpec(*entries[:ndim]) if ok else PartitionSpec()
+            placed[param_name] = jax.device_put(
+                leaf, NamedSharding(mesh, spec))
+        out[op_name] = placed
+    return out

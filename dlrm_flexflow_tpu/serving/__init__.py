@@ -13,6 +13,10 @@ as training:
     ``max_batch_size`` / ``max_wait_us`` micro-batching, explicit
     overload shedding (:class:`Rejected`), per-request deadlines
     (:class:`DeadlineExceeded`), graceful drain on ``close()``.
+  * :class:`ReplicaRouter` — N engine+batcher replicas behind one
+    least-loaded ``submit``; sheds only when EVERY replica is
+    saturated, drains replicas in parallel on ``close()``, exposes
+    per-replica ``/metrics`` families.
   * :class:`LatencyStats` — p50/p95/p99/QPS accumulation feeding the
     ``serve`` telemetry events and the report CLI's ``== serving ==``
     section.
@@ -31,9 +35,11 @@ Quick start::
 from .batcher import (DeadlineExceeded, DynamicBatcher, Rejected,
                       ServeFuture)
 from .engine import DEFAULT_BUCKETS, InferenceEngine, parse_buckets
+from .router import ReplicaRouter
 from .stats import LatencyStats
 
 __all__ = [
-    "InferenceEngine", "DynamicBatcher", "ServeFuture", "LatencyStats",
-    "Rejected", "DeadlineExceeded", "DEFAULT_BUCKETS", "parse_buckets",
+    "InferenceEngine", "DynamicBatcher", "ReplicaRouter", "ServeFuture",
+    "LatencyStats", "Rejected", "DeadlineExceeded", "DEFAULT_BUCKETS",
+    "parse_buckets",
 ]

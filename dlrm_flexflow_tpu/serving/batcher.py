@@ -20,6 +20,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import traceback
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -54,22 +55,52 @@ class ServeFuture:
         self._lk = threading.Lock()
         self._value = None
         self._exc: Optional[BaseException] = None
+        self._cbs: List[Any] = []
 
     # completion is FIRST-WRITE-WINS: the dispatcher and a racing
-    # close() must never flip an already-delivered result
+    # close() must never flip an already-delivered result.  Callbacks
+    # fire exactly once, OUTSIDE the lock — a callback that takes its
+    # own lock (the router's in-flight accounting) must not nest under
+    # this one.
+    def _run_cbs(self, cbs) -> None:
+        # a raising callback must never unwind the dispatcher thread
+        # (it would strand the rest of the batch's futures) or abort a
+        # close() drain — match concurrent.futures: report, carry on
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                traceback.print_exc()
+
     def _set(self, value) -> None:
         with self._lk:
             if self._ev.is_set():
                 return
             self._value = value
+            cbs, self._cbs = self._cbs, []
             self._ev.set()
+        self._run_cbs(cbs)
 
     def _set_exception(self, exc: BaseException) -> None:
         with self._lk:
             if self._ev.is_set():
                 return
             self._exc = exc
+            cbs, self._cbs = self._cbs, []
             self._ev.set()
+        self._run_cbs(cbs)
+
+    def add_done_callback(self, cb) -> None:
+        """Run ``cb(future)`` when the result or exception lands (at
+        most once; immediately when already done).  Used by the replica
+        router's in-flight accounting — callbacks must be cheap and
+        must not block the dispatcher; a raising callback is reported
+        and swallowed, never propagated into the completing thread."""
+        with self._lk:
+            if not self._ev.is_set():
+                self._cbs.append(cb)
+                return
+        self._run_cbs([cb])
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -108,6 +139,55 @@ class _Request:
 
 
 _STOP = object()
+
+
+class _CloseOnce:
+    """Winner-elected idempotent shutdown, shared by
+    :class:`DynamicBatcher` and the replica router so the two close
+    paths cannot drift.  ``run(shutdown)`` elects exactly ONE caller to
+    execute ``shutdown()`` (returning the final summary); concurrent
+    callers park on an event and every later call returns the first
+    summary without re-running shutdown.  The lock guards ONLY the
+    who-runs flag and the stored summary (ffcheck lock-discipline —
+    the shutdown itself emits telemetry, completes futures, and joins
+    threads, none of which may run under a held lock).  A winner whose
+    shutdown RAISES un-elects itself so parked and later callers re-run
+    it instead of inheriting a None summary forever."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._started = False
+        self._done = threading.Event()
+        self._summary: Optional[Dict[str, Any]] = None
+
+    def run(self, shutdown):
+        while True:
+            with self._lock:
+                if self._summary is not None:
+                    return self._summary
+                if not self._started:
+                    self._started = True
+                    self._done.clear()
+                    break  # this caller runs the shutdown
+            self._done.wait()
+            # loop: either the winner finished (summary set) or it
+            # failed and un-elected — re-check under the lock
+        try:
+            summary = shutdown()
+        except BaseException:
+            # un-elect AND wake parked closers in one locked step: a
+            # set() after the lock released could land after a new
+            # winner's clear(), leaving the event stuck set and the
+            # parked closers spinning through wait() for the whole
+            # retry shutdown
+            with self._lock:
+                self._started = False
+                self._done.set()
+            raise
+        with self._lock:
+            self._summary = summary
+            self._done.set()
+        return summary
 
 
 class DynamicBatcher:
@@ -151,21 +231,13 @@ class DynamicBatcher:
         # caller blocks forever) and the dispatcher's sentinel re-put
         # in _collect() could block on a queue a late submit refilled
         self._intake_lock = threading.Lock()
-        # close() election: the lock guards ONLY the who-runs-shutdown
-        # flag (ffcheck lock-discipline — the shutdown itself emits
-        # telemetry, completes futures, and joins the dispatcher, none
-        # of which may run under a held lock); losers wait on the event
-        # and return the winner's summary
-        self._close_lock = threading.Lock()
-        self._close_started = False
-        self._close_done = threading.Event()
+        self._closer = _CloseOnce()
         self._thread: Optional[threading.Thread] = None
         # one request held over from a batch it would have overflowed
         # (a bounded Queue cannot push-front; re-put could deadlock the
         # single consumer when the queue is full)
         self._carry: Optional[_Request] = None
         self._cancelling = False  # close(drain=False) in progress
-        self._final_summary: Optional[Dict[str, float]] = None
         # live-metrics visibility (telemetry/metrics.py): queue depth +
         # served/shed counters scrape-able while this batcher lives;
         # close() retires it (final counters fold so totals stay
@@ -183,19 +255,26 @@ class DynamicBatcher:
             self._thread.start()
 
     def submit(self, inputs: Dict[str, Any],
-               timeout_us: Optional[float] = None) -> ServeFuture:
+               timeout_us: Optional[float] = None,
+               record_shed: bool = True) -> ServeFuture:
         """Enqueue one request (dict name -> (n, ...) array or a single
         unbatched sample of shape ``feature_shape``); returns its
         :class:`ServeFuture`.  Raises :class:`Rejected` immediately when
-        the queue is full or the batcher is closed."""
+        the queue is full or the batcher is closed.
+
+        ``record_shed=False`` makes a refusal silent (no shed counter,
+        no reject event): the ReplicaRouter probes replicas with it so
+        one router-shed request doesn't count N replica rejections —
+        the router records THE shed itself, exactly once."""
         if self._closed:
-            # the batcher may already be RETIRED from /metrics (its
-            # stats folded): record_shed_late routes the reject into
-            # the retained base so the Prometheus counter still sees it
-            _metrics.record_shed_late(self.stats)
-            emit("serve", phase="reject", reason="shutdown")
-            start_span("serve.request").set_attr(
-                "reason", "shutdown").end(status="shed")
+            if record_shed:
+                # the batcher may already be RETIRED from /metrics (its
+                # stats folded): record_shed_late routes the reject into
+                # the retained base so the Prometheus counter sees it
+                _metrics.record_shed_late(self.stats)
+                emit("serve", phase="reject", reason="shutdown")
+                start_span("serve.request").set_attr(
+                    "reason", "shutdown").end(status="shed")
             raise Rejected("batcher is shut down")
         arrs = {}
         rows = None
@@ -243,17 +322,26 @@ class DynamicBatcher:
                 except queue.Full:
                     shed = "queue_full"
         if shed is not None:
-            # BOTH reasons can race past the batcher's retire (submit
-            # runs on client threads unsynchronized with close(), which
-            # folds this stats object); record_shed_late routes a
-            # post-fold count into the retained base.  _miss/cancel
-            # paths need no such guard — they run on the dispatcher (or
-            # inside _close itself), strictly before the fold.
-            _metrics.record_shed_late(self.stats)
-            emit("serve", phase="reject", reason=shed)
-            req.qspan.end(status="shed")
+            if record_shed:
+                # BOTH reasons can race past the batcher's retire
+                # (submit runs on client threads unsynchronized with
+                # close(), which folds this stats object);
+                # record_shed_late routes a post-fold count into the
+                # retained base.  _miss/cancel paths need no such guard
+                # — they run on the dispatcher (or inside _close
+                # itself), strictly before the fold.
+                _metrics.record_shed_late(self.stats)
+                emit("serve", phase="reject", reason=shed)
+            # a silent router probe's refusal is NOT a shed — the
+            # request may be served by the next replica, and a
+            # status="shed" span here would make span-derived shed
+            # counts disagree with the counters the probe design keeps
+            # exact.  The span still closes (exactly-once), as an
+            # explicit refused offer.
+            status = "shed" if record_shed else "probe_refused"
+            req.qspan.end(status=status)
             req.span.set_attr("reason", shed)
-            req.span.end(status="shed")
+            req.span.end(status=status)
             raise Rejected(
                 "batcher is shut down" if shed == "shutdown" else
                 f"request queue full ({self._q.maxsize} waiting) — "
@@ -265,6 +353,21 @@ class DynamicBatcher:
                 result_timeout_s: Optional[float] = None):
         """Blocking convenience: submit + wait for the result."""
         return self.submit(inputs, timeout_us).result(result_timeout_s)
+
+    def queue_depth(self) -> int:
+        """Requests currently waiting (``Queue.qsize`` — approximate by
+        nature, which is exactly what a load signal wants).  The router
+        keys least-loaded dispatch on it; /metrics scrapes the same
+        number."""
+        return self._q.qsize()
+
+    def queue_full(self) -> bool:
+        """Whether the bounded queue is full right now (approximate,
+        like :meth:`queue_depth`).  The router pre-screens its offers
+        with it so probing a saturated replica costs no input
+        coercion; ``submit`` itself stays the authority — a slot can
+        open or vanish between the check and the enqueue."""
+        return self._q.full()
 
     # ------------------------------------------------------------- dispatch
     def _expired(self, req: "_Request", now: float) -> bool:
@@ -401,39 +504,10 @@ class DynamicBatcher:
         Returns (and by default emits) the run's latency summary.
         Idempotent: a second close (e.g. explicit close inside a
         ``with`` block, or a concurrent one) returns the first summary
-        without re-running shutdown or re-emitting.  Only the
-        flag election runs under ``_close_lock`` — the shutdown itself
-        (queue flush, future delivery, dispatcher join, summary emit)
-        runs lock-free, with concurrent closers parked on
-        ``_close_done`` until the winner finishes.  A winner whose
-        shutdown RAISES un-elects itself before re-raising, so parked
-        and later closers re-run shutdown instead of inheriting a
-        None summary forever."""
-        while True:
-            with self._close_lock:
-                if self._final_summary is not None:
-                    return self._final_summary
-                if not self._close_started:
-                    self._close_started = True
-                    self._close_done.clear()
-                    break  # this caller runs the shutdown
-            self._close_done.wait()
-            # loop: either the winner finished (summary set) or it
-            # failed and un-elected — re-check under the lock
-        try:
-            summary = self._close(drain, emit_summary)
-        except BaseException:
-            # un-elect AND wake parked closers in one locked step: a
-            # set() after the lock released could land after a new
-            # winner's clear(), leaving the event stuck set and the
-            # parked closers spinning through wait() for the whole
-            # retry shutdown
-            with self._close_lock:
-                self._close_started = False
-                self._close_done.set()
-            raise
-        self._close_done.set()
-        return summary
+        without re-running shutdown or re-emitting — the winner
+        election, parked concurrent closers, and failed-shutdown
+        un-elect all live in :class:`_CloseOnce`."""
+        return self._closer.run(lambda: self._close(drain, emit_summary))
 
     def _close(self, drain: bool, emit_summary: bool) -> Dict[str, float]:
         with self._intake_lock:
@@ -483,7 +557,6 @@ class DynamicBatcher:
             self._thread.join()
         summary = (self.stats.emit_summary() if emit_summary
                    else self.stats.summary())
-        self._final_summary = summary
         _metrics.retire_batcher(self)
         return summary
 

@@ -14,6 +14,20 @@ row-independent (BatchNorm uses running stats), so the first ``n`` rows
 of a padded bucket are bit-identical to the unpadded forward — pinned
 by ``tests/test_serving.py`` and ``scripts/check_serving.py``.
 
+Under a **mesh** the engine is mesh-native, not a jit fallback: params
+are ``device_put`` under the spec-driven partition rules the training
+placement uses (``parallel.mesh.partition_rules`` — table-parallel
+embedding shards, replicated MLPs), and every bucket program is
+AOT-compiled UNDER the mesh with explicit input shardings and
+replicated outputs (the host fetches the full result anyway — the
+gather runs on-device, inside the compiled program).  Same
+zero-recompile + donation-free guarantees as the single-device path.
+A full-mesh REPLICA (all params replicated) serves replicated request
+batches and stays **bit-identical** to the single-device engine; a
+SHARDED engine (table-parallel params) data-shards divisible buckets
+(rounded up in the constructor) and is tolerance-pinned instead — its
+collectives reorder floating-point reductions (docs/serving.md).
+
 Every dispatch emits one ``serve`` ``phase="dispatch"`` telemetry event
 (queue wait / compute wall / batch fill); bucket builds emit ``compile``
 ``kind="aot"`` events like ``fit``'s epoch programs.
@@ -28,7 +42,10 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec
 
+from ..parallel.mesh import (DATA_AXIS, apply_partition_rules,
+                             partition_rules)
 from ..telemetry import emit
 from ..telemetry import metrics as _metrics
 from ..telemetry.trace import span as trace_span
@@ -66,9 +83,13 @@ class InferenceEngine:
     ``CheckpointManager`` directory or a single committed checkpoint.
 
     ``buckets`` overrides ``model.config.serve_buckets``.  ``aot=True``
-    (default off-mesh) builds each bucket's executable explicitly at
-    :meth:`warmup`; under a mesh the engine uses the jitted forward
-    (shapes still bucket-stable, so the cache is hit after warmup).
+    (the default, mesh or not) builds each bucket's executable
+    explicitly at :meth:`warmup` — under a mesh via
+    ``jit(..., out_shardings=replicated).lower(...).compile()`` against
+    the placed params and sharded abstract inputs, so steady state
+    keeps the zero-recompile + donation-free guarantees on every
+    topology.  ``aot=False`` keeps the cached-jit path (bucket shapes
+    are stable, so the cache is hit after warmup).
 
     ``quantize`` ("off" | "int8" | "bf16", default
     ``model.config.serve_quantize``) re-encodes the embedding tables at
@@ -118,12 +139,51 @@ class InferenceEngine:
         if buckets is None:
             buckets = getattr(model.config, "serve_buckets", None)
         self.buckets = parse_buckets(buckets)
-        # AOT executables want addressable single-program arrays; under a
-        # mesh the jitted forward (XLA SPMD placement) is the right path
-        self._aot = (model.mesh is None) if aot is None else bool(aot)
+        self._aot = True if aot is None else bool(aot)
         self.stats = stats or LatencyStats()
         self._in_specs = {t.name: (tuple(t.shape[1:]), t.dtype)
                           for t in model._inputs}
+        # mesh-native placement: the param tree goes under the SAME
+        # spec-driven partition rules the training placement computes
+        # (table-parallel embedding shards, replicated MLPs); quantized
+        # extras (e.g. the per-row scale column) ride the rules'
+        # replicated catch-all.  The rules are kept on the engine —
+        # reshard-on-restore (docs/resilience.md) reuses them.
+        self.partition_rules = None
+        self._mesh_sharded = False
+        if model.mesh is not None:
+            self.partition_rules = partition_rules(model)
+            self._params = apply_partition_rules(
+                self.partition_rules, self._params, model.mesh)
+            repl = NamedSharding(model.mesh, PartitionSpec())
+            self._bn = jax.tree.map(
+                lambda a: jax.device_put(a, repl), self._bn)
+            # "sharded serving" vs "full-mesh replica": any actually-
+            # sharded param leaf makes this a sharded engine (its
+            # collectives reorder reductions, so outputs are
+            # tolerance-pinned against single-device, not bit-exact);
+            # an all-replicated tree is a replica — every device runs
+            # the identical program and outputs stay bit-identical
+            self._mesh_sharded = any(
+                any(ax is not None for ax in tuple(v.sharding.spec))
+                for d in self._params.values() for v in d.values())
+            dsize = model.mesh.shape.get(DATA_AXIS, 1)
+            if self._mesh_sharded and dsize > 1:
+                # sharded engines on a data+model mesh compile ONLY
+                # data-divisible buckets (round up; predict pads the
+                # same way): a replicated batch flowing into
+                # model-sharded gathers trips an XLA SPMD sharp edge —
+                # the partitioner can lower the downstream
+                # reshape+concat to a SUMMING collective, returning
+                # 2x-wrong values (reproduced on jax 0.4.37 cpu; see
+                # scenario_mesh_sharded_engine's provenance in
+                # docs/serving.md).  Divisible buckets always shard
+                # the batch and never enter that path.  A model-ONLY
+                # mesh (no data axis) needs no round-up: its
+                # replicated-batch/sharded-gather programs are correct
+                # — pinned by the same scenario.
+                self.buckets = sorted({-(-b // dsize) * dsize
+                                       for b in self.buckets})
         self._compiled: Dict[int, Any] = {}
         self._lock = threading.Lock()
         # live-metrics visibility: per-bucket dispatch counts ride
@@ -172,8 +232,33 @@ class InferenceEngine:
         for b in self.buckets:
             self._ensure(b)
 
+    def _input_shardings(self, b: int) -> Dict[str, Any]:
+        """Explicit request shardings for one bucket's mesh program,
+        decided at COMPILE time so the executable's layout never
+        depends on traffic.  A full-mesh REPLICA (no sharded params)
+        replicates the request — every device runs the identical
+        program, keeping outputs bit-identical to the single-device
+        engine (data-parallel scale belongs to the router, not the
+        batch dim).  A SHARDED engine puts rows on the ``data`` axis
+        when the bucket divides it (always true after the constructor's
+        bucket rounding)."""
+        mesh = self.model.mesh
+        dsize = mesh.shape.get(DATA_AXIS, 1)
+        out = {}
+        for name, (shape, _dtype) in self._in_specs.items():
+            axes = [None] * (1 + len(shape))
+            if self._mesh_sharded and dsize > 1 and b % dsize == 0:
+                axes[0] = DATA_AXIS
+            out[name] = NamedSharding(mesh, PartitionSpec(*axes))
+        return out
+
     def _abstract_inputs(self, b: int) -> Dict[str, jax.ShapeDtypeStruct]:
-        return {name: jax.ShapeDtypeStruct((b,) + shape, dtype)
+        if self.model.mesh is None:
+            return {name: jax.ShapeDtypeStruct((b,) + shape, dtype)
+                    for name, (shape, dtype) in self._in_specs.items()}
+        sh = self._input_shardings(b)
+        return {name: jax.ShapeDtypeStruct((b,) + shape, dtype,
+                                           sharding=sh[name])
                 for name, (shape, dtype) in self._in_specs.items()}
 
     def _ensure(self, b: int):
@@ -190,7 +275,21 @@ class InferenceEngine:
                     # with no donate_argnums, so params/request buffers
                     # survive the call (a shed/retried request can be
                     # re-run)
-                    fn = self.model._forward_fn.lower(
+                    fwd = self.model._forward_fn
+                    if self.model.mesh is not None:
+                        # mesh-native AOT: re-jit the raw forward with
+                        # replicated outputs (the host fetches the full
+                        # result; the gather runs inside the program)
+                        # and lower against the PLACED params + sharded
+                        # abstract inputs — the executable pins every
+                        # arg/result sharding, so XLA SPMD owns the
+                        # collectives and steady state never consults
+                        # the jit cache
+                        raw = (getattr(self.model, "_forward_raw", None)
+                               or fwd.__wrapped__)
+                        fwd = jax.jit(raw, out_shardings=NamedSharding(
+                            self.model.mesh, PartitionSpec()))
+                    fn = fwd.lower(
                         self._params, self._abstract_inputs(b),
                         self._bn).compile()
                     aot_wall = time.perf_counter() - t0

@@ -1,0 +1,252 @@
+"""Least-loaded replica routing over N serving engines (docs/serving.md).
+
+One :class:`~.engine.InferenceEngine` + :class:`~.batcher.DynamicBatcher`
+pair is a **replica**; horizontal serving scale is N of them behind a
+:class:`ReplicaRouter`.  Each replica keeps its own dispatcher thread
+and its own bounded queue — the engines may be distinct (each on its
+own mesh slice or host) or the SAME engine shared N ways (queue-level
+replication: the batcher threads interleave dispatches on one param
+set, which is valid because the engine forward is stateless and
+thread-safe).
+
+Routing is **least-loaded**: ``submit`` snapshots each replica's
+outstanding work — its router-accepted not-yet-completed count,
+floored by the batcher's live queue depth (see :meth:`loads`) — and
+offers the request to replicas in ascending-load order.  Offers are
+SILENT probes (``record_shed=False``): a full replica's refusal is not
+a replica-level shed — the router sheds the request exactly once
+(:class:`~.batcher.Rejected`, reason ``router_saturated``, counted in
+``dlrm_serve_router_shed_total``) and only when EVERY replica refused
+it, so one hot replica never turns away traffic the others could
+absorb and one shed request never counts N replica rejections.  ``close`` drains all replicas
+in parallel (one closer thread each) and returns a pooled summary with
+per-replica breakdowns.
+
+Per-replica live metrics (`dlrm_serve_replica_qps{replica=}`,
+`dlrm_serve_replica_queue_depth{replica=}`) and the monotone
+router-level `dlrm_serve_router_shed_total` ride the same pull-based
+registry discipline as the batcher families (telemetry/metrics.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry import emit
+from ..telemetry import metrics as _metrics
+from .batcher import DynamicBatcher, Rejected, ServeFuture, _CloseOnce
+
+
+class ReplicaRouter:
+    """N serving replicas behind one least-loaded ``submit``.
+
+    ``engines``: one engine per replica (repeat one engine for
+    queue-level replication).  The batcher knobs (``max_batch_size``,
+    ``max_wait_us``, ``queue_depth``, ``timeout_us``) apply to every
+    replica; ``name`` prefixes the ``replica=`` metric labels (give
+    concurrent routers distinct names so their label rows stay apart).
+    """
+
+    def __init__(self, engines: Sequence, name: str = "r",
+                 max_batch_size: Optional[int] = None,
+                 max_wait_us: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 timeout_us: Optional[float] = None,
+                 autostart: bool = True):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        self.name = str(name)
+        self.batchers: List[DynamicBatcher] = [
+            DynamicBatcher(e, max_batch_size=max_batch_size,
+                           max_wait_us=max_wait_us,
+                           queue_depth=queue_depth, timeout_us=timeout_us,
+                           autostart=autostart)
+            for e in engines]
+        # one lock for the in-flight counters and the closed flag; shed
+        # counting lives in telemetry.metrics (its retained-base lock
+        # keeps the counter monotone across router retirement)
+        self._lock = threading.Lock()
+        self._inflight = [0] * len(self.batchers)
+        self._closed = False
+        self._closer = _CloseOnce()
+        self._t0 = time.perf_counter()
+        self._shed_cell = _metrics.track_router(self)
+
+    def __len__(self) -> int:
+        return len(self.batchers)
+
+    # ---------------------------------------------------------------- intake
+    def start(self) -> None:
+        for b in self.batchers:
+            b.start()
+
+    def loads(self) -> List[int]:
+        """Live per-replica load: outstanding router work (accepted,
+        not yet completed — queued AND dispatched) floored by the
+        batcher's own queue depth (which also sees directly-submitted
+        traffic).  A router request still queued appears in BOTH
+        views, so taking the max — not the sum — keeps it from
+        counting twice and skewing the ranking toward replicas with
+        dispatched work.  The snapshot is advisory (queues move under
+        us) — good enough to spread traffic, never used for
+        correctness."""
+        with self._lock:
+            inflight = list(self._inflight)
+        return [max(b.queue_depth(), inflight[i])
+                for i, b in enumerate(self.batchers)]
+
+    def _release(self, i: int) -> None:
+        with self._lock:
+            self._inflight[i] -= 1
+
+    def submit(self, inputs: Dict[str, Any],
+               timeout_us: Optional[float] = None) -> ServeFuture:
+        """Enqueue one request on the least-loaded replica; returns its
+        :class:`ServeFuture`.  Raises :class:`Rejected` only when every
+        replica's queue is full (reason ``router_saturated``) or the
+        router is closed."""
+        with self._lock:
+            closed = self._closed
+        if closed:
+            raise self._reject_shutdown()
+        loads = self.loads()
+        for i in sorted(range(len(loads)), key=lambda i: loads[i]):
+            b = self.batchers[i]
+            if b.queue_full():
+                continue  # saturated: skip the coercion-cost probe
+            try:
+                # silent probe: a refused offer must not count as a
+                # replica-level shed, or one router-shed request would
+                # inflate dlrm_serve_rejected_total (and the pooled
+                # summary's `rejected`) N-fold — the router records
+                # the ONE real shed below
+                fut = b.submit(inputs, timeout_us, record_shed=False)
+            except Rejected:
+                continue  # this replica is saturated; try the next
+            with self._lock:
+                self._inflight[i] += 1
+            fut.add_done_callback(lambda _f, i=i: self._release(i))
+            return fut
+        # every replica refused.  Re-check _closed before calling it a
+        # shed: a submit racing close() sees every probe refused because
+        # the batchers were swept, not because traffic saturated them —
+        # that is a shutdown reject, and counting it would pollute
+        # dlrm_serve_router_shed_total's pure-saturation signal.
+        with self._lock:
+            closed = self._closed
+        if closed:
+            raise self._reject_shutdown()
+        # THE router-level shed.  The count goes through the metrics
+        # module so it stays monotone even when the fold-on-retire
+        # races a late submit; the emit runs outside every lock.
+        _metrics.record_router_shed(self._shed_cell)
+        emit("serve", phase="reject", reason="router_saturated")
+        raise Rejected(
+            f"all {len(self.batchers)} replicas saturated — router "
+            f"shedding")
+
+    def _reject_shutdown(self) -> Rejected:
+        """Record + emit one post-shutdown reject and build its
+        exception.  Counts into ``dlrm_serve_rejected_total`` exactly
+        like a submit on a closed batcher would (the retired batchers'
+        stats are folded, so the count lands in the retained base) —
+        /metrics and the event stream stay in agreement during
+        shutdown."""
+        _metrics.record_shed_late(self.batchers[0].stats)
+        emit("serve", phase="reject", reason="shutdown")
+        return Rejected("router is shut down")
+
+    def predict(self, inputs: Dict[str, Any],
+                timeout_us: Optional[float] = None,
+                result_timeout_s: Optional[float] = None):
+        """Blocking convenience: submit + wait for the result."""
+        return self.submit(inputs, timeout_us).result(result_timeout_s)
+
+    # -------------------------------------------------------------- metrics
+    def replica_labels(self) -> List[str]:
+        return [f"{self.name}{i}" for i in range(len(self.batchers))]
+
+    def shed_count(self) -> int:
+        """Router-level sheds so far (requests no replica could take)."""
+        return _metrics.router_shed_count(self._shed_cell)
+
+    # ------------------------------------------------------------- shutdown
+    def close(self, drain: bool = True,
+              emit_summary: bool = True) -> Dict[str, Any]:
+        """Stop intake on every replica and close them IN PARALLEL
+        (graceful by default: each replica drains its queue and
+        delivers every future before its dispatcher exits).  Returns a
+        pooled summary — totals, pooled latency percentiles, the
+        router-level shed count, and ``per_replica`` breakdowns — and
+        by default emits it as one ``serve`` ``phase="summary"`` event
+        (replica batchers fold their counters into /metrics' retained
+        base as they retire; their per-batcher summary events are
+        suppressed in favor of this pooled one).  Idempotent like
+        ``DynamicBatcher.close`` — winner election, parked concurrent
+        closers, and failed-shutdown un-elect shared via
+        :class:`~.batcher._CloseOnce`."""
+        return self._closer.run(lambda: self._close(drain, emit_summary))
+
+    def _close(self, drain: bool, emit_summary: bool) -> Dict[str, Any]:
+        with self._lock:
+            self._closed = True
+        per: List[Optional[Dict[str, float]]] = [None] * len(self.batchers)
+        errs: List[BaseException] = []
+
+        def closer(i: int, b: DynamicBatcher) -> None:
+            try:
+                per[i] = b.close(drain=drain, emit_summary=False)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=closer, args=(i, b),
+                                    name=f"dlrm-router-close-{i}",
+                                    daemon=True)
+                   for i, b in enumerate(self.batchers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        # wall measured AFTER the parallel drain: requests served while
+        # draining are in the replicas' counts, so the pooled qps must
+        # span the time they took (same contract as the batcher, whose
+        # summary wall closes after the dispatcher join)
+        wall_s = time.perf_counter() - self._t0
+        pooled = np.asarray([v for b in self.batchers
+                             for v in b.stats.samples()])
+        summary: Dict[str, Any] = {
+            "replicas": len(self.batchers),
+            "wall_s": float(wall_s),
+            "requests": int(sum(s["requests"] for s in per)),
+            "dispatches": int(sum(s["dispatches"] for s in per)),
+            "rejected": int(sum(s["rejected"] for s in per)),
+            "deadline_misses": int(sum(s["deadline_misses"]
+                                       for s in per)),
+            "router_shed": int(self.shed_count()),
+        }
+        summary["qps"] = summary["requests"] / max(wall_s, 1e-9)
+        if pooled.size:
+            p50, p95, p99 = np.percentile(pooled, [50, 95, 99])
+            summary.update(p50_us=float(p50), p95_us=float(p95),
+                           p99_us=float(p99),
+                           mean_us=float(pooled.mean()))
+        ev = dict(summary)  # schema-shaped (per_replica is report-only)
+        summary["per_replica"] = per
+        _metrics.retire_router(self)
+        if emit_summary:
+            emit("serve", phase="summary", **ev)
+        return summary
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

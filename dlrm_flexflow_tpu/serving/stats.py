@@ -171,6 +171,19 @@ class LatencyStats:
         return float(LATENCY_BUCKETS_US[-1])  # rank in the +Inf slot
 
     # ------------------------------------------------------------- reading
+    def samples(self) -> List[float]:
+        """One locked copy of the latency reservoir — the router pools
+        replica reservoirs into its combined percentile summary."""
+        with self._lock:
+            return list(self._lat_us)
+
+    def lifetime_qps(self) -> float:
+        """Served requests per second since construction (the live
+        per-replica QPS gauge; 0.0 before any traffic)."""
+        with self._lock:
+            n = self.count
+        return n / max(time.perf_counter() - self._t0, 1e-9)
+
     def percentile(self, p: float) -> Optional[float]:
         """The p-th percentile (0..100) of recorded latencies in us, by
         linear interpolation between closest ranks; None with no

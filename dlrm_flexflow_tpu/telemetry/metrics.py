@@ -60,6 +60,14 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "dlrm_serve_bucket_latency_us": (
         "histogram",
         "engine forward wall per dispatch, labelled by compiled bucket"),
+    "dlrm_serve_replica_qps": (
+        "gauge", "lifetime-average served QPS per routed serving "
+                 "replica (served count / seconds since construction)"),
+    "dlrm_serve_replica_queue_depth": (
+        "gauge", "requests waiting per routed serving replica queue"),
+    "dlrm_serve_router_shed_total": (
+        "counter",
+        "requests a ReplicaRouter shed with every replica saturated"),
     "dlrm_train_steps_total": (
         "counter", "training dispatches adopted (global steps)"),
     "dlrm_train_samples_per_s": (
@@ -160,6 +168,14 @@ class LabeledCounter(Metric):
     def expose(self) -> List[str]:
         return [f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}'
                 for k, v in sorted(self._fn().items())]
+
+
+class LabeledGauge(LabeledCounter):
+    """Pull-based gauge family with one label: ``fn`` returns
+    {label_value: value} at scrape time.  Rows come and go with the
+    live objects behind them (gauges carry no monotonicity contract —
+    a retired replica's row simply disappears).  Same exposition as
+    :class:`LabeledCounter`; only the contract differs."""
 
 
 class Histogram(Metric):
@@ -376,6 +392,122 @@ def _queue_depth() -> float:
     return float(sum(b._q.qsize() for b in list(_live_batchers)))
 
 
+# ------------------------------------------------------- router collection
+#
+# Router-level shed counts follow the SAME monotone discipline as the
+# batcher counters: a live router's count lives in a _ShedCell swept by
+# scrapes; retire_router folds it into the retained base atomically
+# under _retired_lock, and every increment goes through
+# record_router_shed, which routes post-fold sheds (a submit racing
+# close) straight into the base.  A router abandoned without close()
+# folds via a GC finalizer that only queues its cell on a lock-free
+# deque (finalizers must never contend for _retired_lock).  The
+# per-replica qps/queue-depth gauge families carry no monotonicity
+# contract — rows come from the live-router weakset and vanish on
+# retire/GC.
+
+class _ShedCell:
+    """One router's shed count, mutated ONLY under ``_retired_lock``."""
+
+    __slots__ = ("n", "folded")
+
+    def __init__(self):
+        self.n = 0
+        self.folded = False
+
+
+_live_routers: "weakref.WeakSet" = weakref.WeakSet()
+_live_shed_cells: set = set()          # strong refs until folded
+_pending_router_folds: deque = deque()
+_retired_router_shed = 0
+
+
+def _fold_shed_cell_locked(cell: _ShedCell) -> None:
+    global _retired_router_shed
+    if not cell.folded:
+        cell.folded = True
+        _retired_router_shed += cell.n
+    _live_shed_cells.discard(cell)
+
+
+def _drain_router_pending_locked() -> None:
+    while True:
+        try:
+            cell = _pending_router_folds.popleft()
+        except IndexError:
+            return
+        _fold_shed_cell_locked(cell)
+
+
+def _finalize_router(cell: _ShedCell) -> None:
+    _pending_router_folds.append(cell)  # lock-free; folded at next scrape
+
+
+def track_router(router) -> _ShedCell:
+    """Called by ``ReplicaRouter.__init__``: expose the per-replica
+    gauge rows and the router's shed count until it closes
+    (``retire_router``) or is collected (finalizer queues the cell so
+    the counter stays monotone).  Returns the router's shed cell."""
+    cell = _ShedCell()
+    with _retired_lock:
+        _drain_router_pending_locked()
+        _live_shed_cells.add(cell)
+    _live_routers.add(router)
+    weakref.finalize(router, _finalize_router, cell)
+    return cell
+
+
+def retire_router(router) -> None:
+    """Called by ``ReplicaRouter.close``: fold the shed count into the
+    retained base and drop the gauge rows."""
+    with _retired_lock:
+        _drain_router_pending_locked()
+        _fold_shed_cell_locked(router._shed_cell)
+    _live_routers.discard(router)
+
+
+def record_router_shed(cell: _ShedCell) -> None:
+    """Count one router-level shed.  Post-fold sheds (a submit racing
+    close) land in the retained base directly, so the exposed counter
+    never loses one."""
+    global _retired_router_shed
+    with _retired_lock:
+        if cell.folded:
+            _retired_router_shed += 1
+        else:
+            cell.n += 1
+
+
+def router_shed_count(cell: _ShedCell) -> int:
+    """One router's shed count so far (its own cell only — folded cells
+    keep their final value for the router's summary)."""
+    with _retired_lock:
+        return int(cell.n)
+
+
+def _router_shed_total() -> float:
+    with _retired_lock:
+        _drain_router_pending_locked()
+        return float(_retired_router_shed
+                     + sum(c.n for c in _live_shed_cells))
+
+
+def _replica_qps() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for r in list(_live_routers):
+        for label, b in zip(r.replica_labels(), r.batchers):
+            out[label] = out.get(label, 0.0) + b.stats.lifetime_qps()
+    return out
+
+
+def _replica_queue_depth() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for r in list(_live_routers):
+        for label, b in zip(r.replica_labels(), r.batchers):
+            out[label] = out.get(label, 0.0) + float(b.queue_depth())
+    return out
+
+
 # the scrape collectors hold _retired_lock across the pending-fold
 # drain, the retained base, AND the live sweep, so fold transitions are
 # invisible to them and the exposed counters are exactly-once sums
@@ -505,6 +637,13 @@ SERVE_LATENCY = REGISTRY.register(
 SERVE_BUCKET_LATENCY = REGISTRY.register(
     LabeledHistogram("dlrm_serve_bucket_latency_us", "bucket",
                      LATENCY_BUCKETS_US, _bucket_latency_hists))
+SERVE_REPLICA_QPS = REGISTRY.register(
+    LabeledGauge("dlrm_serve_replica_qps", "replica", _replica_qps))
+SERVE_REPLICA_QUEUE_DEPTH = REGISTRY.register(
+    LabeledGauge("dlrm_serve_replica_queue_depth", "replica",
+                 _replica_queue_depth))
+SERVE_ROUTER_SHED = REGISTRY.register(
+    Gauge("dlrm_serve_router_shed_total", fn=_router_shed_total))
 TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
 TRAIN_SAMPLES_PER_S = REGISTRY.register(
     Gauge("dlrm_train_samples_per_s"))

@@ -81,6 +81,16 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         b = h.get("bucket")
         if b is not None:
             name = f"{name}:bucket={b}"
+        # serving topology: an N-replica router run and a mesh-native
+        # run measure different serving shapes — neither may gate
+        # against the single-replica / single-device baseline (entries
+        # predating the fields count as replicas=1, no mesh)
+        r = h.get("replicas")
+        if r is not None and int(r) != 1:
+            name = f"{name}:replicas={r}"
+        ms = h.get("mesh")
+        if ms:
+            name = f"{name}:mesh={ms}"
         # later entries overwrite: the NEWEST anchors the gate.  Only
         # THIS entry's own derived riders are replaced — a plain-name
         # prefix sweep would also delete the ":quantize=..." anchors a

@@ -141,7 +141,8 @@ SCHEMA: Dict[str, dict] = {
                      "requests": int, "dispatches": int,
                      "rejected": int, "deadline_misses": int,
                      "wall_s": float, "qps": float, "p50_us": float,
-                     "p95_us": float, "p99_us": float, "mean_us": float},
+                     "p95_us": float, "p99_us": float, "mean_us": float,
+                     "replicas": int, "router_shed": int},
         "phases": {
             "dispatch": ("batch", "bucket", "queue_wait_us",
                          "compute_us"),

@@ -14,7 +14,18 @@ of ``check_resilience.py`` (docs/serving.md):
      explicit ``Rejected`` (and a ``serve`` reject event), it never
      queues unbounded work;
   4. graceful drain — ``close()`` delivers every in-flight response
-     before shutdown and emits the latency summary with percentiles.
+     before shutdown and emits the latency summary with percentiles;
+  5. mesh-native engine — under a data+model mesh every bucket is
+     AOT-compiled UNDER the mesh (``kind="aot"`` compile events, ZERO
+     steady-state compiles); a full-mesh replica answers
+     bit-identically to the single-device engine on every bucket
+     incl. top-bucket chunking, and a table-parallel sharded engine
+     (params placed by the spec-driven partition rules) holds
+     ULP-level tolerance — its collectives reorder FP reductions;
+  6. router absorbs overload — an open-loop QPS target that one
+     replica demonstrably sheds (>10% rejected at the bounded queue)
+     is absorbed by a 4-replica least-loaded ``ReplicaRouter`` (0
+     shed, every future delivered, no deadline misses).
 
 Exit 0 when every scenario passes; prints one line per scenario and
 exits 1 otherwise.
@@ -26,10 +37,19 @@ import os
 import sys
 import tempfile
 import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the mesh scenario wants a multi-device platform; standalone runs on
+# the CPU backend pin the virtual device count BEFORE jax initializes
+# (under pytest, tests/conftest.py has already set the same flag)
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 import numpy as np  # noqa: E402
 
@@ -37,7 +57,8 @@ import dlrm_flexflow_tpu as ff  # noqa: E402
 from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
 from dlrm_flexflow_tpu.resilience import CheckpointManager  # noqa: E402
 from dlrm_flexflow_tpu.serving import (DynamicBatcher,  # noqa: E402
-                                       InferenceEngine, Rejected)
+                                       InferenceEngine, Rejected,
+                                       ReplicaRouter)
 from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
 
 BUCKETS = "2,4,8"
@@ -162,11 +183,178 @@ def scenario_graceful_drain(cfg, m) -> str:
     return ""
 
 
+def scenario_mesh_sharded_engine(cfg, m) -> str:
+    """Mesh-native serving on BOTH topologies (docs/serving.md): a
+    full-mesh REPLICA (all params replicated) answers bit-identically
+    to the single-device engine on every bucket incl. top-bucket
+    chunking; a table-parallel SHARDED engine (params placed by the
+    spec-driven partition rules, buckets rounded up to the data axis
+    and data-sharded) is pinned at ULP-level tolerance instead — its
+    collectives reorder floating-point reductions.  Every bucket of
+    both engines AOT-compiles UNDER the mesh (``kind="aot"`` events)
+    and steady-state traffic never compiles anything."""
+    import jax
+
+    if jax.device_count() < 4:
+        return f"platform has {jax.device_count()} devices, need 4"
+
+    def build(mesh, table_parallel):
+        # uniform tables so the stacked (T, R, d) weight's table axis
+        # divides the 2-way model axis
+        c = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 64],
+                       embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                       mlp_top=[8 * 2 + 8, 8, 1])
+        mm = build_dlrm(c, ff.FFConfig(batch_size=8, serve_buckets=BUCKETS),
+                        table_parallel=table_parallel)
+        mm.compile(optimizer=ff.AdamOptimizer(0.01),
+                   loss_type="mean_squared_error", metrics=(), mesh=mesh)
+        return c, mm
+
+    cfg1, m1 = build(False, False)                       # single device
+    mesh = ff.make_mesh({"data": 2, "model": 2})
+    _, m_rep = build(mesh, False)                        # full-mesh replica
+    _, m_sh = build(mesh, True)                          # table-parallel
+    e1 = InferenceEngine(m1, m1.init(seed=0))
+    with event_log() as log:
+        e_rep = InferenceEngine(m_rep, m_rep.init(seed=0))
+        # odd buckets pin the sharded constructor's round-up: 1,3 must
+        # become the data-divisible 2,4 (8 already divides)
+        e_sh = InferenceEngine(m_sh, m_sh.init(seed=0), buckets="1,3,8")
+        aot = [e for e in log.events("compile")
+               if e.get("kind") == "aot"]
+    want_aot = len(e_rep.buckets) + len(e_sh.buckets)
+    if len(aot) != want_aot:
+        return (f"warmup built {len(aot)} aot programs for "
+                f"{want_aot} buckets ({[e.get('fn') for e in aot]})")
+    if e_sh.buckets != [2, 4, 8]:
+        return (f"sharded engine kept data-indivisible buckets "
+                f"{e_sh.buckets} (wanted [2, 4, 8])")
+    if e_rep._mesh_sharded or not e_sh._mesh_sharded:
+        return (f"topology misclassified: replica sharded="
+                f"{e_rep._mesh_sharded}, sharded={e_sh._mesh_sharded}")
+    spec = tuple(e_sh._params["emb"]["embedding"].sharding.spec)
+    if "model" not in spec:
+        return f"embedding not table-sharded under the mesh ({spec})"
+    # model-ONLY topology (no data axis, dsize=1): the replicated batch
+    # flows into table-sharded gathers with no bucket round-up or batch
+    # sharding.  Verified correct on the pinned jax (the
+    # summing-collective hazard the constructor documents needs the
+    # unused data axis present) — pinned here so a jax upgrade can't
+    # silently regress it to 2x-wrong values.
+    _, m_mo = build(ff.make_mesh({"model": 2}), True)
+    e_mo = InferenceEngine(m_mo, m_mo.init(seed=0), buckets="1,8")
+    rng = np.random.default_rng(7)
+    with event_log() as log:
+        for n in (1, 3, 4, 7, 11):  # padding AND top-bucket chunking
+            x = make_request(cfg1, rng, n)
+            want = np.asarray(e1.predict(x))
+            got = e_rep.predict(x)
+            if got.shape != want.shape:
+                return f"n={n}: shape {got.shape} != {want.shape}"
+            if not np.array_equal(got, want):
+                return (f"n={n}: full-mesh replica differs from "
+                        f"single-device by {np.abs(got - want).max()} "
+                        f"— replicated programs must be bit-identical")
+            got = e_sh.predict(x)
+            if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
+                return (f"n={n}: sharded engine off by "
+                        f"{np.abs(got - want).max()} — beyond "
+                        f"reduction-reorder tolerance")
+            got = e_mo.predict(x)
+            if not np.allclose(got, want, rtol=1e-5, atol=1e-6):
+                return (f"n={n}: model-only sharded engine off by "
+                        f"{np.abs(got - want).max()} — the replicated-"
+                        f"batch/sharded-gather path must stay correct")
+        recompiles = log.events("compile")
+    if recompiles:
+        return (f"{len(recompiles)} steady-state compile(s) under the "
+                f"mesh — the AOT path must pin zero")
+    return ""
+
+
+class _SlowEngine(InferenceEngine):
+    """Fixed +delay per dispatch: makes the overload point of the
+    open-loop scenario deterministic instead of machine-dependent."""
+
+    def __init__(self, *args, delay_s: float = 0.02, **kwargs):
+        self._delay_s = delay_s
+        super().__init__(*args, **kwargs)
+
+    def predict(self, inputs, queue_wait_us: float = 0.0):
+        time.sleep(self._delay_s)
+        return super().predict(inputs, queue_wait_us)
+
+
+def _offer_open_loop(target, cfg, qps: float, duration: float):
+    """Fixed-rate arrivals for ``duration`` seconds (the coordinated-
+    omission-free model serve_bench uses); returns (futures, shed,
+    offered)."""
+    rng = np.random.default_rng(11)
+    pool = [make_request(cfg, rng) for _ in range(16)]
+    futures, shed, k = [], 0, 0
+    period = 1.0 / qps
+    t0 = time.perf_counter()
+    while True:
+        now = time.perf_counter()
+        if now - t0 >= duration:
+            break
+        tgt = t0 + k * period
+        if tgt > now:
+            time.sleep(tgt - now)
+        try:
+            futures.append(target.submit(pool[k % len(pool)]))
+        except Rejected:
+            shed += 1
+        k += 1
+    return futures, shed, k
+
+
+def scenario_router_absorbs_overload(cfg, m) -> str:
+    """An offered QPS one replica sheds >10% of must pass through a
+    4-replica router with ZERO sheds and no deadline misses: 60
+    requests arrive at 200 QPS against a 20 ms/dispatch service
+    (unbatched), so one depth-16 queue must overflow while 4 of them
+    (64 slots) cannot."""
+    engine = _SlowEngine(m, m.init(seed=0))
+    one = DynamicBatcher(engine, max_batch_size=1, queue_depth=16)
+    _futs, shed, offered = _offer_open_loop(one, cfg, qps=200.0,
+                                            duration=0.3)
+    one.close()  # drain; the shed ones already failed at submit
+    if offered == 0:
+        return "open loop offered nothing"
+    if shed / offered <= 0.10:
+        return (f"single replica shed only {shed}/{offered} — the "
+                f"overload point is miscalibrated")
+    router = ReplicaRouter([engine] * 4, max_batch_size=1,
+                           queue_depth=16)
+    futs, rshed, roffered = _offer_open_loop(router, cfg, qps=200.0,
+                                             duration=0.3)
+    summary = router.close()
+    if rshed or summary["router_shed"]:
+        return (f"router shed {rshed} of {roffered} "
+                f"(router_shed={summary['router_shed']}) — 4x16 queue "
+                f"slots must absorb {roffered} arrivals")
+    if summary["deadline_misses"]:
+        return f"{summary['deadline_misses']} deadline misses"
+    for i, f in enumerate(futs):
+        try:
+            f.result(30.0)
+        except Exception as e:  # noqa: BLE001 — reported below
+            return f"future {i} failed after drain: {e!r}"
+    if summary["requests"] != roffered:
+        return (f"router served {summary['requests']} of {roffered} "
+                f"offered")
+    return ""
+
+
 SCENARIOS = [
     ("checkpoint->engine bit-exact buckets", scenario_checkpoint_to_engine),
     ("concurrent micro-batched traffic", scenario_concurrent_traffic),
     ("overload shedding", scenario_overload_shed),
     ("graceful drain", scenario_graceful_drain),
+    ("mesh-native engine (replica bit-exact, sharded tol)",
+     scenario_mesh_sharded_engine),
+    ("router absorbs overload", scenario_router_absorbs_overload),
 ]
 
 
@@ -186,7 +374,7 @@ def main() -> int:
     if failed:
         return 1
     print(f"check_serving: OK ({len(SCENARIOS)} serving paths)")
-    return 0
+    return 0  # 6 paths: 4 single-replica + mesh engine + router
 
 
 if __name__ == "__main__":

@@ -29,6 +29,13 @@ training checkpoint (optimizer slots skipped — checkpoint.py
 inference-only restore) instead of a fresh init; ``--metrics-port N``
 serves live Prometheus metrics at ``http://:N/metrics`` for the run's
 duration (docs/telemetry.md).
+
+``--replicas N`` routes the load through a least-loaded
+:class:`ReplicaRouter` over N batcher replicas (per-replica breakdown
+in the report: dispatched / shed / p99 — the router-absorbs-overload
+claim visible in one run's output); ``--mesh-shape data=2,model=4``
+compiles and serves mesh-native (sharded params, AOT bucket programs
+under the mesh — docs/serving.md).
 """
 
 from __future__ import annotations
@@ -45,17 +52,59 @@ if __name__ == "__main__":
     # standalone default; NOT set when bench.py imports closed_loop on
     # a real accelerator (backend init is lazy, so this is early enough)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # a --mesh-shape run on the CPU backend needs the virtual device
+    # count pinned BEFORE jax initializes (the flag is read at backend
+    # start); respect an explicit XLA_FLAGS from the caller.  Both
+    # argparse spellings ("--mesh-shape SPEC" and "--mesh-shape=SPEC")
+    # must hit this path.
+    _spec = None
+    for _j, _arg in enumerate(sys.argv):
+        if _arg == "--mesh-shape" and _j + 1 < len(sys.argv):
+            _spec = sys.argv[_j + 1]
+        elif _arg.startswith("--mesh-shape="):
+            _spec = _arg.partition("=")[2]
+    if _spec is not None and os.environ.get(
+            "JAX_PLATFORMS") == "cpu" and \
+            "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        try:
+            _n = 1
+            for _part in _spec.split(","):
+                _n *= int(_part.partition("=")[2] or 1)
+        except ValueError:
+            _n = 1
+        if _n > 1:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={_n}").strip()
 
 import numpy as np  # noqa: E402
 
 import dlrm_flexflow_tpu as ff  # noqa: E402
 from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
 from dlrm_flexflow_tpu.serving import (DynamicBatcher,  # noqa: E402
-                                       InferenceEngine, Rejected)
+                                       InferenceEngine, Rejected,
+                                       ReplicaRouter)
 from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
 
 
+def parse_mesh_shape(spec: str):
+    """``"data=2,model=4"`` -> {"data": 2, "model": 4}; "" -> None."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    shape = {}
+    for part in spec.split(","):
+        axis, _, n = part.partition("=")
+        if not axis or not n:
+            raise ValueError(
+                f"--mesh-shape wants axis=N[,axis=N...], got {spec!r}")
+        shape[axis.strip()] = int(n)
+    return shape
+
+
 def build_model(args):
+    mesh_shape = parse_mesh_shape(getattr(args, "mesh_shape", ""))
     cfg = DLRMConfig(sparse_feature_size=args.emb_dim,
                      embedding_size=[args.table_rows] * args.tables,
                      embedding_bag_size=args.bag,
@@ -67,9 +116,13 @@ def build_model(args):
                      serve_max_wait_us=args.max_wait_us,
                      serve_queue_depth=args.queue_depth,
                      serve_timeout_us=args.timeout_us)
-    m = build_dlrm(cfg, fc)
+    # table-parallel strategies only make sense with a model axis to
+    # shard over; a pure-data mesh serves replicated params
+    table_parallel = bool(mesh_shape and mesh_shape.get("model", 1) > 1)
+    m = build_dlrm(cfg, fc, table_parallel=table_parallel)
+    mesh = ff.make_mesh(mesh_shape) if mesh_shape else False
     m.compile(optimizer=ff.SGDOptimizer(0.01),
-              loss_type="mean_squared_error", metrics=(), mesh=False)
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
     return cfg, m
 
 
@@ -166,6 +219,16 @@ def main(argv=None) -> int:
                    help="open-loop window seconds")
     p.add_argument("--rows", type=int, default=1,
                    help="rows per request")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serving replicas behind a least-loaded "
+                        "ReplicaRouter (1 = single DynamicBatcher); "
+                        "replicas share one engine (queue-level "
+                        "replication) — docs/serving.md")
+    p.add_argument("--mesh-shape", default="",
+                   help="compile + serve under a device mesh, e.g. "
+                        "data=2,model=4 (model>1 builds the "
+                        "table-parallel strategy); empty = single "
+                        "device")
     p.add_argument("--buckets", default="1,8,32")
     p.add_argument("--max-wait-us", type=float, default=1000.0)
     p.add_argument("--queue-depth", type=int, default=256)
@@ -218,7 +281,13 @@ def main(argv=None) -> int:
             print(f"serve_bench: quantized tables ({q['mode']}): "
                   f"{q['bytes_before']:,} -> {q['bytes_after']:,} bytes")
         pool = request_pool(cfg, args)
-        batcher = DynamicBatcher(engine)
+        if args.replicas > 1:
+            # N batcher replicas over ONE engine (shared params + AOT
+            # cache; each replica still has its own queue + dispatcher
+            # thread) — pass distinct engines for per-slice serving
+            batcher = ReplicaRouter([engine] * args.replicas)
+        else:
+            batcher = DynamicBatcher(engine)
         if args.mode == "closed":
             wall, rejected = closed_loop(batcher, pool, args.clients,
                                          args.requests)
@@ -230,6 +299,8 @@ def main(argv=None) -> int:
     qps = served / max(wall, 1e-9)
     line = (f"serve_bench[{args.mode}]: {served} requests in "
             f"{wall:.2f}s = {qps:,.0f} QPS")
+    if args.replicas > 1:
+        line += f" across {args.replicas} replicas"
     if "p50_us" in summary:
         line += (f"; latency p50 {summary['p50_us']:.0f} us / "
                  f"p95 {summary['p95_us']:.0f} us / "
@@ -238,6 +309,17 @@ def main(argv=None) -> int:
         line += (f" ({rejected} rejected, "
                  f"{summary.get('deadline_misses', 0)} deadline misses)")
     print(line)
+    for i, rep in enumerate(summary.get("per_replica") or []):
+        # the absorb claim in one run's output: who dispatched, who
+        # shed (local queue_full probes), each replica's tail
+        p99 = (f"{rep['p99_us']:.0f} us" if "p99_us" in rep else "n/a")
+        print(f"serve_bench:   replica {i}: {rep['requests']} served / "
+              f"{rep['dispatches']} dispatched, {rep['rejected']} shed, "
+              f"p99 {p99}")
+    if args.replicas > 1:
+        print(f"serve_bench:   router shed "
+              f"{summary.get('router_shed', 0)} request(s) — a shed "
+              f"means ALL {args.replicas} replicas were saturated")
     print(f"serve_bench: telemetry -> {args.telemetry} "
           f"(python -m dlrm_flexflow_tpu.telemetry report "
           f"{os.path.relpath(args.telemetry, os.getcwd())})")

@@ -153,6 +153,25 @@ class TestLockDiscipline:
         assert _codes(fs) == ["emit-under-lock"]
         assert fs[0].line == 8 and "helper()" in fs[0].message
 
+    def test_fires_router_emit_under_shed_lock(self, tmp_path):
+        # the router's shed path: counting under the lock is fine,
+        # emitting telemetry under it is the bug the real router avoids
+        # (serving/router.py emits after every lock is released)
+        fs = _run_pass(tmp_path, {"pkg/rt.py": (
+            "import threading\n"
+            "from x import emit\n"
+            "class Router:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.shed = 0\n"
+            "    def reject(self):\n"
+            "        with self._lock:\n"
+            "            self.shed += 1\n"
+            "            emit('serve', phase='reject')\n"
+        )}, LockDisciplinePass)
+        assert _codes(fs) == ["emit-under-lock"]
+        assert "Router._lock" in fs[0].message
+
     def test_silent_emit_outside_lock(self, tmp_path):
         fs = _run_pass(tmp_path, {"pkg/e.py": (
             "import threading\n"
@@ -739,6 +758,50 @@ class TestSharedState:
             "    def submit(self, item):\n"
             "        if self.depth > 0:\n"
             "            self._q.put(item)\n")}, SharedStatePass)
+        assert fs == []
+
+    def test_fires_router_unlocked_inflight(self, tmp_path):
+        # the replica-router shape (serving/router.py): a dispatcher
+        # thread and the public submit both mutate the in-flight
+        # counters — without a common lock the least-loaded snapshot
+        # reads torn state
+        fs = _run_pass(tmp_path, {"pkg/router.py": (
+            "import threading\n"
+            "class Router:\n"
+            "    def __init__(self):\n"
+            "        self.inflight = [0, 0]\n"
+            "        self._t = threading.Thread(target=self._drain)\n"
+            "    def _drain(self):\n"
+            "        self.inflight[0] -= 1\n"
+            "    def submit(self, i):\n"
+            "        self.inflight[i] += 1\n"
+            "        return min(range(2), key=self.inflight.__getitem__)\n"
+        )}, SharedStatePass)
+        assert _codes(fs) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "Router.inflight"
+
+    def test_silent_router_locked_inflight(self, tmp_path):
+        # the REAL router's discipline: in-flight accounting under one
+        # lock on both sides, queue probing through the thread-safe
+        # Queue — nothing to report
+        fs = _run_pass(tmp_path, {"pkg/router.py": (
+            "import queue\n"
+            "import threading\n"
+            "class Router:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "        self.inflight = [0, 0]\n"
+            "        self._t = threading.Thread(target=self._drain)\n"
+            "    def _drain(self):\n"
+            "        i = self._q.get()\n"
+            "        with self._lock:\n"
+            "            self.inflight[i] -= 1\n"
+            "    def submit(self, i):\n"
+            "        self._q.put(i)\n"
+            "        with self._lock:\n"
+            "            self.inflight[i] += 1\n"
+        )}, SharedStatePass)
         assert fs == []
 
     def test_lock_held_through_call_chain(self, tmp_path):

@@ -1,8 +1,10 @@
 """Online serving tests (serving/, docs/serving.md): bucket selection +
 padding bit-exactness vs direct ``FFModel.predict``, inference-only
 checkpoint restore, queue shedding under overload, per-request deadline
-timeouts, graceful drain, latency-stat math, serve telemetry + report
-section, and the tier-1 smoke matrix."""
+timeouts, graceful drain, least-loaded replica routing (shed only when
+EVERY replica is saturated, pooled drain summary, per-replica /metrics
+rows), latency-stat math, serve telemetry + report section, and the
+tier-1 smoke matrix (incl. the mesh-native engine scenarios)."""
 
 import os
 import subprocess
@@ -21,7 +23,8 @@ from dlrm_flexflow_tpu.model import TrainState
 from dlrm_flexflow_tpu.resilience import CheckpointManager
 from dlrm_flexflow_tpu.serving import (DeadlineExceeded, DynamicBatcher,
                                        InferenceEngine, LatencyStats,
-                                       Rejected, parse_buckets)
+                                       Rejected, ReplicaRouter,
+                                       parse_buckets)
 from dlrm_flexflow_tpu.telemetry import event_log
 from dlrm_flexflow_tpu.telemetry.report import format_report, load_events
 
@@ -102,8 +105,9 @@ class TestPaddingBitExact:
         assert np.array_equal(got, want)
 
     def test_jit_fallback_engine_matches_aot(self, served):
-        # aot=False is the mesh path: the jitted forward serves instead
-        # of explicit executables — numerics must be identical
+        # aot=False keeps the cached-jit path: the jitted forward
+        # serves instead of explicit executables — numerics must be
+        # identical
         cfg, m, state, _ = served
         engine = InferenceEngine(m, state, buckets=[2], aot=False)
         x = make_request(cfg, np.random.default_rng(3), 1)
@@ -297,6 +301,30 @@ class TestBatcher:
             b.submit(make_request(cfg, rng, 5))
         b.close()
 
+    def test_raising_done_callback_does_not_kill_dispatcher(self, served,
+                                                            capsys):
+        from dlrm_flexflow_tpu.serving.batcher import ServeFuture
+
+        # a raising callback is reported and swallowed (like
+        # concurrent.futures): neither completion path propagates it
+        f = ServeFuture()
+        boom = lambda _f: (_ for _ in ()).throw(RuntimeError("boom"))
+        f.add_done_callback(boom)
+        f._set(1)  # must not raise
+        assert "boom" in capsys.readouterr().err
+        f.add_done_callback(boom)  # already-done immediate-fire path
+        assert "boom" in capsys.readouterr().err
+        # end-to-end: the dispatcher survives a raising callback and
+        # keeps delivering later requests
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(3)
+        with DynamicBatcher(engine, max_wait_us=200) as b:
+            f1 = b.submit(make_request(cfg, rng))
+            f1.add_done_callback(boom)
+            f1.result(30)
+            f2 = b.submit(make_request(cfg, rng))  # dispatcher alive
+            f2.result(30)
+
     def test_single_unbatched_sample(self, served):
         cfg, m, state, engine = served
         rng = np.random.default_rng(7)
@@ -305,6 +333,149 @@ class TestBatcher:
         with DynamicBatcher(engine, max_wait_us=200) as b:
             out = b.predict(flat, result_timeout_s=30)
         assert np.array_equal(out, np.asarray(m.predict(state, x)))
+
+
+# ------------------------------------------------------------- router
+
+class TestReplicaRouter:
+    def test_least_loaded_spreads_queued_traffic(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        r = ReplicaRouter([engine] * 2, name="tll", autostart=False)
+        futs = [r.submit(make_request(cfg, rng)) for _ in range(4)]
+        # with dispatchers parked, ascending-load order must alternate
+        # replicas — never pile 4 requests on one queue
+        assert [b.queue_depth() for b in r.batchers] == [2, 2]
+        # a queued request appears in the batcher's queue AND the
+        # router's accepted count: load counts it ONCE
+        assert r.loads() == [2, 2]
+        summary = r.close()  # parallel drain starts both dispatchers
+        for f in futs:
+            assert f.done()
+            f.result(0)
+        assert summary["requests"] == 4 and summary["router_shed"] == 0
+        # in-flight accounting drained back to zero with the futures
+        assert r.loads() == [0, 0]
+
+    def test_sheds_only_when_every_replica_full(self, served):
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(0)
+        with event_log() as log:
+            r = ReplicaRouter([engine] * 2, name="tsh", queue_depth=2,
+                              autostart=False)
+            for _ in range(4):  # fills both depth-2 queues
+                r.submit(make_request(cfg, rng))
+            with pytest.raises(Rejected, match="saturated"):
+                r.submit(make_request(cfg, rng))
+            ev = log.last("serve")
+        assert ev["phase"] == "reject"
+        assert ev["reason"] == "router_saturated"
+        assert r.shed_count() == 1
+        # one replica full but another free -> NO router shed: the
+        # local queue_full probe lands on the free replica instead
+        r.batchers[0]._q.get()  # one slot opens on replica 0
+        fut = r.submit(make_request(cfg, rng))
+        assert not isinstance(fut, Exception)
+        assert r.shed_count() == 1
+        summary = r.close(drain=False)
+        assert summary["router_shed"] == 1
+
+    def test_pooled_summary_and_single_event(self, served):
+        cfg, m, state, engine = served
+        rng = np.random.default_rng(1)
+        # unbatched dispatch (max_batch_size=1): coalescing shifts a
+        # request's row OFFSET inside the micro-batch, which reorders
+        # SIMD lanes and costs a ULP — the bit-exact contract covers
+        # zero-padding one request, so routing must not coalesce here
+        reqs = [make_request(cfg, rng, 1) for _ in range(6)]
+        want = [np.asarray(m.predict(state, x)) for x in reqs]
+        with event_log() as log:
+            r = ReplicaRouter([engine] * 3, name="tps",
+                              max_batch_size=1, autostart=False)
+            futs = [r.submit(x) for x in reqs]
+            summary = r.close()
+            summaries = [e for e in log.events("serve")
+                         if e.get("phase") == "summary"]
+        # replica batchers retire silently; ONE pooled event, carrying
+        # the router shape the schema added (replicas, router_shed)
+        assert len(summaries) == 1
+        assert summaries[0]["replicas"] == 3
+        assert summaries[0]["router_shed"] == 0
+        assert summary["requests"] == 6
+        assert len(summary["per_replica"]) == 3
+        assert sum(s["requests"] for s in summary["per_replica"]) == 6
+        assert "p99_us" in summary  # pooled reservoir percentiles
+        for f, w in zip(futs, want):
+            assert np.array_equal(f.result(0), w)
+        assert r.close() is summary  # idempotent like the batcher
+
+    def test_closed_router_rejects_and_metrics_rows_retire(self, served):
+        from dlrm_flexflow_tpu.telemetry import metrics as tm
+
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(2)
+        r = ReplicaRouter([engine] * 2, name="tmr")
+        r.predict(make_request(cfg, rng), result_timeout_s=30)
+        body = tm.REGISTRY.render()
+        assert 'dlrm_serve_replica_qps{replica="tmr0"}' in body
+        assert 'dlrm_serve_replica_queue_depth{replica="tmr1"}' in body
+        shed_before = tm._router_shed_total()
+        r.close()
+        with pytest.raises(Rejected, match="shut down"):
+            r.submit(make_request(cfg, rng))
+        body = tm.REGISTRY.render()
+        # gauge rows vanish with the router; the shed counter is
+        # fold-on-retire monotone (never loses, never double-counts)
+        assert 'replica="tmr0"' not in body
+        assert tm._router_shed_total() == shed_before
+        assert "dlrm_serve_router_shed_total" in body
+
+    def test_summary_wall_spans_the_drain(self, served):
+        # pooled qps must be computed over a wall that INCLUDES the
+        # parallel drain — requests served while draining are in the
+        # replica counts, so freezing the wall at close() entry would
+        # overstate sustained throughput
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(5)
+        r = ReplicaRouter([engine] * 2, name="twd", autostart=False)
+        for _ in range(2):
+            r.submit(make_request(cfg, rng))
+        orig = r.batchers[0].close
+
+        def slow_close(**kw):
+            time.sleep(0.3)
+            return orig(**kw)
+
+        r.batchers[0].close = slow_close
+        summary = r.close()
+        assert summary["requests"] == 2
+        assert summary["wall_s"] >= 0.3
+
+    def test_submit_racing_close_is_shutdown_not_shed(self, served):
+        # a submit that passes the _closed fast path while close()
+        # sweeps the batchers sees every probe refused — that must
+        # surface as a SHUTDOWN reject, never inflate the
+        # pure-saturation dlrm_serve_router_shed_total counter
+        cfg, _, _, engine = served
+        rng = np.random.default_rng(4)
+        with event_log() as log:
+            r = ReplicaRouter([engine] * 2, name="trc", autostart=False)
+
+            def refuse_and_close(*a, **k):
+                r._closed = True  # close() lands mid-probe
+                raise Rejected("queue full")
+
+            for b in r.batchers:
+                b.submit = refuse_and_close
+            with pytest.raises(Rejected, match="shut down"):
+                r.submit(make_request(cfg, rng))
+            ev = log.last("serve")
+        assert ev["phase"] == "reject" and ev["reason"] == "shutdown"
+        assert r.shed_count() == 0
+
+    def test_needs_at_least_one_engine(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ReplicaRouter([])
 
 
 # ------------------------------------------------------------ latency stats
@@ -398,7 +569,7 @@ class TestServingTooling:
             capture_output=True, text=True,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert r.returncode == 0, r.stdout + r.stderr
-        assert "OK (4 serving paths)" in r.stdout
+        assert "OK (6 serving paths)" in r.stdout
 
     def test_serve_bench_reports_latency(self, tmp_path):
         tele = str(tmp_path / "tele.jsonl")
