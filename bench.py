@@ -356,6 +356,15 @@ def _windows(model, state, inputs, labels, batch, num_batches, epochs, reps,
             except Exception as e:  # tracing is best-effort provenance
                 print(f"# device-busy trace failed: {e!r}", file=sys.stderr)
     prov = {"device_busy_ms": busy_ms}
+    # host share of the best wall window (docs/pipeline.md): how far
+    # the wall headline sits above the busy-equivalent ceiling because
+    # of host-side work/queueing.  Rides the history entry (and the
+    # regress CLI's ":host_overhead_pct" lower-is-better gate) so a
+    # host-path regression can't hide behind an unchanged busy number.
+    if busy_ms:
+        wall_ms = best_t * 1e3
+        prov["host_overhead_pct"] = round(
+            max(0.0, 100.0 * (wall_ms - busy_ms) / wall_ms), 2)
     # XLA cost-analysis bytes of the window program (feeds hbm_util_pct;
     # judge r4 item 5).  Lowering does not execute, so donated buffers
     # are untouched; per-epoch (non-fused) programs scale by `epochs`.
@@ -415,6 +424,14 @@ def main():
     emb_dtype = os.environ.get("BENCH_EMB_DTYPE", "float32")
     ffconfig = ff.FFConfig(batch_size=batch, compute_dtype=dtype,
                            embedding_dtype=emb_dtype)
+    # BENCH_PREFETCH=N: async input-pipeline depth (FFConfig.
+    # prefetch_depth, docs/pipeline.md).  The headline windows dispatch
+    # scanned epochs (no per-batch loader on the hot path), so like
+    # BENCH_FUSED this is graph-shape-neutral provenance, NOT part of
+    # the anchor key — numerics are bit-exact prefetch on/off (pinned
+    # by tests/test_pipeline.py).
+    prefetch = int(os.environ.get("BENCH_PREFETCH", "0") or 0)
+    ffconfig.prefetch_depth = prefetch
     model = build_dlrm(cfg, ffconfig)
     # BENCH_STRATEGY=<strategy artifact>: run the headline under a
     # search-tune winner (sim/tune.py, docs/tuning.md).  The artifact is
@@ -479,6 +496,7 @@ def main():
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
            "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype},
           extra={"dtype": dtype, "fused": cfg.fused_interaction,
+                 "prefetch": prefetch,
                  "probe_us": round(probe_us, 1), **prov,
                  **({"strategy_version": strategy_version}
                     if strategy_version is not None else {}),
@@ -682,6 +700,10 @@ def bench_app(app: str):
     else:
         raise SystemExit(f"unknown BENCH_APP {app!r}")
 
+    # provenance of the input-pipeline knob (see main(): graph-shape-
+    # neutral, never part of the anchor key)
+    prefetch = int(os.environ.get("BENCH_PREFETCH", "0") or 0)
+    model.config.prefetch_depth = prefetch
     state = model.init(seed=0)
     thpt, probe_us, prov = _windows(model, state, inputs, labels, batch,
                                     nb, epochs, reps)
@@ -689,7 +711,8 @@ def bench_app(app: str):
                     batch, nb, epochs)
     _checkpoint_tail(model, state, app)
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
-    extra = {"dtype": dtype, "probe_us": round(probe_us, 1), **prov,
+    extra = {"dtype": dtype, "prefetch": prefetch,
+             "probe_us": round(probe_us, 1), **prov,
              **_mfu_extras(model, batch, epochs * nb, prov)}
     if app in CONV_APPS:
         # activation STORAGE dtype changes numerics (loss pinned only to

@@ -37,6 +37,9 @@ PACKAGE = "dlrm_flexflow_tpu"
 #: public API and so ranks above every subsystem; scripts/bench are
 #: entry points and may import anything.
 LAYERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    # stdlib-only thread primitives sit below everything: foundation
+    # modules (data/prefetch) and subsystems (serving) both reuse them
+    ("primitives", ("concurrency",)),
     ("foundation", ("tensor", "config", "initializers", "losses",
                     "metrics", "optim", "data", "native_lib",
                     "distributed", "analysis")),

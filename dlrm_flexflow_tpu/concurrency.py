@@ -1,0 +1,63 @@
+"""Small thread-coordination primitives shared across subsystems.
+
+Foundation-layer (imports nothing from the package) so both the serving
+side (``serving/batcher.py``, ``serving/router.py``) and the data side
+(``data/prefetch.py``) can reuse the same battle-tested shutdown
+protocol instead of hand-syncing copies — the drift the analysis
+suite's shared-state pass exists to prevent.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class CloseOnce:
+    """Winner-elected idempotent shutdown, shared by
+    :class:`~dlrm_flexflow_tpu.serving.DynamicBatcher`, the replica
+    router, and the prefetching dataloader so their close paths cannot
+    drift.  ``run(shutdown)`` elects exactly ONE caller to execute
+    ``shutdown()`` (returning the final summary); concurrent callers
+    park on an event and every later call returns the first summary
+    without re-running shutdown.  The lock guards ONLY the who-runs
+    flag and the stored summary (ffcheck lock-discipline — the shutdown
+    itself emits telemetry, completes futures, and joins threads, none
+    of which may run under a held lock).  A winner whose shutdown
+    RAISES un-elects itself so parked and later callers re-run it
+    instead of inheriting a None summary forever."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._started = False
+        self._done = threading.Event()
+        self._summary: Optional[Dict[str, Any]] = None
+
+    def run(self, shutdown):
+        while True:
+            with self._lock:
+                if self._summary is not None:
+                    return self._summary
+                if not self._started:
+                    self._started = True
+                    self._done.clear()
+                    break  # this caller runs the shutdown
+            self._done.wait()
+            # loop: either the winner finished (summary set) or it
+            # failed and un-elected — re-check under the lock
+        try:
+            summary = shutdown()
+        except BaseException:
+            # un-elect AND wake parked closers in one locked step: a
+            # set() after the lock released could land after a new
+            # winner's clear(), leaving the event stuck set and the
+            # parked closers spinning through wait() for the whole
+            # retry shutdown
+            with self._lock:
+                self._started = False
+                self._done.set()
+            raise
+        with self._lock:
+            self._summary = summary
+            self._done.set()
+        return summary

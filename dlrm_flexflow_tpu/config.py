@@ -164,6 +164,15 @@ class FFConfig:
     # datasets larger than this stay on the streaming per-batch loop
     # (0 disables the fast path entirely)
     fit_scan_max_bytes: int = 2 * 1024 * 1024 * 1024
+    # Async input pipeline for the per-batch training loops
+    # (data/prefetch.py, docs/pipeline.md): a background thread slices,
+    # shards, and device_puts up to this many batches ahead while the
+    # current step runs on device, so the host's input work overlaps
+    # the device window instead of stalling it.  0 (default) = the
+    # synchronous loop; 2 is the double-buffered sweet spot.  Numerics
+    # are bit-identical either way (pinned) and checkpoint resume stays
+    # cursor-exact (state_dict reports the last batch CONSUMED).
+    prefetch_depth: int = 0
     # --- Online serving (serving/, docs/serving.md) -------------------
     # Batch-size buckets the InferenceEngine AOT-compiles; requests pad
     # up to the enclosing bucket so steady-state serving never
@@ -256,6 +265,8 @@ class FFConfig:
                 cfg.serve_quantize = nxt()
             elif a == "--metrics-port":
                 cfg.metrics_port = int(nxt())
+            elif a == "--prefetch":
+                cfg.prefetch_depth = int(nxt())
             elif a in ("-d", "--devices", "-ll:gpu"):
                 # reference -ll:gpu N => N workers; here: device count
                 cfg.num_devices = int(nxt())

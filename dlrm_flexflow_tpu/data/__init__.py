@@ -1,5 +1,6 @@
 from .loader import (ArrayDataLoader, SyntheticDLRMLoader, load_criteo_h5,
                      preprocess_criteo_npz)
+from .prefetch import PrefetchLoader
 
-__all__ = ["ArrayDataLoader", "SyntheticDLRMLoader", "load_criteo_h5",
-           "preprocess_criteo_npz"]
+__all__ = ["ArrayDataLoader", "PrefetchLoader", "SyntheticDLRMLoader",
+           "load_criteo_h5", "preprocess_criteo_npz"]

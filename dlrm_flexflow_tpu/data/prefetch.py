@@ -1,0 +1,266 @@
+"""Asynchronous batch prefetch: overlap host-side input work with the
+in-flight device step (docs/pipeline.md).
+
+The per-batch training loop's steady state used to be serial: the host
+slices the next batch, ``device_put``s it, dispatches, and only then
+starts preparing the following batch — so the device idles for the
+whole host stretch of every step (PERF.md "Where the cycles go": the
+wall-vs-busy gap).  :class:`PrefetchLoader` moves that host stretch off
+the critical path: a background thread pulls batches from the wrapped
+loader, applies the model's placement function (``FFModel.shard_batch``
+— the SAME ``partition_rules`` specs training proves, so prefetched
+batches land sharded exactly as the synchronous path would place them),
+and parks up to ``depth`` ready batches in a bounded queue while the
+current step runs on device.
+
+Resume stays bit-identical (docs/resilience.md): the wrapped loader's
+cursor advances as batches are FETCHED, but :meth:`state_dict` reports
+the position of the last batch *consumed* — each batch travels through
+the queue with the cursor snapshot taken at its fetch, and the snapshot
+becomes current only when the training loop takes the batch.  A
+checkpoint cut at step k therefore resumes at batch k+1 regardless of
+how many batches the prefetcher had in flight, proven by the
+``prefetch`` scenario in ``scripts/check_resilience.py``.
+
+Thread discipline (machine-checked by the analysis suite's
+shared-state pass): the worker is a module-level function that touches
+NO loader attributes — everything it needs (the inner iterator, the
+queue, the stop event, the placement callable, the snapshot callable)
+arrives as arguments, and results/errors travel back through the
+thread-safe queue.  The close protocol reuses the serving side's
+winner-elected :class:`~dlrm_flexflow_tpu.concurrency.CloseOnce`.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..concurrency import CloseOnce
+
+#: queue item tags — batches, the natural end of an epoch, and a
+#: producer-side error re-raised in the consumer.
+_BATCH, _DONE, _ERROR = "batch", "done", "error"
+
+#: worker put/get poll interval: long enough to stay off the CPU,
+#: short enough that close() never waits noticeably.
+_POLL_S = 0.05
+
+
+def _produce(src, q: "queue.Queue", stop: threading.Event,
+             place: Optional[Callable], snapshot: Callable) -> None:
+    """Worker body: fetch, place, enqueue — until the epoch ends, an
+    error occurs, or ``stop`` is set.  Every ``put`` polls the stop
+    event so a closing consumer never deadlocks against a full queue."""
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        for inputs, labels in src:
+            if stop.is_set():
+                return
+            if place is not None:
+                inputs = {k: place(v) for k, v in inputs.items()}
+                labels = place(labels)
+            if not put((_BATCH, inputs, labels, snapshot())):
+                return
+        put((_DONE, None, None, None))
+    except BaseException as e:  # re-raised at the consumer's next take
+        put((_ERROR, e, None, None))
+
+
+class PrefetchLoader:
+    """Wrap any batch loader (``ArrayDataLoader``, ``SyntheticDLRMLoader``,
+    or anything yielding ``(inputs_dict, labels)``) with ``depth``-deep
+    asynchronous prefetch and optional device placement.
+
+    ``place_fn`` is applied to every input array and the labels in the
+    worker thread — pass ``model.shard_batch`` so batches arrive
+    device-resident (and mesh-sharded) before the training loop even
+    asks for them.  ``place_fn=None`` prefetches host arrays only
+    (still overlaps slicing/shuffling with the device step).
+
+    The wrapped loader must not be iterated or mutated elsewhere while
+    an epoch is active: the worker owns it between ``__iter__`` and the
+    epoch's end.  ``state_dict``/``load_state_dict`` proxy the inner
+    loader's resume contract with consumed-exact semantics (module
+    docstring); the loader shape attributes (``num_batches``,
+    ``batch_size``, ``inputs``, ``labels``, ``drop_last``, ``shuffle``)
+    pass through so ``fit``'s scanned-epoch staging sees the wrapped
+    loader exactly like the bare one.
+    """
+
+    def __init__(self, loader, depth: int = 2,
+                 place_fn: Optional[Callable] = None,
+                 snapshot: bool = True):
+        if int(depth) < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._inner = loader
+        self.depth = int(depth)
+        self._place = place_fn
+        # snapshot=False skips the per-fetch deepcopy of the inner
+        # loader's resume state — for wrap sites that will NEVER call
+        # state_dict (plain fit's internal wrap, sentinel-only
+        # resilient runs), the same hot-path gate resilience/loop.py
+        # applies to its own per-batch snapshots.  state_dict then
+        # proxies the inner loader's LIVE cursor (fetch-position, not
+        # consumed-exact) — only correct between epochs.
+        self._snapshot = bool(snapshot)
+        self._closer = CloseOnce()
+        self._closed = threading.Event()
+        # (queue, stop event, thread) of the active epoch, if any —
+        # written and read only by the consuming thread
+        self._epoch = None
+        # cursor snapshot of the last CONSUMED batch (None = nothing
+        # consumed since construction / the last load_state_dict)
+        self._consumed = None
+
+    # ------------------------------------------------------------ iteration
+    def __iter__(self):
+        # NOT a generator: the closed check and the worker start happen
+        # at iter() time, eagerly — iter-after-close raises immediately
+        # instead of arming a generator that would only fail when (if
+        # ever) first advanced
+        if self._closed.is_set():
+            raise RuntimeError("PrefetchLoader is closed")
+        self._stop_epoch()  # a re-iter abandons any half-consumed epoch
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        sd = getattr(self._inner, "state_dict", None)
+        if self._snapshot and callable(sd):
+            def snapshot(sd=sd):
+                return copy.deepcopy(sd())
+        else:
+            def snapshot():
+                return None
+        src = iter(self._inner)
+        # seed the consumed cursor with the epoch-start snapshot BEFORE
+        # the worker starts: a state_dict() between iter() and the
+        # first consumed batch must say "nothing consumed this epoch",
+        # never the worker's in-flight (and torn-read) fetch cursor
+        seed = snapshot()
+        if seed is not None:
+            self._consumed = seed
+        t = threading.Thread(
+            target=_produce,
+            args=(src, q, stop, self._place, snapshot),
+            name="dlrm-prefetch", daemon=True)
+        self._epoch = (q, stop, t)
+        t.start()
+        return self._consume(q, stop, t)
+
+    def _consume(self, q: "queue.Queue", stop: threading.Event,
+                 t: threading.Thread):
+        try:
+            while True:
+                while True:
+                    try:
+                        kind, a, b, snap = q.get(timeout=_POLL_S)
+                        break
+                    except queue.Empty:
+                        if not t.is_alive():
+                            # the worker may have parked its sentinel
+                            # and exited BETWEEN our Empty and this
+                            # liveness check — drain once before
+                            # concluding it died sentinel-less
+                            try:
+                                kind, a, b, snap = q.get_nowait()
+                                break
+                            except queue.Empty:
+                                raise RuntimeError(
+                                    "prefetch worker died without a "
+                                    "sentinel") from None
+                if kind is _DONE:
+                    return
+                if kind is _ERROR:
+                    raise a
+                # consumed-exact cursor: the snapshot taken at this
+                # batch's FETCH becomes current exactly when the
+                # training loop takes the batch
+                if snap is not None:
+                    self._consumed = snap
+                yield a, b
+        finally:
+            stop.set()
+            t.join()
+            # clear the registration only if it is still OURS: a
+            # late-finalized abandoned generator must not clobber the
+            # epoch a subsequent iter() registered
+            if self._epoch is not None and self._epoch[1] is stop:
+                self._epoch = None
+
+    def peek(self):
+        return self._inner.peek()
+
+    # -------------------------------------------------------------- resume
+    def state_dict(self) -> Optional[dict]:
+        """The wrapped loader's resume state at the last batch
+        CONSUMED — not the (further-advanced) fetch cursor.  None when
+        the wrapped loader has no resume contract of its own (the same
+        shape ``resilience.loop._loader_state`` reports for it bare)."""
+        if self._consumed is not None:
+            return copy.deepcopy(self._consumed)
+        sd = getattr(self._inner, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def load_state_dict(self, sd: dict) -> None:
+        self._stop_epoch()  # in-flight batches predate the restore
+        self._inner.load_state_dict(sd)
+        self._consumed = None
+
+    # --------------------------------------------------------------- close
+    def _stop_epoch(self) -> None:
+        if self._epoch is None:
+            return
+        _q, stop, t = self._epoch
+        stop.set()
+        t.join()
+        self._epoch = None
+
+    def close(self) -> dict:
+        """Stop any active worker and refuse further iteration.
+        Idempotent and safe under concurrent callers (CloseOnce)."""
+
+        def shutdown():
+            self._closed.set()
+            self._stop_epoch()
+            return {"closed": True}
+
+        return self._closer.run(shutdown)
+
+    # ------------------------------------------------- shape passthroughs
+    @property
+    def num_batches(self) -> int:
+        return self._inner.num_batches
+
+    @property
+    def batch_size(self) -> int:
+        return self._inner.batch_size
+
+    @property
+    def inputs(self):
+        return getattr(self._inner, "inputs", None)
+
+    @property
+    def labels(self):
+        return getattr(self._inner, "labels", None)
+
+    @property
+    def drop_last(self):
+        return getattr(self._inner, "drop_last", False)
+
+    @property
+    def shuffle(self):
+        return getattr(self._inner, "shuffle", False)
+
+    def __len__(self):
+        return len(self._inner)

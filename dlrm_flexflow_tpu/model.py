@@ -2373,6 +2373,26 @@ class FFModel:
         scan_data = self._stage_scan_dataset(dataloader, cbs)
         self._last_fit_used_scan = scan_data is not None
 
+        # async input pipeline (docs/pipeline.md): when the run stays on
+        # the streaming per-batch loop, a background thread slices and
+        # device-places the next prefetch_depth batches (shard_batch —
+        # the same placement the synchronous path applies) while the
+        # current step runs.  The scanned fast path stages the whole
+        # dataset up front and needs no prefetch.
+        from .data.prefetch import PrefetchLoader
+        pf_depth = int(getattr(self.config, "prefetch_depth", 0) or 0)
+        own_prefetch = None
+        if scan_data is None and pf_depth > 0 \
+                and not isinstance(dataloader, PrefetchLoader):
+            # snapshot=False: this internal wrap never checkpoints, so
+            # the worker skips the per-fetch resume-state deepcopy
+            own_prefetch = PrefetchLoader(dataloader, depth=pf_depth,
+                                          place_fn=self.shard_batch,
+                                          snapshot=False)
+            dataloader = own_prefetch
+        stall_s = 0.0     # host wall waiting on the dataloader
+        dispatch_s = 0.0  # host wall issuing per-batch dispatches
+
         # warmup/compile batch (a real update on the first batch — the
         # reference's untimed epoch 0, dlrm.cc:178; warmup=False keeps
         # exact step parity with a plain per-batch loop)
@@ -2458,59 +2478,85 @@ class FFModel:
                 if verbose:
                     print(f"epoch {epoch}: {acc.report()}")
             self._fit_state = state
-        for epoch in range(epochs) if fused_fn is None else ():
-            ep_span = start_span("train.epoch", parent=fit_span,
-                                 attrs={"epoch": epoch})
-            if epoch > 0:
-                for cb in cbs:
-                    cb.on_epoch_begin(epoch)
-                state = apply_pending_lr(state)
-            acc.reset()
-            if scan_data is not None:
-                dspan = start_span("train.dispatch", parent=ep_span,
-                                   attrs={"epoch": epoch})
-                if chunk_bounds is not None:
-                    state, mets = self._run_epoch_chunks(
-                        state, scan_data[0], scan_data[1], chunk_bounds,
-                        aot=chunk_aot)
-                else:
-                    state, mets = scan_fn(state, *scan_data)
-                dspan.end()
-                samples += dataloader.num_batches * dataloader.batch_size
-                acc.update({k: v for k, v in mets.items() if k != "loss"})
-                last_loss = mets.get("loss", last_loss)
-            else:
-                for it, (inputs, labels) in enumerate(dataloader):
+        try:
+            for epoch in range(epochs) if fused_fn is None else ():
+                ep_span = start_span("train.epoch", parent=fit_span,
+                                     attrs={"epoch": epoch})
+                if epoch > 0:
                     for cb in cbs:
-                        cb.on_batch_begin(it)
+                        cb.on_epoch_begin(epoch)
+                    state = apply_pending_lr(state)
+                acc.reset()
+                if scan_data is not None:
                     dspan = start_span("train.dispatch", parent=ep_span,
-                                       attrs={"epoch": epoch, "it": it})
-                    state, mets = self.train_step(state, inputs, labels)
+                                       attrs={"epoch": epoch})
+                    if chunk_bounds is not None:
+                        state, mets = self._run_epoch_chunks(
+                            state, scan_data[0], scan_data[1], chunk_bounds,
+                            aot=chunk_aot)
+                    else:
+                        state, mets = scan_fn(state, *scan_data)
                     dspan.end()
-                    samples += int(labels.shape[0])
+                    samples += dataloader.num_batches * dataloader.batch_size
                     acc.update({k: v for k, v in mets.items()
                                 if k != "loss"})
                     last_loss = mets.get("loss", last_loss)
-                    for cb in cbs:
-                        cb.on_batch_end(it)
-            self._fit_state = state
-            if verbose:
-                print(f"epoch {epoch}: {acc.report()}")
-            early_stop = False
-            for cb in cbs:
-                if cb.on_epoch_end(epoch) is True:
-                    early_stop = True
-            ep_span.end()
-            if early_stop:
-                print(f"Accuracy reached, early stop, epoch: {epoch}")
-                epochs_run = epoch + 1
-                break
+                else:
+                    batches = iter(dataloader)
+                    it = -1
+                    while True:
+                        ts = time.perf_counter()
+                        try:
+                            inputs, labels = next(batches)
+                        except StopIteration:
+                            break
+                        stall_s += time.perf_counter() - ts
+                        it += 1
+                        for cb in cbs:
+                            cb.on_batch_begin(it)
+                        dspan = start_span("train.dispatch",
+                                           parent=ep_span,
+                                           attrs={"epoch": epoch,
+                                                  "it": it})
+                        td = time.perf_counter()
+                        state, mets = self.train_step(state, inputs,
+                                                      labels)
+                        dispatch_s += time.perf_counter() - td
+                        dspan.end()
+                        samples += int(labels.shape[0])
+                        acc.update({k: v for k, v in mets.items()
+                                    if k != "loss"})
+                        last_loss = mets.get("loss", last_loss)
+                        for cb in cbs:
+                            cb.on_batch_end(it)
+                self._fit_state = state
+                if verbose:
+                    print(f"epoch {epoch}: {acc.report()}")
+                early_stop = False
+                for cb in cbs:
+                    if cb.on_epoch_end(epoch) is True:
+                        early_stop = True
+                ep_span.end()
+                if early_stop:
+                    print(f"Accuracy reached, early stop, epoch: {epoch}")
+                    epochs_run = epoch + 1
+                    break
+        finally:
+            if own_prefetch is not None:
+                own_prefetch.close()
         device_fence(state.step)
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
         fit_span.set_attr("samples", int(samples))
         fit_span.end()
         _tmetrics.TRAIN_SAMPLES_PER_S.set(thpt)
+        per_batch = scan_data is None and fused_fn is None
+        if per_batch:
+            # input-pipeline share of the wall (docs/pipeline.md);
+            # the scanned/fused paths stage the dataset up front and
+            # have no per-step input path to attribute
+            _tmetrics.DATA_STALL_PCT.set(
+                100.0 * stall_s / max(elapsed, 1e-9))
         nb = getattr(dataloader, "num_batches", None)
         if nb:  # every path runs num_batches dispatches per epoch
             _tmetrics.TRAIN_STEPS.inc(epochs_run * int(nb))
@@ -2522,11 +2568,15 @@ class FFModel:
             # each epoch), while wall_s/samples span the whole run —
             # documented in docs/telemetry.md; finalized_means() performs
             # the host sync (safe: the fence above already drained)
+            pipeline_fields = ({"data_stall_ms": round(stall_s * 1e3, 3),
+                                "dispatch_ms": round(dispatch_s * 1e3, 3)}
+                               if per_batch else {})
             log.emit("step", wall_s=elapsed, samples=int(samples),
                      samples_per_s=thpt, epochs=epochs_run, fenced=True,
                      phase="fit", metrics=acc.finalized_means(),
                      loss=(float(np.asarray(last_loss))
-                           if last_loss is not None else None))
+                           if last_loss is not None else None),
+                     **pipeline_fields)
             sample_memory(phase="fit", log=log)
         if verbose and show_throughput:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thpt:.2f} samples/s")

@@ -130,6 +130,24 @@ def active() -> bool:
     return any(f.remaining > 0 for f in _faults)
 
 
+def save_counts() -> List[int]:
+    """Remaining-firings snapshot of every installed fault.  The lag-1
+    training loop (resilience/loop.py, docs/pipeline.md) takes one
+    before each speculative dispatch: when a rejection of the PREVIOUS
+    step discards that in-flight dispatch, any fault that fired inside
+    it is un-consumed via :func:`restore_counts` so it re-fires when
+    the batch is re-dispatched — exactly the eager loop's semantics,
+    where the discarded dispatch never happened."""
+    return [f.remaining for f in _faults]
+
+
+def restore_counts(snap: List[int]) -> None:
+    """Restore a :func:`save_counts` snapshot (see there).  Faults
+    installed after the snapshot keep their current counts."""
+    for f, r in zip(_faults, snap):
+        f.remaining = r
+
+
 def _fire(f: _Fault, step: Optional[int] = None) -> None:
     f.remaining -= 1
     from ..telemetry import emit

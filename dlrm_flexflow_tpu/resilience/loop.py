@@ -16,13 +16,28 @@ rejected, or resumed mid-epoch.  When any resilience option is active,
   hetero host tables land back in their ops, and the dataloader replays
   the exact batch sequence from its restored cursor — a killed run
   continues bit-identically to the run that never died (npz/CPU);
-* arms a :class:`..resilience.NaNSentinel`: each dispatch's folded loss
-  is checked on host; an anomalous dispatch is rejected (the
-  pre-dispatch state stays current — the step runs non-donating while a
-  sentinel is armed, so no snapshot copies are needed) and the batch is
+* arms a :class:`..resilience.NaNSentinel` at **lag 1**
+  (docs/pipeline.md): each dispatch's folded loss is checked on host
+  while the NEXT step is already in flight, so the sentinel fence
+  overlaps device work instead of serializing it.  An anomalous
+  dispatch is rejected one step late — the pre-dispatch state is still
+  live (the step runs non-donating while a sentinel is armed), the
+  speculative in-flight step computed from the poisoned state is
+  discarded (its injected faults are un-consumed), and the batch is
   skipped or retried at a backed-off learning rate;
 * honors the fault-injection harness (``FF_FAULTS`` /
-  ``FFConfig.faults`` / ``faultinject.install``) at its step boundary.
+  ``FFConfig.faults`` / ``faultinject.install``) at its step boundary;
+* prefetches input batches (``FFConfig.prefetch_depth`` > 0 —
+  ``data/prefetch.py``): a background thread slices, shards, and
+  ``device_put``s the next batches while the current step runs, with
+  checkpoint cursors staying consumed-exact.
+
+The only *unconditional* host fences are the boundaries the
+correctness story needs: epoch ends, cadence checkpoint saves (a
+checkpoint must never contain an unverified state), and the final
+device fence that closes the throughput window.  Everything else —
+telemetry folds, metrics accumulation, the loss trace — runs at lag 1
+on not-yet-ready arrays.
 
 The loop records ``model._fit_loss_trace`` / ``model._fit_loss_steps``
 (the per-adopted-dispatch folded losses and their global step numbers)
@@ -37,6 +52,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..data.prefetch import PrefetchLoader
 from ..metrics import MetricsAccumulator
 from ..telemetry import active_log, sample_memory
 from ..telemetry import metrics as _tmetrics
@@ -49,6 +65,30 @@ from .sentinel import NaNSentinel
 def _loader_state(dataloader) -> Optional[dict]:
     sd = getattr(dataloader, "state_dict", None)
     return sd() if callable(sd) else None
+
+
+class _Pending:
+    """One dispatched-but-unverified training step: everything needed
+    to adopt it (record loss/metrics, cadence-save), reject it (restore
+    the pre-dispatch world), or retry its batch at a backed-off rate."""
+
+    __slots__ = ("pre_state", "new_state", "mets", "step", "lr", "span",
+                 "inputs", "labels", "host_snap", "loader_sd",
+                 "n_samples")
+
+    def __init__(self, pre_state, new_state, mets, step, lr, span,
+                 inputs, labels, host_snap, loader_sd, n_samples):
+        self.pre_state = pre_state
+        self.new_state = new_state
+        self.mets = mets
+        self.step = step
+        self.lr = lr
+        self.span = span
+        self.inputs = inputs
+        self.labels = labels
+        self.host_snap = host_snap
+        self.loader_sd = loader_sd
+        self.n_samples = n_samples
 
 
 def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
@@ -74,6 +114,19 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         if getattr(cb, "model", None) is None:
             cb.set_model(model)
         cb.on_train_begin()
+
+    # async input pipeline (docs/pipeline.md): wrap the loader unless
+    # the caller already did; batches arrive sliced + device-placed
+    # (model.shard_batch — the same specs the synchronous path uses)
+    depth = int(getattr(model.config, "prefetch_depth", 0) or 0)
+    own_prefetch = None
+    if depth > 0 and not isinstance(dataloader, PrefetchLoader):
+        # consumed-exact fetch snapshots cost a deepcopy per batch —
+        # pay it only when a checkpoint could actually store one
+        own_prefetch = PrefetchLoader(dataloader, depth=depth,
+                                      place_fn=model.shard_batch,
+                                      snapshot=manager is not None)
+        dataloader = own_prefetch
 
     # span chain (telemetry/trace.py): fit -> epoch -> dispatch, with
     # ckpt.save/ckpt.restore spans emitted inside the manager under the
@@ -102,130 +155,254 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     # hetero CPU tables are updated IN the dispatch (host-side SGD after
     # the backward callback) — a rejection must roll them back too.
     # apply_host_sgd REBINDS table.array, so the pre-dispatch snapshot
-    # is a dict of references, not copies.
+    # is a dict of references, not copies — restoring a two-step-old
+    # snapshot undoes the rejected step AND the discarded in-flight one.
     hetero_ops = [op for op in getattr(model, "_hetero_ops", [])
                   if hasattr(op, "host_table")] if sentinel else []
     losses, loss_steps = [], []
-    samples = 0
-    last_loss = None
+    samples = [0]
     epochs_run = 0
+    # lag-1 pipelining is on whenever no per-batch callbacks demand an
+    # eager host decision point: the previous dispatch's loss check
+    # (sentinel verdict + trace fold) overlaps the in-flight step.
+    # With callbacks the loop settles each dispatch immediately —
+    # the pre-pipeline behavior, bit-identical adopted trajectory.
+    lag1 = not cbs
+    pending: list = [None]      # the one unverified dispatch, or None
+    stall_s = [0.0]             # host wall waiting on the dataloader
+    dispatch_s = [0.0]          # host wall issuing train_step dispatches
     t0 = time.perf_counter()
 
     cur_ep = [fit_span]  # the ambient parent for cadence saves
 
-    def save(extra_epoch: int):
+    def save(state_, step_, loader_sd, mark):
         if manager is None:
             return
         push_span(cur_ep[0])  # parents the manager's ckpt.save span
         try:
-            manager.save(state, model=model, step=global_step,
-                         extra={"epoch": extra_epoch,
-                                "loader": _loader_state(dataloader),
+            manager.save(state_, model=model, step=step_,
+                         extra={"epoch": mark, "loader": loader_sd,
                                 "epochs_requested": int(epochs)})
         finally:
             pop_span(cur_ep[0])
 
+    def adopt(p: _Pending, loss_f: float, ep: int):
+        """Commit one verified dispatch: loss trace, metrics fold,
+        throughput counters, cadence checkpoint."""
+        step_no = p.step + 1
+        _tmetrics.TRAIN_STEPS.inc()
+        samples[0] += p.n_samples
+        losses.append(loss_f)
+        loss_steps.append(step_no)
+        acc.update({k: v for k, v in p.mets.items() if k != "loss"})
+        model._fit_state = p.new_state
+        if every_n_steps and step_no % every_n_steps == 0:
+            # a save at the epoch's final batch marks the NEXT epoch
+            # (the loader cursor has wrapped to 0 already)
+            sd = p.loader_sd
+            mark = ep + 1 if (sd is not None
+                              and sd.get("batch", 0) == 0) else ep
+            save(p.new_state, step_no, sd, mark)
+
+    def retry_backed_off(p: _Pending, ep: int):
+        """lr_backoff after a rejection: re-dispatch the REJECTED batch
+        eagerly (each attempt fenced — rejections are rare) until the
+        sentinel adopts it or raises TrainingDiverged."""
+        nonlocal state, global_step
+        retry_state = model.set_learning_rate(p.pre_state,
+                                              p.lr * sentinel.lr_factor)
+        while True:
+            lr = float(getattr(model.optimizer, "lr", 0.0))
+            rspan = start_span("train.dispatch", parent=cur_ep[0],
+                               attrs={"step": p.step, "retry": True})
+            faultinject.maybe_preempt("step", step=p.step)
+            binputs, blabels = faultinject.poison_batch(
+                p.inputs, p.labels, step=p.step)
+            host_snap = {op.name: op.host_table.array
+                         for op in hetero_ops}
+            td = time.perf_counter()
+            new_state, mets = model.train_step(retry_state, binputs,
+                                               blabels, donate=False)
+            dispatch_s[0] += time.perf_counter() - td
+            loss_f = float(np.asarray(mets["loss"]))
+            if sentinel.observe(loss_f, new_state, step=p.step, lr=lr):
+                rspan.end()
+                state = new_state
+                global_step = p.step + 1
+                adopt(_Pending(retry_state, new_state, mets, p.step, lr,
+                               rspan, p.inputs, p.labels, host_snap,
+                               p.loader_sd, p.n_samples), loss_f, ep)
+                return
+            rspan.set_attr("policy", sentinel.policy)
+            rspan.end(status="rejected")
+            for op in hetero_ops:
+                op.host_table.array = host_snap[op.name]
+            retry_state = model.set_learning_rate(
+                retry_state, lr * sentinel.lr_factor)
+
+    def settle(ep: int, discard=None) -> bool:
+        """Fence the pending dispatch's folded loss (the device is
+        usually already past it) and adopt or reject it.  Returns True
+        when the world is unchanged (nothing pending / adopted); False
+        after a rejection rolled ``state``/``global_step`` back (the
+        caller must re-dispatch whatever it had in flight).  ``discard``
+        undoes the caller's speculative in-flight dispatch on
+        rejection, BEFORE any retry re-fires its faults."""
+        nonlocal state, global_step
+        p, pending[0] = pending[0], None
+        if p is None:
+            return True
+        loss_f = float(np.asarray(p.mets["loss"]))
+        if sentinel is None or sentinel.observe(loss_f, p.new_state,
+                                                step=p.step, lr=p.lr):
+            p.span.end()
+            adopt(p, loss_f, ep)
+            return True
+        # REJECTED one step late: p.pre_state is still live (the
+        # non-donating step left its buffers alive); host-side hetero
+        # tables were updated by p's dispatch AND the discarded
+        # in-flight one — the reference snapshot restores both
+        p.span.set_attr("policy", sentinel.policy)
+        p.span.end(status="rejected")
+        state = p.pre_state
+        global_step = p.step
+        for op in hetero_ops:
+            op.host_table.array = p.host_snap[op.name]
+        if discard is not None:
+            discard()
+        if sentinel.policy == "lr_backoff":
+            retry_backed_off(p, ep)
+        # skip: p's batch is dropped entirely
+        return False
+
     ep = start_epoch
-    while ep < epochs:
-        ep_span = start_span("train.epoch", parent=fit_span,
-                             attrs={"epoch": ep})
-        cur_ep[0] = ep_span
-        for cb in cbs:
-            cb.on_epoch_begin(ep)
-        if model._pending_lr is not None:
-            state = model.set_learning_rate(state, model._pending_lr)
-            model._pending_lr = None
-        acc.reset()
-        for it, (inputs, labels) in enumerate(dataloader):
+    try:
+        while ep < epochs:
+            ep_span = start_span("train.epoch", parent=fit_span,
+                                 attrs={"epoch": ep})
+            cur_ep[0] = ep_span
             for cb in cbs:
-                cb.on_batch_begin(it)
-            while True:  # lr_backoff retries the same batch
-                dspan = start_span("train.dispatch", parent=ep_span,
-                                   attrs={"step": global_step})
-                faultinject.maybe_preempt("step", step=global_step)
-                binputs, blabels = faultinject.poison_batch(
-                    inputs, labels, step=global_step)
-                host_snap = {op.name: op.host_table.array
-                             for op in hetero_ops}
-                new_state, mets = model.train_step(state, binputs, blabels,
-                                                   donate=donate)
-                if sentinel is None:
-                    state = new_state
-                    dspan.end()
+                cb.on_epoch_begin(ep)
+            if model._pending_lr is not None:
+                state = model.set_learning_rate(state, model._pending_lr)
+                model._pending_lr = None
+            acc.reset()
+            batches = iter(dataloader)
+            it = -1
+            while True:
+                ts = time.perf_counter()
+                try:
+                    inputs, labels = next(batches)
+                except StopIteration:
                     break
-                lr = float(getattr(model.optimizer, "lr", 0.0))
-                if sentinel.observe(mets["loss"], new_state,
-                                    step=global_step, lr=lr):
+                stall_s[0] += time.perf_counter() - ts
+                it += 1
+                # cursor at FETCH time = resume position after this
+                # batch (prefetching loaders report consumed-exact
+                # state; the plain loader's cursor is already here).
+                # Snapshotting deep-copies RNG state — skip it on the
+                # hot path unless a step-cadence save could consume it
+                loader_sd = (_loader_state(dataloader)
+                             if manager is not None and every_n_steps
+                             else None)
+                n_samples = int(labels.shape[0])
+                for cb in cbs:
+                    cb.on_batch_begin(it)
+                while True:  # re-dispatch loop for THIS batch
+                    # fence point: a cadence save due on the pending
+                    # step settles BEFORE the next dispatch — a
+                    # checkpoint must never hold an unverified state,
+                    # and with donation on, the next dispatch would
+                    # consume the buffers the save needs
+                    if pending[0] is not None and every_n_steps and \
+                            (pending[0].step + 1) % every_n_steps == 0:
+                        settle(ep)
+                        continue  # re-check (a rejection moved steps)
+                    dspan = start_span("train.dispatch", parent=ep_span,
+                                       attrs={"step": global_step})
+                    fault_snap = faultinject.save_counts()
+                    faultinject.maybe_preempt("step", step=global_step)
+                    binputs, blabels = faultinject.poison_batch(
+                        inputs, labels, step=global_step)
+                    host_snap = {op.name: op.host_table.array
+                                 for op in hetero_ops}
+                    td = time.perf_counter()
+                    new_state, mets = model.train_step(
+                        state, binputs, blabels, donate=donate)
+                    dispatch_s[0] += time.perf_counter() - td
+                    lr = float(getattr(model.optimizer, "lr", 0.0))
+                    cur = _Pending(state, new_state, mets, global_step,
+                                   lr, dspan, inputs, labels, host_snap,
+                                   loader_sd, n_samples)
+                    # speculatively advance so the PREVIOUS dispatch's
+                    # loss check overlaps this one's device window
                     state = new_state
-                    dspan.end()
+                    global_step += 1
+
+                    def discard(dspan=dspan, fault_snap=fault_snap):
+                        # cur was computed from the rejected state:
+                        # drop it and un-consume any faults that fired
+                        # inside it (the re-dispatch must re-fire them
+                        # — eager semantics)
+                        dspan.end(status="discarded")
+                        faultinject.restore_counts(fault_snap)
+
+                    if pending[0] is not None \
+                            and not settle(ep, discard=discard):
+                        continue  # prev rejected: re-dispatch this batch
+                    pending[0] = cur
+                    if not lag1:
+                        # eager mode (per-batch callbacks): verdict now.
+                        # A skip-rejection drops THIS batch; lr_backoff
+                        # already retried it to adoption inside settle.
+                        settle(ep)
                     break
-                # REJECTED: `state` is still the pre-dispatch state (the
-                # non-donating step left its buffers alive); host-side
-                # hetero tables WERE updated in the dispatch — put the
-                # pre-dispatch arrays back
-                dspan.set_attr("policy", sentinel.policy)
-                dspan.end(status="rejected")
-                for op in hetero_ops:
-                    op.host_table.array = host_snap[op.name]
-                if sentinel.policy == "lr_backoff":
-                    state = model.set_learning_rate(
-                        state, lr * sentinel.lr_factor)
-                    continue   # retry the same batch
-                mets = None    # skip: drop the batch entirely
-                break
-            if mets is None:
                 for cb in cbs:
                     cb.on_batch_end(it)
-                continue
-            global_step += 1
-            _tmetrics.TRAIN_STEPS.inc()
-            samples += int(labels.shape[0])
-            last_loss = float(np.asarray(mets["loss"]))
-            losses.append(last_loss)
-            loss_steps.append(global_step)
-            acc.update({k: v for k, v in mets.items() if k != "loss"})
-            model._fit_state = state
-            if every_n_steps and global_step % every_n_steps == 0:
-                # a save at the epoch's final batch marks the NEXT epoch
-                # (the loader cursor has wrapped to 0 already)
-                sd = _loader_state(dataloader)
-                mark = ep + 1 if (sd is not None
-                                  and sd.get("batch", 0) == 0) else ep
-                save(mark)
+            # epoch boundary: an explicit fence point — the last
+            # dispatch settles before per-epoch host work runs
+            while not settle(ep):
+                pass
+            epochs_run += 1
+            if verbose:
+                print(f"epoch {ep}: {acc.report()}")
+            if every_n_epochs and (ep + 1) % every_n_epochs == 0:
+                save(state, global_step, _loader_state(dataloader),
+                     ep + 1)
+            early_stop = False
             for cb in cbs:
-                cb.on_batch_end(it)
-        epochs_run += 1
-        if verbose:
-            print(f"epoch {ep}: {acc.report()}")
-        if every_n_epochs and (ep + 1) % every_n_epochs == 0:
-            save(ep + 1)
-        early_stop = False
-        for cb in cbs:
-            if cb.on_epoch_end(ep) is True:
-                early_stop = True
-        ep_span.end()
-        cur_ep[0] = fit_span
-        ep += 1
-        if early_stop:
-            print(f"Accuracy reached, early stop, epoch: {ep - 1}")
-            break
+                if cb.on_epoch_end(ep) is True:
+                    early_stop = True
+            ep_span.end()
+            cur_ep[0] = fit_span
+            ep += 1
+            if early_stop:
+                print(f"Accuracy reached, early stop, epoch: {ep - 1}")
+                break
+    finally:
+        if own_prefetch is not None:
+            own_prefetch.close()
 
     from ..profiling import device_fence
     device_fence(state.step)
     elapsed = time.perf_counter() - t0
-    thpt = samples / max(elapsed, 1e-9)
-    fit_span.set_attr("samples", int(samples))
+    thpt = samples[0] / max(elapsed, 1e-9)
+    fit_span.set_attr("samples", int(samples[0]))
     fit_span.end()
     _tmetrics.TRAIN_SAMPLES_PER_S.set(thpt)
+    _tmetrics.DATA_STALL_PCT.set(100.0 * stall_s[0] / max(elapsed, 1e-9))
     model._fit_state = state
     model._fit_loss_trace = np.asarray(losses, dtype=np.float64)
     model._fit_loss_steps = np.asarray(loss_steps, dtype=np.int64)
+    last_loss = losses[-1] if losses else None
     log = active_log()
     if log is not None:
-        log.emit("step", wall_s=elapsed, samples=int(samples),
+        log.emit("step", wall_s=elapsed, samples=int(samples[0]),
                  samples_per_s=thpt, epochs=epochs_run, fenced=True,
                  phase="resilient_fit", metrics=acc.finalized_means(),
-                 loss=last_loss)
+                 loss=last_loss,
+                 data_stall_ms=round(stall_s[0] * 1e3, 3),
+                 dispatch_ms=round(dispatch_s[0] * 1e3, 3))
         sample_memory(phase="resilient_fit", log=log)
     if verbose and show_throughput:
         print(f"ELAPSED TIME = {elapsed:.4f}s, "

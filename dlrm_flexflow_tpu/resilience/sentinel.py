@@ -13,6 +13,15 @@ rate is backed off and the batch retried.  Total rollbacks are bounded
 by ``max_rollbacks``; exceeding it raises :class:`TrainingDiverged`
 (at that point the run is diverging, not glitching).
 
+The resilient loop runs this check at **lag 1** (docs/pipeline.md):
+step k's loss is folded on host while step k+1 is already in flight,
+so the sentinel's fence overlaps device work instead of serializing
+the pipeline.  A rejection therefore rolls back one step FURTHER than
+the eager design — the speculative in-flight step, computed from the
+poisoned state, is discarded alongside the rejected one — and the
+adopted loss trajectory stays bit-identical to an eager check
+(pinned by tests/test_resilience.py).
+
 Every rejection emits an ``anomaly`` telemetry event, so the report CLI
 shows what was rolled back, when, and under which policy.
 """

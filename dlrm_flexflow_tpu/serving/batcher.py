@@ -27,6 +27,7 @@ import numpy as np
 
 import jax
 
+from ..concurrency import CloseOnce
 from ..telemetry import emit
 from ..telemetry import metrics as _metrics
 from ..telemetry.trace import (NULL_SPAN, pop_span, push_span, record_span,
@@ -141,53 +142,10 @@ class _Request:
 _STOP = object()
 
 
-class _CloseOnce:
-    """Winner-elected idempotent shutdown, shared by
-    :class:`DynamicBatcher` and the replica router so the two close
-    paths cannot drift.  ``run(shutdown)`` elects exactly ONE caller to
-    execute ``shutdown()`` (returning the final summary); concurrent
-    callers park on an event and every later call returns the first
-    summary without re-running shutdown.  The lock guards ONLY the
-    who-runs flag and the stored summary (ffcheck lock-discipline —
-    the shutdown itself emits telemetry, completes futures, and joins
-    threads, none of which may run under a held lock).  A winner whose
-    shutdown RAISES un-elects itself so parked and later callers re-run
-    it instead of inheriting a None summary forever."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._started = False
-        self._done = threading.Event()
-        self._summary: Optional[Dict[str, Any]] = None
-
-    def run(self, shutdown):
-        while True:
-            with self._lock:
-                if self._summary is not None:
-                    return self._summary
-                if not self._started:
-                    self._started = True
-                    self._done.clear()
-                    break  # this caller runs the shutdown
-            self._done.wait()
-            # loop: either the winner finished (summary set) or it
-            # failed and un-elected — re-check under the lock
-        try:
-            summary = shutdown()
-        except BaseException:
-            # un-elect AND wake parked closers in one locked step: a
-            # set() after the lock released could land after a new
-            # winner's clear(), leaving the event stuck set and the
-            # parked closers spinning through wait() for the whole
-            # retry shutdown
-            with self._lock:
-                self._started = False
-                self._done.set()
-            raise
-        with self._lock:
-            self._summary = summary
-            self._done.set()
-        return summary
+# the winner-elected idempotent shutdown protocol now lives in the
+# foundation layer (concurrency.CloseOnce) so the data-side prefetcher
+# reuses it too; the old private name stays importable for the router.
+_CloseOnce = CloseOnce
 
 
 class DynamicBatcher:

@@ -72,6 +72,9 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
         "counter", "training dispatches adopted (global steps)"),
     "dlrm_train_samples_per_s": (
         "gauge", "throughput of the most recent fit/bench window"),
+    "dlrm_data_stall_pct": (
+        "gauge", "host time waiting for input batches as a percent of "
+                 "the most recent per-batch fit window's wall"),
     "dlrm_checkpoint_saves_total": (
         "counter", "checkpoints committed by CheckpointManager.save"),
     "dlrm_checkpoint_age_s": (
@@ -647,6 +650,7 @@ SERVE_ROUTER_SHED = REGISTRY.register(
 TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
 TRAIN_SAMPLES_PER_S = REGISTRY.register(
     Gauge("dlrm_train_samples_per_s"))
+DATA_STALL_PCT = REGISTRY.register(Gauge("dlrm_data_stall_pct"))
 CHECKPOINT_SAVES = REGISTRY.register(
     Counter("dlrm_checkpoint_saves_total"))
 CHECKPOINT_AGE = REGISTRY.register(

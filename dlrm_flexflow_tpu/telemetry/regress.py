@@ -3,14 +3,18 @@
     python -m dlrm_flexflow_tpu.telemetry regress \\
         --baseline bench_history.json --new BENCH_r06.json --tolerance 5
 
-Diffs the HEADLINE metrics two bench artifacts share — throughput
-(samples/s or requests/s), busy-equivalent throughput (samples per
-device-busy second, the queue-lottery-proof number PERF.md trusts),
-MFU, and the serving tail-latency headline (``dlrm_serving_p99_ms``)
-— and exits nonzero naming each metric that regressed more than
-``tolerance`` percent.  Throughput metrics regress DOWNWARD; latency
-metrics (``*_ms``/``*_us``/percentile names, :func:`lower_is_better`)
-regress UPWARD.
+Diffs the HEADLINE metrics two bench artifacts share — wall-clock
+throughput (samples/s or requests/s), busy-equivalent throughput
+(samples per device-busy second, the queue-lottery-proof number
+PERF.md trusts), MFU, the host-overhead share of the wall
+(``:host_overhead_pct`` — docs/pipeline.md; gates a host-path
+regression that an unchanged busy number would hide), and the serving
+tail-latency headline (``dlrm_serving_p99_ms``) — and exits nonzero
+naming each metric that regressed more than ``tolerance`` percent.
+Wall and busy gate side by side: both rows must hold.  Throughput
+metrics regress DOWNWARD; latency/overhead metrics
+(``*_ms``/``*_us``/percentile/overhead/stall names,
+:func:`lower_is_better`) regress UPWARD.
 
 Accepted file shapes (auto-detected):
 
@@ -48,12 +52,14 @@ def _history_metric_name(entry: dict) -> str:
 def lower_is_better(name: str) -> bool:
     """Latency-style headlines regress UPWARD: ``dlrm_serving_p99_ms``
     and friends gate on the new value RISING past tolerance, where the
-    throughput metrics gate on falling.  Checked per ``:``-qualifier
-    segment (names may carry suffixes like ``:quantize=int8``)."""
+    throughput metrics gate on falling.  Host-overhead/stall shares
+    (``host_overhead_pct``, ``data_stall_pct`` — docs/pipeline.md) are
+    likewise better when smaller.  Checked per ``:``-qualifier segment
+    (names may carry suffixes like ``:quantize=int8``)."""
     for seg in name.lower().split(":"):
         if (seg.endswith("_ms") or seg.endswith("_us")
                 or "latency" in seg or "_p99" in seg or "_p95" in seg
-                or "_p50" in seg):
+                or "_p50" in seg or "overhead" in seg or "stall" in seg):
             return True
     return False
 
@@ -95,7 +101,8 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         # THIS entry's own derived riders are replaced — a plain-name
         # prefix sweep would also delete the ":quantize=..." anchors a
         # newer unquantized entry must never touch
-        for suffix in ("", ":mfu_pct", ":busy_samples_per_s"):
+        for suffix in ("", ":mfu_pct", ":busy_samples_per_s",
+                       ":host_overhead_pct"):
             out.pop(name + suffix, None)
         out[name] = float(h["value"])
         if h.get("mfu_pct"):
@@ -106,6 +113,13 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
             samples = (int(h["batch"]) * int(h["num_batches"])
                        * int(h["epochs"]))
             out[f"{name}:busy_samples_per_s"] = samples / (busy_ms * 1e-3)
+        # the host share of the wall rides next to the busy-equivalent
+        # gate (lower is better): the wall headline is gated on its own
+        # row, and this rider pins the host PATH — a host-side
+        # regression cannot hide behind an unchanged busy number or an
+        # anchor whose wall was measured in a noisier queue era
+        if h.get("host_overhead_pct") is not None:
+            out[f"{name}:host_overhead_pct"] = float(h["host_overhead_pct"])
     return out
 
 

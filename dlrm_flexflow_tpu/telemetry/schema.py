@@ -42,7 +42,14 @@ SCHEMA: Dict[str, dict] = {
         "required": {"wall_s": float, "samples": int},
         "optional": {"samples_per_s": float, "steps": int,
                      "epochs": int, "loss": float, "metrics": dict,
-                     "fenced": bool, "phase": str, "probe_us": float},
+                     "fenced": bool, "phase": str, "probe_us": float,
+                     # input-pipeline decomposition of the per-batch
+                     # loops (docs/pipeline.md): host ms spent waiting
+                     # for the next batch / issuing dispatches across
+                     # the whole stretch, and the derived host share of
+                     # the wall (100*(wall-busy)/wall for bench windows)
+                     "data_stall_ms": float, "dispatch_ms": float,
+                     "host_overhead_pct": float},
     },
     # one XLA compilation (jit cache miss).  ``kind`` is
     # "backend_compile" for hook-observed compiles and "aot" for

@@ -13,7 +13,13 @@ of ``check_telemetry_schema.py``:
      backoff and the run ends with a valid checkpoint;
   4. preempt@save  — a kill between the state write and the
      manifest/rename commit; the partial tmp dir is never returned by
-     latest_checkpoint and GC removes it.
+     latest_checkpoint and GC removes it;
+  5. prefetch      — a mid-epoch kill (FF_FAULTS=preempt@step=5) with
+     the async input pipeline enabled (FFConfig.prefetch_depth,
+     docs/pipeline.md); the resumed run's loss trace and final params
+     are bit-identical to the no-prefetch scenario-1 baseline — the
+     prefetching loader's cursor is consumed-exact, so batches the
+     worker had fetched ahead of the kill are replayed, not skipped.
 
 Exit 0 when every scenario recovers; prints one line per scenario and
 exits 1 otherwise.
@@ -158,11 +164,66 @@ def scenario_crash_consistency(cfg, m) -> str:
     return ""
 
 
+def scenario_prefetch(cfg, m) -> str:
+    """Kill-at-step-5 with the async input pipeline on: the resumed
+    run must match the NO-prefetch uninterrupted baseline bitwise —
+    the prefetching loader's consumed-exact cursor is what makes the
+    checkpoint replay batches the worker had already fetched ahead."""
+    # no-prefetch uninterrupted baseline (scenario 1's twin, re-run so
+    # this scenario stands alone)
+    faultinject.clear()
+    s_ref, _ = m.fit(m.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+                     verbose=False, checkpoint_manager=CheckpointManager(
+                         tempfile.mkdtemp(prefix="resil_pf_twin_")),
+                     checkpoint_every_n_steps=2)
+    ref_trace = dict(zip(m._fit_loss_steps.tolist(),
+                         m._fit_loss_trace.tolist()))
+    ref_params = s_ref.params
+    d = tempfile.mkdtemp(prefix="resil_pf_")
+    mgr = CheckpointManager(d, keep_n=3)
+    m.config.prefetch_depth = 2
+    try:
+        # the kill arrives through the env route (FF_FAULTS), as a
+        # fleet preemption would
+        faultinject.clear()
+        os.environ["FF_FAULTS"] = "preempt@step=5"
+        try:
+            m.fit(m.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+                  verbose=False, checkpoint_manager=mgr,
+                  checkpoint_every_n_steps=2)
+            return "preemption never fired"
+        except Preemption:
+            pass
+        finally:
+            os.environ.pop("FF_FAULTS", None)
+        faultinject.clear()
+        # resumed run, still prefetching
+        s2, _ = m.fit(m.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+                      verbose=False, checkpoint_manager=mgr,
+                      checkpoint_every_n_steps=2, resume=True)
+    finally:
+        m.config.prefetch_depth = 0
+    if m._fit_loss_steps[0] != 5:
+        return f"resumed at step {m._fit_loss_steps[0]}, want 5"
+    for st, lo in zip(m._fit_loss_steps.tolist(),
+                      m._fit_loss_trace.tolist()):
+        if ref_trace[st] != lo:  # bitwise vs the no-prefetch baseline
+            return (f"loss at step {st}: {lo} != no-prefetch "
+                    f"{ref_trace[st]}")
+    for op, dd in ref_params.items():
+        for k, v in dd.items():
+            if not np.array_equal(np.asarray(v),
+                                  np.asarray(s2.params[op][k])):
+                return f"param {op}/{k} differs from no-prefetch run"
+    return ""
+
+
 SCENARIOS = [
     ("preempt@step resume", scenario_preempt_resume),
     ("nan_grads@step sentinel", scenario_nan_sentinel),
     ("io_error@save retry", scenario_io_retry),
     ("preempt@save crash-consistency", scenario_crash_consistency),
+    ("prefetch kill-resume determinism", scenario_prefetch),
 ]
 
 

@@ -29,7 +29,14 @@ field without the schema and the report CLI seeing it:
      docs/tuning.md, the example artifacts must validate, and the
      promotion gate's metric name must gate UPWARD
      (``regress.lower_is_better``) so a slower candidate can never
-     read as an improvement.
+     read as an improvement;
+  6. input-pipeline contract — the pipelined hot loop's step-event
+     fields (``data_stall_ms``/``dispatch_ms``/``host_overhead_pct``)
+     must be declared in the step schema, the ``dlrm_data_stall_pct``
+     family must be declared, both must be documented in
+     docs/pipeline.md (next to the ``prefetch_depth``/``--prefetch``
+     knobs), and the overhead/stall names must gate UPWARD in the
+     regress CLI so a host-path regression reads as a regression.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -249,6 +256,49 @@ def check_tuning_artifacts(doc_path: str) -> list:
     return errs
 
 
+PIPELINE_STEP_FIELDS = ("data_stall_ms", "dispatch_ms",
+                        "host_overhead_pct")
+PIPELINE_GAUGE = "dlrm_data_stall_pct"
+
+
+def check_pipeline_contract(doc_path: str) -> list:
+    """The input-pipeline observability contract (docs/pipeline.md):
+    the fields the pipelined training loop reports exist in the schema
+    and metric registry, are documented next to the knobs that move
+    them, and regress in the right direction."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+
+    errs = []
+    step_fields = {**SCHEMA["step"]["required"],
+                   **SCHEMA["step"]["optional"]}
+    for name in PIPELINE_STEP_FIELDS:
+        if name not in step_fields:
+            errs.append(f"pipeline: step event field {name!r} missing "
+                        f"from telemetry/schema.py")
+    if PIPELINE_GAUGE not in tmetrics.FAMILIES:
+        errs.append(f"pipeline: metric family {PIPELINE_GAUGE!r} not "
+                    f"declared in telemetry.metrics.FAMILIES")
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented input "
+                    f"pipeline)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in PIPELINE_STEP_FIELDS + (PIPELINE_GAUGE,
+                                              "prefetch_depth",
+                                              "--prefetch"):
+            if f"`{needle}`" not in doc:
+                errs.append(f"docs/pipeline.md does not document "
+                            f"`{needle}`")
+    for name in ("host_overhead_pct", PIPELINE_GAUGE):
+        if not lower_is_better(name):
+            errs.append(f"pipeline: {name!r} is not overhead-shaped in "
+                        f"regress.lower_is_better — a host-path "
+                        f"regression would read as an improvement")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -256,7 +306,9 @@ def main() -> int:
             + check_producers()
             + check_metrics_registry(doc)
             + check_tuning_artifacts(os.path.join(REPO, "docs",
-                                                  "tuning.md")))
+                                                  "tuning.md"))
+            + check_pipeline_contract(os.path.join(REPO, "docs",
+                                                   "pipeline.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

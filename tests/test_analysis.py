@@ -804,6 +804,68 @@ class TestSharedState:
         )}, SharedStatePass)
         assert fs == []
 
+    def test_fires_prefetch_worker_writing_consumer_cursor(self, tmp_path):
+        # the anti-pattern data/prefetch.py exists to avoid: the worker
+        # METHOD writes the resume-cursor attribute the consumer's
+        # state_dict reads — a checkpoint cut mid-epoch snapshots a
+        # cursor torn between fetch position and consume position
+        fs = _run_pass(tmp_path, {"pkg/prefetch_bad.py": (
+            "import queue\n"
+            "import threading\n"
+            "class Prefetcher:\n"
+            "    def __init__(self, loader):\n"
+            "        self._inner = loader\n"
+            "        self.consumed = None\n"
+            "        self._q = queue.Queue(maxsize=2)\n"
+            "        self._t = threading.Thread(target=self._work)\n"
+            "    def _work(self):\n"
+            "        for b in self._inner:\n"
+            "            self.consumed = self._inner.cursor\n"
+            "            self._q.put(b)\n"
+            "    def state_dict(self):\n"
+            "        return {'cursor': self.consumed}\n"
+        )}, SharedStatePass)
+        assert _codes(fs) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "Prefetcher.consumed"
+
+    def test_silent_prefetch_args_in_queue_out(self, tmp_path):
+        # the REAL prefetcher's discipline (data/prefetch.py): a
+        # module-level worker touching no loader attributes — inputs
+        # arrive as arguments, batches travel back through the
+        # thread-safe queue, and the consumed cursor is written only by
+        # the consuming thread when it takes a batch
+        fs = _run_pass(tmp_path, {"pkg/prefetch_ok.py": (
+            "import queue\n"
+            "import threading\n"
+            "def _produce(src, q, stop, snapshot):\n"
+            "    for b in src:\n"
+            "        if stop.is_set():\n"
+            "            return\n"
+            "        q.put((b, snapshot()))\n"
+            "    q.put((None, None))\n"
+            "class Prefetcher:\n"
+            "    def __init__(self, loader):\n"
+            "        self._inner = loader\n"
+            "        self._consumed = None\n"
+            "    def __iter__(self):\n"
+            "        q = queue.Queue(maxsize=2)\n"
+            "        stop = threading.Event()\n"
+            "        t = threading.Thread(target=_produce,\n"
+            "                             args=(iter(self._inner), q,\n"
+            "                                   stop,\n"
+            "                                   self._inner.state_dict))\n"
+            "        t.start()\n"
+            "        while True:\n"
+            "            b, snap = q.get()\n"
+            "            if b is None:\n"
+            "                return\n"
+            "            self._consumed = snap\n"
+            "            yield b\n"
+            "    def state_dict(self):\n"
+            "        return self._consumed\n"
+        )}, SharedStatePass)
+        assert fs == []
+
     def test_lock_held_through_call_chain(self, tmp_path):
         # the lock taken one frame up still covers the helper's access
         fs = _run_pass(tmp_path, {"pkg/d.py": (

@@ -1,0 +1,332 @@
+"""Async input-pipeline tests (data/prefetch.py, docs/pipeline.md):
+PrefetchLoader semantics (batch identity, consumed-exact resume cursor,
+close protocol, worker error propagation) and the acceptance pins —
+per-epoch loss trajectory bit-identical prefetch on/off on the same
+seed (CPU), and the pipeline observability fields."""
+
+import time
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.data import PrefetchLoader
+from dlrm_flexflow_tpu.data.loader import ArrayDataLoader
+from dlrm_flexflow_tpu.telemetry import event_log
+from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+
+N, BATCH = 64, 8  # 8 batches/epoch
+
+
+def make_loader(shuffle=True, seed=1):
+    rng = np.random.default_rng(0)
+    return ArrayDataLoader(
+        {"x": rng.standard_normal((N, 4)).astype(np.float32)},
+        rng.standard_normal((N, 1)).astype(np.float32), BATCH,
+        shuffle=shuffle, seed=seed)
+
+
+def make_model(prefetch_depth=0, lr=0.05):
+    m = ff.FFModel(ff.FFConfig(batch_size=BATCH))
+    m.config.prefetch_depth = prefetch_depth
+    x = m.create_tensor((BATCH, 4), name="x")
+    m.dense(x, 8, activation="relu")
+    m.dense(m.layers[-1].outputs[0], 1)
+    m.compile(optimizer=ff.SGDOptimizer(lr=lr),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return m
+
+
+def batches_equal(a, b):
+    assert len(a) == len(b)
+    for (ia, la), (ib, lb) in zip(a, b):
+        np.testing.assert_array_equal(la, lb)
+        assert ia.keys() == ib.keys()
+        for k in ia:
+            np.testing.assert_array_equal(np.asarray(ia[k]),
+                                          np.asarray(ib[k]))
+
+
+# ------------------------------------------------------------- the loader
+
+class TestPrefetchLoader:
+    def test_rejects_bad_depth(self):
+        with pytest.raises(ValueError, match="depth"):
+            PrefetchLoader(make_loader(), depth=0)
+
+    def test_yields_identical_batches_across_epochs(self):
+        pf = PrefetchLoader(make_loader(seed=7), depth=3)
+        bare = make_loader(seed=7)
+        for _ in range(2):  # shuffle order advances per epoch
+            batches_equal(list(pf), list(bare))
+        pf.close()
+
+    def test_shape_passthroughs_and_peek(self):
+        inner = make_loader()
+        pf = PrefetchLoader(inner, depth=2)
+        assert pf.num_batches == inner.num_batches
+        assert pf.batch_size == inner.batch_size
+        assert len(pf) == len(inner)
+        assert pf.shuffle is True and pf.drop_last == inner.drop_last
+        pi, pl = pf.peek()
+        bi, bl = inner.peek()
+        np.testing.assert_array_equal(pl, bl)
+        np.testing.assert_array_equal(pi["x"], bi["x"])
+        pf.close()
+
+    def test_place_fn_applied_in_worker(self):
+        import jax.numpy as jnp
+        pf = PrefetchLoader(make_loader(), depth=2,
+                            place_fn=jnp.asarray)
+        inputs, labels = next(iter(pf))
+        assert isinstance(inputs["x"], jnp.ndarray)
+        assert isinstance(labels, jnp.ndarray)
+        pf.close()
+
+    def test_cursor_is_consumed_exact_not_fetch_ahead(self):
+        """With depth >= the epoch, the worker fetches ALL batches while
+        the consumer has taken only k: state_dict must report position
+        k, exactly like a bare loader that consumed k batches."""
+        pf = PrefetchLoader(make_loader(seed=9), depth=2 * (N // BATCH))
+        it = iter(pf)
+        for _ in range(3):
+            next(it)
+        # let the worker run to the end of the epoch (bounded only by
+        # the oversized queue, so it WILL fetch far ahead of consume)
+        deadline = time.monotonic() + 5.0
+        while pf._epoch[0].qsize() < N // BATCH - 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        twin = make_loader(seed=9)
+        tw = iter(twin)
+        for _ in range(3):
+            next(tw)
+        assert pf.state_dict() == twin.state_dict()
+        # a fresh loader restored from that cursor replays the rest
+        fresh = make_loader(seed=123)
+        fresh.load_state_dict(pf.state_dict())
+        batches_equal(list(tw), list(iter(fresh)))
+
+    def test_state_dict_before_any_consume_proxies_inner(self):
+        inner = make_loader(seed=5)
+        pf = PrefetchLoader(inner, depth=4)
+        assert pf.state_dict() == inner.state_dict()
+
+    def test_state_dict_mid_fetch_before_first_consume_is_epoch_start(self):
+        """The worker may have fetched far ahead before the training
+        loop consumes anything: state_dict must report the epoch-start
+        cursor (nothing consumed), never the live fetch cursor."""
+        pf = PrefetchLoader(make_loader(seed=11), depth=2 * (N // BATCH))
+        it = iter(pf)
+        deadline = time.monotonic() + 5.0
+        while pf._epoch[0].qsize() < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sd = pf.state_dict()
+        assert sd["batch"] == 0  # not the worker's fetch-ahead cursor
+        fresh = make_loader(seed=123)
+        fresh.load_state_dict(sd)
+        batches_equal(list(it), list(iter(fresh)))  # same epoch replays
+
+    def test_loader_without_state_dict_is_supported(self):
+        """Anything yielding (inputs, labels) is wrappable: no resume
+        contract means state_dict() is None (same as _loader_state on
+        the bare loader), not an AttributeError."""
+        class Plain:
+            num_batches, batch_size = 2, BATCH
+
+            def __iter__(self):
+                for _ in range(2):
+                    yield {"x": np.zeros((BATCH, 4), np.float32)}, \
+                        np.zeros((BATCH, 1), np.float32)
+
+        pf = PrefetchLoader(Plain(), depth=2)
+        assert pf.state_dict() is None
+        assert len(list(pf)) == 2
+        assert pf.state_dict() is None  # still no contract mid-stream
+
+    def test_abandoned_generator_does_not_clobber_new_epoch(self):
+        """A half-consumed epoch's generator, finalized AFTER a re-iter
+        registered a new worker, must not erase the new registration —
+        close() must still stop the live worker."""
+        pf = PrefetchLoader(make_loader(), depth=2)
+        g1 = iter(pf)
+        next(g1)
+        g2 = iter(pf)  # abandons g1's epoch, registers worker 2
+        g1.close()     # late finalization of the abandoned generator
+        assert pf._epoch is not None  # worker 2 still registered
+        next(g2)
+        t2 = pf._epoch[2]
+        pf.close()
+        assert not t2.is_alive()
+
+    def test_load_state_dict_aborts_inflight_and_replays(self):
+        pf = PrefetchLoader(make_loader(seed=3), depth=2)
+        it = iter(pf)
+        next(it), next(it)
+        sd = pf.state_dict()
+        pf2 = PrefetchLoader(make_loader(seed=77), depth=2)
+        it2 = iter(pf2)
+        next(it2)  # mid-epoch when the restore lands
+        pf2.load_state_dict(sd)
+        rest = list(it)
+        batches_equal(rest, list(pf2)[:len(rest)])
+
+    def test_worker_error_reraised_at_consumer(self):
+        class Boom:
+            num_batches, batch_size = 2, BATCH
+
+            def __iter__(self):
+                yield {"x": np.zeros((BATCH, 4), np.float32)}, \
+                    np.zeros((BATCH, 1), np.float32)
+                raise ValueError("loader exploded")
+
+        pf = PrefetchLoader(Boom(), depth=2)
+        it = iter(pf)
+        next(it)
+        with pytest.raises(ValueError, match="loader exploded"):
+            next(it)
+
+    def test_close_idempotent_and_refuses_iteration(self):
+        pf = PrefetchLoader(make_loader(), depth=2)
+        next(iter(pf))
+        assert pf.close() == {"closed": True}
+        assert pf.close() == {"closed": True}  # CloseOnce
+        with pytest.raises(RuntimeError, match="closed"):
+            iter(pf)
+
+
+# --------------------------------------------- bit-identical trajectories
+
+class TestBitIdentity:
+    def test_plain_fit_prefetch_on_off(self):
+        """The acceptance pin: prefetch re-orders WHEN host work
+        happens, never WHAT is computed — final params bitwise equal
+        on the same seed (CPU, per-batch loop)."""
+        states = {}
+        for depth in (0, 2):
+            m = make_model(prefetch_depth=depth)
+            st, _ = m.fit(m.init(seed=0), make_loader(), epochs=2,
+                          verbose=False, warmup=False)
+            assert m._last_fit_used_scan is False  # per-batch loop
+            states[depth] = st
+        for op, d in states[0].params.items():
+            for k, v in d.items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(states[2].params[op][k]))
+
+    def test_resilient_fit_prefetch_on_off(self, tmp_path):
+        """Same pin through the resilient loop: per-step loss trace AND
+        final params bitwise, with a checkpoint cadence running."""
+        runs = {}
+        for depth in (0, 2):
+            m = make_model(prefetch_depth=depth)
+            st, _ = m.fit(m.init(seed=0), make_loader(), epochs=2,
+                          verbose=False,
+                          checkpoint_manager=str(tmp_path / f"ck{depth}"),
+                          checkpoint_every_n_steps=4)
+            runs[depth] = (st, m._fit_loss_trace.copy(),
+                           m._fit_loss_steps.copy())
+        np.testing.assert_array_equal(runs[0][1], runs[2][1])  # bitwise
+        np.testing.assert_array_equal(runs[0][2], runs[2][2])
+        for op, d in runs[0][0].params.items():
+            for k, v in d.items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(runs[2][0].params[op][k]))
+
+    def test_sentinel_lag1_with_prefetch(self):
+        """Prefetch + lag-1 sentinel + an injected NaN: the recovered
+        trajectory still matches the no-prefetch run bitwise."""
+        from dlrm_flexflow_tpu.resilience import NaNSentinel, faultinject
+        traces = {}
+        for depth in (0, 2):
+            faultinject.clear()
+            faultinject.install("nan_grads@step=3")
+            m = make_model(prefetch_depth=depth)
+            m.fit(m.init(seed=0), make_loader(), epochs=2, verbose=False,
+                  sentinel=NaNSentinel(policy="skip"))
+            traces[depth] = m._fit_loss_trace.copy()
+        faultinject.clear()
+        assert np.isfinite(traces[0]).all() and len(traces[0]) == 15
+        np.testing.assert_array_equal(traces[0], traces[2])
+
+    def test_explicit_prefetch_loader_used_as_is(self):
+        """A PrefetchLoader passed directly to fit is not re-wrapped,
+        and yields the same training result."""
+        m = make_model(prefetch_depth=2)
+        pf = PrefetchLoader(make_loader(), depth=2,
+                            place_fn=m.shard_batch)
+        st, _ = m.fit(m.init(seed=0), pf, epochs=1, verbose=False,
+                      warmup=False)
+        m2 = make_model(prefetch_depth=0)
+        st2, _ = m2.fit(m2.init(seed=0), make_loader(), epochs=1,
+                        verbose=False, warmup=False)
+        for op, d in st2.params.items():
+            for k, v in d.items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(st.params[op][k]))
+        pf.close()
+
+
+# ------------------------------------------------------------ observability
+
+class TestPipelineTelemetry:
+    def test_per_batch_step_event_carries_stall_fields(self):
+        m = make_model(prefetch_depth=2)
+        with event_log() as log:
+            m.fit(m.init(seed=0), make_loader(), epochs=1, verbose=False,
+                  warmup=False)
+        ev = log.last("step")
+        assert ev["phase"] == "fit"
+        assert ev["data_stall_ms"] >= 0.0
+        assert ev["dispatch_ms"] > 0.0
+        pct = tmetrics.DATA_STALL_PCT.value
+        assert pct is not None and 0.0 <= pct <= 100.0
+
+    def test_resilient_step_event_carries_stall_fields(self, tmp_path):
+        m = make_model()
+        with event_log() as log:
+            m.fit(m.init(seed=0), make_loader(), epochs=1, verbose=False,
+                  checkpoint_manager=str(tmp_path / "ck"),
+                  checkpoint_every_n_steps=4)
+        ev = log.last("step")
+        assert ev["phase"] == "resilient_fit"
+        assert ev["data_stall_ms"] >= 0.0 and ev["dispatch_ms"] > 0.0
+
+    def test_scanned_path_has_no_stall_fields(self):
+        # shuffle=False keeps the scanned fast path: the dataset stages
+        # up front, there is no per-step input path to attribute
+        m = make_model(prefetch_depth=2)
+        with event_log() as log:
+            m.fit(m.init(seed=0), make_loader(shuffle=False), epochs=1,
+                  verbose=False, warmup=False)
+        assert m._last_fit_used_scan is True
+        ev = log.last("step")
+        assert "data_stall_ms" not in ev and "dispatch_ms" not in ev
+
+    def test_regress_gates_host_overhead_rider(self, tmp_path):
+        """A history entry's host_overhead_pct becomes a lower-is-better
+        rider: a rise past tolerance fails the gate even when the wall
+        headline and busy number are unchanged."""
+        import json
+
+        from dlrm_flexflow_tpu.telemetry.regress import (lower_is_better,
+                                                         main as rmain)
+        assert lower_is_better("dlrm_synthetic_samples_per_sec"
+                               ":host_overhead_pct")
+        assert lower_is_better("dlrm_data_stall_pct")
+        assert not lower_is_better("dlrm_synthetic_samples_per_sec")
+
+        def write(name, overhead):
+            p = str(tmp_path / name)
+            with open(p, "w") as f:
+                json.dump([{"app": "dlrm", "value": 1000.0,
+                            "fenced": True, "batch": 8, "num_batches": 4,
+                            "epochs": 1, "device_busy_ms": 10.0,
+                            "host_overhead_pct": overhead}], f)
+            return p
+
+        base, worse = write("base.json", 20.0), write("new.json", 45.0)
+        assert rmain(["--baseline", base, "--new", worse,
+                      "--tolerance", "5"]) == 1
+        assert rmain(["--baseline", base, "--new",
+                      write("better.json", 5.0), "--tolerance", "5"]) == 0

@@ -339,6 +339,68 @@ class TestSentinel:
         assert np.isfinite(m._fit_loss_trace).all()
         assert len(m._fit_loss_trace) == 3  # 4 batches, one skipped
 
+    def test_lag1_detects_at_next_step_and_discards_inflight(self):
+        """The pipelined loop (docs/pipeline.md) checks step k's loss
+        while step k+1 is in flight: a nan at step 3 is detected one
+        step late, the speculative step-4 dispatch closes with
+        status="discarded", and the rollback spans BOTH steps."""
+        faultinject.install("nan_grads@step=3")
+        m = make_model()
+        with event_log() as log:
+            m.fit(m.init(seed=0), make_loader(), epochs=2, verbose=False,
+                  sentinel=NaNSentinel(policy="skip"))
+        assert np.isfinite(m._fit_loss_trace).all()
+        assert len(m._fit_loss_trace) == 15  # the poisoned batch dropped
+        an = log.last("anomaly")
+        assert an["kind"] == "nan_loss" and an["step"] == 3
+        spans = [e for e in log.events("span")
+                 if e["name"] == "train.dispatch"]
+        statuses = [e.get("status") for e in spans]
+        # the in-flight speculative dispatch was computed from the
+        # poisoned state: it is discarded, never adopted or rejected
+        assert statuses.count("rejected") == 1
+        assert statuses.count("discarded") == 1
+        # detection happened at lag 1: the discarded step-4 dispatch
+        # OPENED before the rejected step-3 span closed
+        rej = next(e for e in spans if e.get("status") == "rejected")
+        dis = next(e for e in spans if e.get("status") == "discarded")
+        assert dis["attrs"]["step"] == rej["attrs"]["step"] + 1
+        assert dis["start_s"] < rej["start_s"] + rej["dur_us"] * 1e-6
+
+    @pytest.mark.parametrize("policy,faults", [
+        ("skip", "nan_grads@step=3"),
+        ("lr_backoff", "nan_grads@step=3"),
+        # consecutive faults: the second fires INSIDE the discarded
+        # speculative dispatch and must be un-consumed (restore_counts)
+        # so it re-fires exactly where the eager loop would see it
+        ("skip", "nan_grads@step=3,nan_grads@step=4"),
+    ])
+    def test_lag1_trajectory_matches_eager_sentinel(self, policy, faults):
+        """The adopted loss trajectory and final params are bit-identical
+        between the lag-1 pipeline and an eager (settle-every-dispatch)
+        run — a per-batch callback forces the eager path."""
+        from dlrm_flexflow_tpu.frontends.keras_callbacks import Callback
+
+        def run(cbs):
+            faultinject.clear()
+            faultinject.install(faults)
+            m = make_model()
+            st, _ = m.fit(m.init(seed=0), make_loader(), epochs=2,
+                          verbose=False, callbacks=cbs,
+                          sentinel=NaNSentinel(policy=policy,
+                                               max_rollbacks=4))
+            return (st, m._fit_loss_trace.copy(),
+                    m._fit_loss_steps.copy())
+
+        st_lag, tr_lag, steps_lag = run(None)
+        st_eag, tr_eag, steps_eag = run([Callback()])
+        np.testing.assert_array_equal(steps_lag, steps_eag)
+        np.testing.assert_array_equal(tr_lag, tr_eag)  # bitwise
+        for op, d in st_eag.params.items():
+            for k, v in d.items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(st_lag.params[op][k]))
+
     def test_check_params_catches_inf_state(self):
         s = NaNSentinel(check_params=True)
         m = make_model()
@@ -431,4 +493,4 @@ class TestReportAndTooling:
             env={**os.environ, "JAX_PLATFORMS": "cpu",
                  "FF_FAULTS": ""})
         assert r.returncode == 0, r.stdout + r.stderr
-        assert "OK (4 recovery paths)" in r.stdout
+        assert "OK (5 recovery paths)" in r.stdout
