@@ -50,6 +50,11 @@ LAYERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("model", ("model",)),
     ("checkpoint", ("checkpoint",)),
     ("subsystems", ("resilience", "serving")),
+    # elastic integrates BOTH subsystems (reshard rides checkpoint +
+    # resilience, the controller rides serving + sim/tune), so it sits
+    # strictly above them; resilience's elastic resume reaches UP via a
+    # deferred import (the sanctioned cycle-break)
+    ("elastic", ("elastic",)),
     ("apps", ("apps", "frontends")),
     ("package-root", ("__init__",)),
     ("entry", ("scripts", "bench", "__graft_entry__")),

@@ -18,6 +18,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .model import TrainState
+from .parallel.mesh import format_topology, mesh_topology, same_topology
 
 
 class CheckpointError(Exception):
@@ -139,6 +140,14 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
         use_orbax = _orbax_available()
     meta = {"step": int(state.step) if step is None else step,
             "format": "orbax" if use_orbax else "npz"}
+    if model is not None:
+        # record the topology the state was placed under ({} = single
+        # device) so a restore onto a DIFFERENT fleet shape is detected
+        # instead of handing old-mesh shardings (or a raw shape error)
+        # to the restoring model — docs/elastic.md.  Model-less saves
+        # cannot know and omit the key (legacy checkpoints also lack
+        # it); restore treats "absent" as unknown, never as single.
+        meta["mesh"] = mesh_topology(getattr(model, "mesh", None))
     host_tables = _host_tables_of(model)
     if use_orbax:
         import orbax.checkpoint as ocp
@@ -169,10 +178,60 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
     return path
 
 
+def saved_topology(path: str) -> Optional[dict]:
+    """The ``{axis: size}`` mesh topology recorded in a checkpoint's
+    ``meta.json`` (``{}`` = saved single-device), or None when the
+    checkpoint predates topology recording / was saved model-less.
+    Raises :class:`CheckpointError` like :func:`restore_checkpoint`
+    for a missing/corrupt meta.json."""
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"{path!r} has no meta.json — not a checkpoint directory"
+        ) from None
+    except json.JSONDecodeError as e:
+        raise CheckpointError(
+            f"{meta_path!r} is truncated or corrupt ({e})") from e
+    return meta.get("mesh")
+
+
+def host_gather(tree):
+    """Every array leaf of a (nested-dict) tree pulled to a host-logical
+    numpy array — shard layouts (any mesh, or none) erased, values
+    untouched.  The 'gather' half of reshard-on-restore
+    (docs/elastic.md, re-exported by ``elastic.reshard``): a leaf
+    restored sharded under the SAVED mesh (the orbax path reconstructs
+    shardings from its sharding file) becomes one full host array,
+    ready to be re-placed under whatever mesh the restoring model
+    actually runs."""
+    if isinstance(tree, dict):
+        return {k: host_gather(v) for k, v in tree.items()}
+    if hasattr(tree, "__array__"):
+        return np.asarray(tree)
+    return tree
+
+
 def restore_checkpoint(path: str, model=None,
-                       inference_only: bool = False) -> TrainState:
+                       inference_only: bool = False,
+                       on_mesh_change: str = "error") -> TrainState:
     """Read a checkpoint back into a TrainState; if ``model`` has an active
     mesh, parameters are re-placed with their strategy shardings.
+
+    ``on_mesh_change`` decides what happens when the checkpoint's
+    recorded topology (meta.json ``mesh``) differs from the restoring
+    ``model``'s: ``"error"`` (default) raises :class:`CheckpointError`
+    naming both topologies — restoring cross-topology silently would
+    hand the model arrays still sharded under a mesh it does not run
+    (or, on a fleet where the saved devices are gone, a raw placement
+    error).  ``"reshard"`` is the elastic path
+    (``dlrm_flexflow_tpu.elastic.reshard_restore``, docs/elastic.md):
+    every leaf is gathered to a host-logical array and re-placed under
+    the restoring model's own partition rules — table-parallel
+    embedding rows re-split on the new ``model`` axis, optimizer slots
+    re-sharded alongside their parameters.
 
     ``inference_only=True`` is the serving mode (docs/serving.md): load
     params (+ BN state + hetero host tables) WITHOUT requiring optimizer
@@ -189,6 +248,10 @@ def restore_checkpoint(path: str, model=None,
     Raises :class:`CheckpointError` (naming the path and what is
     missing/corrupt) for a nonexistent directory, an absent or truncated
     ``meta.json``, or a missing/unreadable state payload."""
+    if on_mesh_change not in ("error", "reshard"):
+        raise ValueError(
+            f"on_mesh_change must be 'error' or 'reshard', "
+            f"got {on_mesh_change!r}")
     if not os.path.isdir(path):
         raise CheckpointError(
             f"checkpoint directory {path!r} does not exist")
@@ -205,6 +268,33 @@ def restore_checkpoint(path: str, model=None,
         raise CheckpointError(
             f"{meta_path!r} is truncated or corrupt ({e}) — the save "
             f"was likely killed mid-write") from e
+    # topology guard BEFORE the payload is read: refusing after a full
+    # orbax restore would waste the read and leave its old-mesh arrays
+    # around; meta.json alone answers the question.  An UNKNOWN saved
+    # topology (legacy / model-less save) never trips the error guard —
+    # that would break every pre-elastic checkpoint — but the reshard
+    # path treats it as changed: when the caller explicitly asked for a
+    # cross-topology restore, "can't tell" must gather conservatively
+    # (a same-topology gather is value-neutral; skipping a needed one
+    # leaves dead-mesh shardings on the leaves).
+    mesh_changed = False
+    if model is not None:
+        saved_topo = meta.get("mesh")
+        want_topo = mesh_topology(getattr(model, "mesh", None))
+        known_change = (saved_topo is not None
+                        and not same_topology(saved_topo, want_topo))
+        mesh_changed = known_change or (saved_topo is None
+                                        and on_mesh_change == "reshard")
+        if known_change and on_mesh_change == "error":
+            raise CheckpointError(
+                f"{path!r} was saved on mesh topology "
+                f"[{format_topology(saved_topo)}] but the restoring "
+                f"model runs [{format_topology(want_topo)}] — the "
+                f"fleet shape changed.  Restore across topologies "
+                f"through dlrm_flexflow_tpu.elastic.reshard_restore "
+                f"(docs/elastic.md), which gathers the saved shards "
+                f"to host-logical arrays and re-places them under "
+                f"the new mesh's partition rules")
     host_tables = {}
     if meta["format"] == "orbax":
         import orbax.checkpoint as ocp
@@ -257,6 +347,16 @@ def restore_checkpoint(path: str, model=None,
             f"from scratch).  Pass inference_only=True to load params "
             f"for serving (docs/serving.md)")
     if model is not None:
+        if mesh_changed:
+            # reshard: pull every leaf to a host-logical array FIRST —
+            # the orbax path hands back arrays still sharded under the
+            # SAVED mesh, and placement below must start from full
+            # host-logical values, not a dead topology's layout
+            state = TrainState(host_gather(state.params),
+                               host_gather(state.opt_state),
+                               host_gather(state.bn_state),
+                               jnp.asarray(np.asarray(state.rng)),
+                               jnp.asarray(np.asarray(state.step)))
         # re-form parameters for the restoring model's storage mode
         # (logical checkpoints -> packed tables on single-chip TPU;
         # packed checkpoints from a model-less save -> logical for a

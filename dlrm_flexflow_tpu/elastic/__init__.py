@@ -1,0 +1,42 @@
+"""Elastic topology: the device fleet's shape is a RUNTIME variable
+(docs/elastic.md).
+
+Everything below this package treats the mesh as a run constant —
+training places state under ``parallel/mesh.py:partition_rules()``, the
+serving engine AOT-compiles under it, checkpoints restore bit-identical
+onto it.  Preemptible TPU fleets break that assumption on every
+preemption: the pod that comes back is rarely the pod that died.  This
+package is the integration layer that absorbs the change on both
+halves of the stack:
+
+* :func:`reshard_restore` / :func:`reshard_state` (``reshard.py``) —
+  restore ANY checkpoint onto ANY mesh shape: saved shards are gathered
+  to host-logical arrays and re-placed under the new mesh's partition
+  rules (table-parallel embedding rows re-split on the new ``model``
+  axis, optimizer slots re-sharded alongside their parameters).  The
+  training loop routes resumes through it automatically when the
+  checkpoint's recorded topology differs from the model's
+  (``resilience/loop.py``; killed by ``preempt+reshape@step=K:mesh=DxM``
+  — :class:`~..resilience.faultinject.Reshape`).  Trajectory guarantee:
+  tolerance-level loss equivalence vs the never-killed run, not
+  bitwise — the new topology reorders collective reductions
+  (pinned by ``scripts/check_elastic.py``).
+* :class:`ElasticController` / :func:`regate_strategy`
+  (``controller.py``) — live serving scale: drives
+  ``ReplicaRouter.scale_to/rebuild`` (zero accepted requests dropped
+  across a resize) and re-gates the topology-scoped incumbent SOAP
+  strategy through ``sim/tune.py``'s promotion machinery, so a
+  reshaped fleet never keeps serving a stale topology's strategy.
+
+Telemetry: ``elastic`` events (phases ``reshard``/``scale``/``regate``)
+plus the ``dlrm_elastic_reshard_total`` counter and the live
+``dlrm_serve_replicas`` gauge (docs/telemetry.md).
+"""
+
+from .controller import ElasticController, regate_strategy
+from .reshard import gather_state, host_gather, reshard_restore, reshard_state
+
+__all__ = [
+    "ElasticController", "regate_strategy", "gather_state", "host_gather",
+    "reshard_restore", "reshard_state",
+]

@@ -1,0 +1,162 @@
+"""Reshard-on-restore: any checkpoint onto any mesh (docs/elastic.md).
+
+A checkpoint records the topology it was saved under
+(``checkpoint.py`` meta.json ``mesh``); a plain restore onto a
+different shape refuses (:class:`~..checkpoint.CheckpointError`) because
+the orbax path would hand back arrays still sharded under the DEAD
+mesh.  This module is the sanctioned crossing: **gather, then
+re-place** —
+
+1. every saved leaf is pulled to one host-logical numpy array
+   (:func:`host_gather` — the ``gather_fns`` half of the
+   ``match_partition_rules`` / ``make_shard_and_gather_fns`` pattern);
+2. the restoring model's own ``parallel/mesh.py:partition_rules()`` —
+   the SAME ordered spec list training placement, the mesh-native
+   serving engine, and prefetch sharding already share — names each
+   leaf's PartitionSpec, and ``apply_partition_rules`` ``device_put``s
+   it under the new ``NamedSharding``.  Table-parallel embedding rows
+   re-split on the new ``model`` axis; optimizer ``m``/``v`` slots ride
+   the identical rules as the parameters they shadow; a leaf whose dim
+   no longer divides the new axis falls back to replicated instead of
+   failing the restore.
+
+Values never change — only placement does — so a same-seed run resumed
+across a reshape tracks the never-killed baseline's loss trajectory to
+collective-reduction tolerance (the equivalence ``check_elastic.py``
+pins).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..checkpoint import host_gather, saved_topology
+from ..model import TrainState
+from ..parallel.mesh import (apply_partition_rules, format_topology,
+                             mesh_topology, partition_rules, same_topology)
+from ..resilience.manager import CheckpointManager, latest_checkpoint
+from ..telemetry import emit
+from ..telemetry import metrics as _tmetrics
+
+
+def gather_state(state: TrainState) -> TrainState:
+    """The whole TrainState as host-logical numpy leaves."""
+    return TrainState(host_gather(state.params),
+                      host_gather(state.opt_state),
+                      host_gather(state.bn_state),
+                      np.asarray(state.rng), np.asarray(state.step))
+
+
+def _count_leaves(tree) -> int:
+    if isinstance(tree, dict):
+        return sum(_count_leaves(v) for v in tree.values())
+    return 1
+
+
+def reshard_state(state: TrainState, model) -> TrainState:
+    """Re-place a LIVE TrainState under ``model``'s current mesh:
+    gather every leaf to host, then ``device_put`` it under the spec
+    its first matching partition rule names (``partition_rules()`` +
+    ``apply_partition_rules`` — the serving engine's placement path,
+    reused for state).  Optimizer ``m``/``v`` slot trees go under the
+    SAME rules as the parameters they shadow; other optimizer entries
+    and BN state replicate.  With no mesh the gathered host state comes
+    back as-is (single-device placement happens lazily at first
+    dispatch)."""
+    g = gather_state(state)
+    mesh = getattr(model, "mesh", None)
+    if mesh is None:
+        return g
+    rules = partition_rules(model)
+    params = apply_partition_rules(rules, g.params, mesh)
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def place_opt(x):
+        if isinstance(x, dict) and set(x) >= {"step"}:
+            # m/v slots mirror the parameter rules; every other entry
+            # (step, lr, ...) is a replicated scalar
+            return {k: (apply_partition_rules(rules, v, mesh)
+                        if k in ("m", "v") and isinstance(v, dict)
+                        else jax.device_put(v, repl))
+                    for k, v in x.items()}
+        return x
+
+    opt_state = place_opt(g.opt_state)
+    bn = jax.tree.map(lambda a: jax.device_put(a, repl), g.bn_state)
+    return TrainState(params, opt_state, bn,
+                      jax.device_put(g.rng, repl),
+                      jax.device_put(g.step, repl))
+
+
+def reshard_restore(manager, model, mesh=None,
+                    inference_only: bool = False
+                    ) -> Tuple[TrainState, Dict[str, Any], str]:
+    """Restore the newest valid checkpoint onto ``model`` REGARDLESS of
+    the topology it was saved under — the elastic resume
+    (docs/elastic.md).  ``manager`` is a
+    :class:`~..resilience.CheckpointManager`, a manager directory, or
+    one committed checkpoint directory.  ``model`` must already be
+    compiled under the TARGET mesh; pass ``mesh`` to assert which one
+    (a mismatch raises ValueError — the model, not the argument, is
+    what actually places state).  Returns ``(state, extra, path)``
+    like ``CheckpointManager.restore_latest``.
+
+    Emits one ``elastic`` ``phase="reshard"`` event naming the saved
+    and restored topologies and bumps ``dlrm_elastic_reshard_total``.
+    Same-topology calls degrade to a plain restore (no elastic event —
+    nothing was resharded)."""
+    if mesh is not None and not same_topology(mesh_topology(mesh),
+                                              mesh_topology(model.mesh)):
+        raise ValueError(
+            f"model is compiled under "
+            f"[{format_topology(mesh_topology(model.mesh))}] but the "
+            f"target mesh is [{format_topology(mesh_topology(mesh))}] — "
+            f"compile the model under the target mesh first "
+            f"(model.compile(mesh=...)), then reshard_restore")
+    t0 = time.perf_counter()
+    if isinstance(manager, str):
+        if latest_checkpoint(manager) is not None:
+            manager = CheckpointManager(manager)
+        else:
+            # one committed checkpoint directory (or garbage, which
+            # restore_checkpoint names loudly)
+            from ..checkpoint import restore_checkpoint
+            import json
+            import os
+            path = manager
+            state = restore_checkpoint(path, model=model,
+                                       inference_only=inference_only,
+                                       on_mesh_change="reshard")
+            extra: Dict[str, Any] = {}
+            epath = os.path.join(path, "extra.json")
+            if os.path.isfile(epath):
+                with open(epath) as f:
+                    extra = json.load(f)
+            return _finish(state, extra, path, model, t0)
+    state, extra, path = manager.restore_latest(
+        model=model, inference_only=inference_only,
+        on_mesh_change="reshard")
+    return _finish(state, extra, path, model, t0)
+
+
+def _finish(state: TrainState, extra: Dict[str, Any], path: str, model,
+            t0: float) -> Tuple[TrainState, Dict[str, Any], str]:
+    saved = saved_topology(path)
+    to_topo = mesh_topology(getattr(model, "mesh", None))
+    if saved is not None and same_topology(saved, to_topo):
+        return state, extra, path  # nothing was resharded
+    leaves = _count_leaves(state.params) + _count_leaves(state.opt_state)
+    emit("elastic", phase="reshard",
+         from_mesh=format_topology(saved) if saved is not None
+         else "unknown",
+         to_mesh=format_topology(to_topo),
+         step=int(np.asarray(state.step)), leaves=leaves,
+         duration_s=time.perf_counter() - t0)
+    _tmetrics.ELASTIC_RESHARDS.inc()
+    return state, extra, path

@@ -128,6 +128,44 @@ def sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+# ------------------------------------------------------------- topology ids
+#
+# A checkpoint is only portable across fleet reshapes if it can SAY what
+# topology produced it (checkpoint.py records this in meta.json) and the
+# restorer can compare.  Topologies are plain {axis: size} dicts so they
+# survive a JSON round trip; comparison drops size-1 axes — a
+# {"data": 1} mesh and no mesh at all execute the identical program, so
+# elastic restore (docs/elastic.md) must not treat them as a reshape.
+
+def mesh_topology(mesh: Optional[Mesh]) -> Dict[str, int]:
+    """``{axis_name: size}`` of a mesh; ``{}`` for no mesh (single
+    device).  JSON-able — the form checkpoints record."""
+    if mesh is None:
+        return {}
+    return {str(n): int(s)
+            for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def _effective_topology(topo: Optional[Dict[str, int]]) -> Dict[str, int]:
+    return {k: int(v) for k, v in (topo or {}).items() if int(v) > 1}
+
+
+def same_topology(a: Optional[Dict[str, int]],
+                  b: Optional[Dict[str, int]]) -> bool:
+    """Whether two topology dicts execute the same partitioning.
+    Size-1 axes (and None/{}) are equivalent: they replicate."""
+    return _effective_topology(a) == _effective_topology(b)
+
+
+def format_topology(topo: Optional[Dict[str, int]]) -> str:
+    """Human/telemetry form: ``"data=2,model=4"``, or ``"single"`` when
+    nothing is actually partitioned."""
+    eff = _effective_topology(topo)
+    if not eff:
+        return "single"
+    return ",".join(f"{k}={v}" for k, v in sorted(eff.items()))
+
+
 def constrain(x, mesh: Optional[Mesh], spec: PartitionSpec):
     """Apply a sharding constraint if a mesh is active (the per-op analogue
     of the mapper's placement decision)."""

@@ -16,8 +16,10 @@ training.
   (:class:`TrainingDiverged` past it).
 * :mod:`.faultinject` — deterministic fault injection
   (``nan_grads@step=K``, ``io_error@save=N``, ``preempt@step=K``,
-  ``preempt@save``) so every recovery path is provable end-to-end;
-  :class:`Preemption` is the injected kill.
+  ``preempt@save``, ``preempt+reshape@step=K:mesh=DxM``) so every
+  recovery path is provable end-to-end; :class:`Preemption` is the
+  injected kill, :class:`Reshape` the kill after which the fleet
+  returns with a different topology (docs/elastic.md).
 
 Wired through ``FFModel.fit(checkpoint_manager=..., resume=True,
 checkpoint_every_n_steps=..., sentinel=NaNSentinel(...))``; all
@@ -26,11 +28,11 @@ telemetry events visible in ``python -m dlrm_flexflow_tpu.telemetry
 report``.
 """
 
-from .faultinject import Preemption
+from .faultinject import Preemption, Reshape
 from .manager import CheckpointManager, latest_checkpoint, verify_checkpoint
 from .sentinel import NaNSentinel, TrainingDiverged
 
 __all__ = [
     "CheckpointManager", "latest_checkpoint", "verify_checkpoint",
-    "NaNSentinel", "TrainingDiverged", "Preemption",
+    "NaNSentinel", "TrainingDiverged", "Preemption", "Reshape",
 ]

@@ -18,6 +18,17 @@ the ``FF_FAULTS`` environment variable — and consumed at fixed sites:
                         mid-save — the crash-consistency path)
     io_error@save=N     raise OSError on the next N checkpoint write
                         attempts (the retry-with-backoff path)
+    preempt+reshape@step=K:mesh=DxM
+                        raise :class:`Reshape` at the top of global
+                        step K carrying the TARGET mesh shape
+                        {"data": D, "model": M} — a preemption after
+                        which the fleet comes back with a different
+                        device topology (the normal preemptible-pod
+                        case; docs/elastic.md).  The driver catching it
+                        reads ``e.mesh_shape``, recompiles under the
+                        new mesh, and resumes elastically.  ``:mesh=``
+                        may be omitted when the resuming driver picks
+                        its own shape.
 
 Entries are separated by ``,`` or ``;``.  Every firing decrements the
 fault's remaining count (specs without ``=N`` fire once) and emits a
@@ -46,8 +57,35 @@ class Preemption(BaseException):
     """
 
 
-_KINDS = ("nan_grads", "io_error", "preempt")
+class Reshape(Preemption):
+    """A preemption after which the fleet returns with a DIFFERENT
+    device topology (``preempt+reshape`` — docs/elastic.md).
+    ``mesh_shape`` is the target ``{axis: size}`` dict the spec carried
+    (None when the spec left the resuming shape to the driver)."""
+
+    def __init__(self, msg: str, mesh_shape: Optional[Dict[str, int]] = None):
+        super().__init__(msg)
+        self.mesh_shape = mesh_shape
+
+
+_KINDS = ("nan_grads", "io_error", "preempt", "preempt+reshape")
 _POINTS = ("step", "save", "restore")
+
+
+def parse_mesh_shape(spec: str) -> Dict[str, int]:
+    """``"DxM"`` -> ``{"data": D, "model": M}`` (the two named axes of
+    parallel/mesh.py; a trailing ``x1`` may be omitted: ``"2"`` means
+    data=2)."""
+    parts = [p.strip() for p in spec.lower().split("x")]
+    if not (1 <= len(parts) <= 2) or not all(p.isdigit() for p in parts):
+        raise ValueError(
+            f"bad mesh shape {spec!r}: want DxM (data x model), e.g. "
+            f"mesh=2x1")
+    d = int(parts[0])
+    m = int(parts[1]) if len(parts) == 2 else 1
+    if d < 1 or m < 1:
+        raise ValueError(f"bad mesh shape {spec!r}: sizes must be >= 1")
+    return {"data": d, "model": m}
 
 
 @dataclasses.dataclass
@@ -56,9 +94,13 @@ class _Fault:
     point: str                 # one of _POINTS
     value: Optional[int]       # step number (point="step"), else None
     remaining: int             # firings left
+    mesh: Optional[Dict[str, int]] = None  # preempt+reshape target shape
 
     def spec(self) -> str:
         tail = f"={self.value}" if self.value is not None else ""
+        if self.mesh is not None:
+            tail += (f":mesh={self.mesh.get('data', 1)}"
+                     f"x{self.mesh.get('model', 1)}")
         return f"{self.kind}@{self.point}{tail}"
 
 
@@ -79,8 +121,18 @@ def parse(spec: str) -> List[_Fault]:
         kind, _, rest = entry.partition("@")
         kind = kind.strip()
         value: Optional[int] = None
+        mesh: Optional[Dict[str, int]] = None
         point, _, val = rest.partition("=")
         point = point.strip()
+        # a reshape spec's value may carry the target topology:
+        # preempt+reshape@step=5:mesh=2x1
+        val, _, mesh_spec = val.partition(":mesh=")
+        if mesh_spec:
+            if kind != "preempt+reshape":
+                raise ValueError(
+                    f"{entry!r}: only preempt+reshape faults carry a "
+                    f"target mesh shape")
+            mesh = parse_mesh_shape(mesh_spec)
         if val:
             value = int(val)
         if kind not in _KINDS:
@@ -89,12 +141,17 @@ def parse(spec: str) -> List[_Fault]:
         if point not in _POINTS:
             raise ValueError(f"unknown fault point {point!r} "
                              f"(known: {_POINTS})")
+        if kind == "preempt+reshape" and point != "step":
+            raise ValueError(
+                f"{entry!r}: preempt+reshape fires at a step boundary "
+                f"(kind@step=K[:mesh=DxM]) — a reshape lands between "
+                f"runs, not inside a save")
         if point == "step":
             if value is None:
                 raise ValueError(
                     f"{entry!r}: step faults need a step number "
                     f"(kind@step=K)")
-            out.append(_Fault(kind, point, value, 1))
+            out.append(_Fault(kind, point, value, 1, mesh))
         else:
             # value at a site point is a firing count (io_error@save=2)
             out.append(_Fault(kind, point, None,
@@ -194,12 +251,22 @@ def poison_batch(inputs: Dict[str, np.ndarray], labels, step: int):
 
 
 def maybe_preempt(point: str, step: Optional[int] = None) -> None:
-    """Raise :class:`Preemption` when a ``preempt@<point>`` fault fires."""
+    """Raise :class:`Preemption` when a ``preempt@<point>`` fault fires,
+    or :class:`Reshape` (carrying the target mesh shape) for a
+    ``preempt+reshape`` fault — the elastic recovery path's kill."""
     f = _match("preempt", point, step)
     if f is not None:
         _fire(f, step=step)
         raise Preemption(f"injected preemption at {point}"
                          + (f" step {step}" if step is not None else ""))
+    f = _match("preempt+reshape", point, step)
+    if f is not None:
+        _fire(f, step=step)
+        raise Reshape(
+            f"injected preemption+reshape at {point}"
+            + (f" step {step}" if step is not None else "")
+            + (f" (fleet returns as {f.mesh})" if f.mesh else ""),
+            mesh_shape=dict(f.mesh) if f.mesh else None)
 
 
 def maybe_io_error(point: str, step: Optional[int] = None) -> None:

@@ -142,7 +142,22 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     if resume and manager is not None and manager.latest() is not None:
         push_span(fit_span)  # parents the manager's ckpt.restore span
         try:
-            state, extra, _path = manager.restore_latest(model=model)
+            # elastic recovery (docs/elastic.md): when the newest
+            # checkpoint was saved on a DIFFERENT topology than this
+            # model runs (the fleet reshaped across the kill —
+            # preempt+reshape), route through reshard_restore: gather
+            # the saved shards to host-logical arrays and re-place them
+            # under THIS model's partition rules.  Same-topology
+            # resumes keep the plain bit-identical restore.
+            from ..checkpoint import saved_topology
+            from ..parallel.mesh import mesh_topology, same_topology
+            saved = saved_topology(manager.latest())
+            if saved is not None and not same_topology(
+                    saved, mesh_topology(model.mesh)):
+                from ..elastic.reshard import reshard_restore
+                state, extra, _path = reshard_restore(manager, model)
+            else:
+                state, extra, _path = manager.restore_latest(model=model)
         finally:
             pop_span(fit_span)
         if extra.get("loader") is not None \

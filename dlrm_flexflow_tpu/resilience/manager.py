@@ -276,12 +276,16 @@ class CheckpointManager:
     def latest(self) -> Optional[str]:
         return latest_checkpoint(self.directory)
 
-    def restore_latest(self, model=None, inference_only: bool = False
+    def restore_latest(self, model=None, inference_only: bool = False,
+                       on_mesh_change: str = "error"
                        ) -> Tuple[Any, Dict[str, Any], str]:
         """(state, extra, path) from the newest VALID checkpoint.
         ``inference_only=True`` loads params without optimizer slots
-        (the serving engine's restore — checkpoint.py).  Raises
-        :class:`CheckpointError` when the directory holds none."""
+        (the serving engine's restore — checkpoint.py);
+        ``on_mesh_change="reshard"`` is the elastic cross-topology
+        restore (checkpoint.restore_checkpoint, docs/elastic.md).
+        Raises :class:`CheckpointError` when the directory holds
+        none."""
         path = self.latest()
         if path is None:
             raise CheckpointError(
@@ -289,7 +293,8 @@ class CheckpointManager:
         t0 = time.perf_counter()
         with start_span("ckpt.restore", attrs={"path": path}):
             state = restore_checkpoint(path, model=model,
-                                       inference_only=inference_only)
+                                       inference_only=inference_only,
+                                       on_mesh_change=on_mesh_change)
             extra: Dict[str, Any] = {}
             epath = os.path.join(path, EXTRA)
             if os.path.isfile(epath):

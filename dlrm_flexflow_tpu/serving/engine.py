@@ -195,13 +195,18 @@ class InferenceEngine:
 
     # ----------------------------------------------------------- construction
     @classmethod
-    def from_checkpoint(cls, model, path: str, **kwargs) -> "InferenceEngine":
+    def from_checkpoint(cls, model, path: str,
+                        on_mesh_change: str = "error",
+                        **kwargs) -> "InferenceEngine":
         """Build an engine from a training checkpoint WITHOUT optimizer
         slots in memory: ``path`` is either a ``CheckpointManager``
         directory (the newest valid ``ckpt-<step>`` is used) or one
         committed checkpoint directory.  Restores with
         ``inference_only=True`` — archives missing optimizer slots load
-        fine, present slots are skipped."""
+        fine, present slots are skipped.  ``on_mesh_change="reshard"``
+        serves a checkpoint saved on a DIFFERENT topology (gather +
+        re-place under this model's mesh — docs/elastic.md); the
+        default refuses with :class:`~..checkpoint.CheckpointError`."""
         import os
 
         from ..checkpoint import CheckpointError, restore_checkpoint
@@ -222,7 +227,8 @@ class InferenceEngine:
                     f"{path!r} contains checkpoints but none verify "
                     f"(all corrupt/partial) — nothing to serve from")
             ckpt = path
-        state = restore_checkpoint(ckpt, model=model, inference_only=True)
+        state = restore_checkpoint(ckpt, model=model, inference_only=True,
+                                   on_mesh_change=on_mesh_change)
         return cls(model, state, **kwargs)
 
     # ------------------------------------------------------------ compilation

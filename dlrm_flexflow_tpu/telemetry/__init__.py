@@ -15,6 +15,9 @@ typed, schema-checked events from every layer of the framework:
                   simulator's prediction (profiling.OpTimer)
   * ``serve``   — online-serving dispatches, shed requests, and latency
                   summaries (serving/, docs/serving.md)
+  * ``elastic`` — topology changes absorbed at runtime: cross-mesh
+                  checkpoint reshards, live replica resizes, incumbent
+                  re-gates (elastic/, docs/elastic.md)
   * ``span``    — Dapper-style causal spans: serving request chains
                   (submit → queue-wait → forward → reply) and training
                   chains (fit → epoch → dispatch → checkpoint)

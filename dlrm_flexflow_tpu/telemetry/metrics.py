@@ -68,6 +68,12 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "dlrm_serve_router_shed_total": (
         "counter",
         "requests a ReplicaRouter shed with every replica saturated"),
+    "dlrm_serve_replicas": (
+        "gauge", "live serving replicas across all ReplicaRouters "
+                 "(moves with scale_to/rebuild — docs/elastic.md)"),
+    "dlrm_elastic_reshard_total": (
+        "counter", "checkpoints restored across a topology change "
+                   "(elastic.reshard_restore — docs/elastic.md)"),
     "dlrm_train_steps_total": (
         "counter", "training dispatches adopted (global steps)"),
     "dlrm_train_samples_per_s": (
@@ -498,7 +504,10 @@ def _router_shed_total() -> float:
 def _replica_qps() -> Dict[str, float]:
     out: Dict[str, float] = {}
     for r in list(_live_routers):
-        for label, b in zip(r.replica_labels(), r.batchers):
+        # replica_rows() is ONE consistent (label, batcher) snapshot —
+        # the replica set is mutable now (scale_to/rebuild), so two
+        # separate labels/batchers reads could zip mismatched rows
+        for label, b in r.replica_rows():
             out[label] = out.get(label, 0.0) + b.stats.lifetime_qps()
     return out
 
@@ -506,9 +515,18 @@ def _replica_qps() -> Dict[str, float]:
 def _replica_queue_depth() -> Dict[str, float]:
     out: Dict[str, float] = {}
     for r in list(_live_routers):
-        for label, b in zip(r.replica_labels(), r.batchers):
+        for label, b in r.replica_rows():
             out[label] = out.get(label, 0.0) + float(b.queue_depth())
     return out
+
+
+def _serve_replicas() -> Optional[float]:
+    """Live replica count across routers (None with no live router —
+    'no serving tier' is absent, never a fake 0)."""
+    routers = list(_live_routers)
+    if not routers:
+        return None
+    return float(sum(len(r) for r in routers))
 
 
 # the scrape collectors hold _retired_lock across the pending-fold
@@ -647,6 +665,10 @@ SERVE_REPLICA_QUEUE_DEPTH = REGISTRY.register(
                  _replica_queue_depth))
 SERVE_ROUTER_SHED = REGISTRY.register(
     Gauge("dlrm_serve_router_shed_total", fn=_router_shed_total))
+SERVE_REPLICAS = REGISTRY.register(
+    Gauge("dlrm_serve_replicas", fn=_serve_replicas))
+ELASTIC_RESHARDS = REGISTRY.register(
+    Counter("dlrm_elastic_reshard_total"))
 TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
 TRAIN_SAMPLES_PER_S = REGISTRY.register(
     Gauge("dlrm_train_samples_per_s"))

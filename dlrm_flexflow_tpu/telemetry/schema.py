@@ -157,6 +157,27 @@ SCHEMA: Dict[str, dict] = {
             "summary": ("requests", "qps"),
         },
     },
+    # one elastic-topology action (elastic/, docs/elastic.md).
+    # ``phase`` selects the sub-shape: one cross-topology checkpoint
+    # restore ("reshard" — saved shards gathered to host-logical arrays
+    # and re-placed under the new mesh's partition rules), one live
+    # replica resize ("scale" — ReplicaRouter.scale_to/rebuild), or one
+    # incumbent-strategy re-gate for the new topology ("regate" —
+    # through sim/tune.py's promotion machinery; ``verdict`` is
+    # "incumbent" / "none" / a gate_candidate verdict).
+    "elastic": {
+        "required": {"phase": str},
+        "optional": {"from_mesh": str, "to_mesh": str, "step": int,
+                     "leaves": int, "duration_s": float,
+                     "replicas_from": int, "replicas_to": int,
+                     "drained": int, "verdict": str, "app": str,
+                     "num_devices": int, "version": int},
+        "phases": {
+            "reshard": ("from_mesh", "to_mesh"),
+            "scale": ("replicas_from", "replicas_to"),
+            "regate": ("verdict",),
+        },
+    },
     # one injected fault firing (resilience/faultinject.py) — recovery
     # tests read these next to the checkpoint/anomaly events the fault
     # provoked.  ``point``: "step" | "save" | "restore"; ``remaining``:

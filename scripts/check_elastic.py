@@ -1,0 +1,422 @@
+"""Elastic-topology smoke matrix (tier-1: tests/test_elastic.py runs it).
+
+End-to-end scenarios on a tiny DLRM, CPU backend with virtual devices —
+the elastic analogue of ``check_resilience.py`` / ``check_serving.py``
+(docs/elastic.md):
+
+  1. preempt+reshape kill-resume — a single-device run killed at step 5
+     by ``FF_FAULTS=preempt+reshape@step=5:mesh=2x1`` resumes on the
+     2x1 data x model mesh the fault spec carried; its loss trajectory
+     and final params match the never-killed same-seed baseline within
+     tolerance (the new topology reorders collective reductions — the
+     trajectory-equivalence guarantee, NOT bitwise), and the resume
+     emits the ``elastic`` phase="reshard" event;
+  2. reshard round-trip matrix — one trained state saved on each of
+     {single-device, data x model, model-only} restores onto each OTHER
+     shape with params AND optimizer slots gathering back
+     value-identical; the plain (non-elastic) restore refuses with a
+     CheckpointError naming both topologies;
+  3. router scale 1 -> 4 -> 2 under open-loop load — resizes issued
+     from a second thread while requests arrive; every accepted request
+     completes, the /metrics served counter is monotone across the
+     resizes, the live ``dlrm_serve_replicas`` gauge tracks the size,
+     and the topology-scoped incumbent strategy is re-gated per resize
+     (verdicts: incumbent at attach, first for the promoted 4-replica
+     candidate, none at 2 — never a stale topology's strategy);
+  4. mesh rebuild — a single-device router rebuilt live onto an engine
+     whose params were reshard_state-placed under a data-parallel mesh;
+     requests queued across the swap all complete and answers stay
+     bit-identical (full-mesh replica contract, docs/serving.md).
+
+Exit 0 when every scenario passes; prints one line per scenario and
+exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the mesh scenarios want a multi-device platform; standalone runs on
+# the CPU backend pin the virtual device count BEFORE jax initializes
+# (under pytest, tests/conftest.py has already set the same flag)
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.checkpoint import (CheckpointError,  # noqa: E402
+                                          restore_checkpoint)
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader  # noqa: E402
+from dlrm_flexflow_tpu.elastic import (ElasticController,  # noqa: E402
+                                       gather_state, reshard_restore,
+                                       reshard_state)
+from dlrm_flexflow_tpu.resilience import (CheckpointManager,  # noqa: E402
+                                          Reshape, faultinject)
+from dlrm_flexflow_tpu.serving import (InferenceEngine,  # noqa: E402
+                                       ReplicaRouter)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+from dlrm_flexflow_tpu.telemetry import metrics as tmetrics  # noqa: E402
+
+BATCH, SAMPLES, EPOCHS = 8, 32, 2  # 4 batches/epoch, 8 steps total
+
+
+def make_model(mesh=False, table_parallel=False):
+    # uniform tables so the stacked table/row dims divide a 2-way model
+    # axis in every topology of the matrix
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 64],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=BATCH, serve_buckets="2,4"),
+                   table_parallel=table_parallel)
+    m.compile(optimizer=ff.AdamOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
+    return cfg, m
+
+
+def make_loader(cfg):
+    return SyntheticDLRMLoader(SAMPLES, cfg.mlp_bot[0], cfg.embedding_size,
+                               cfg.embedding_bag_size, BATCH, seed=3)
+
+
+def scenario_preempt_reshape_resume() -> str:
+    cfg, m1 = make_model(mesh=False)
+    # never-killed same-seed baseline on the ORIGINAL topology
+    faultinject.clear()
+    s_ref, _ = m1.fit(m1.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+                      verbose=False, checkpoint_manager=CheckpointManager(
+                          tempfile.mkdtemp(prefix="elastic_twin_")),
+                      checkpoint_every_n_steps=2)
+    ref_trace = dict(zip(m1._fit_loss_steps.tolist(),
+                         m1._fit_loss_trace.tolist()))
+    # killed run: the reshape kill arrives through the env route, as a
+    # fleet preemption would, carrying the topology the fleet will
+    # return as
+    d = tempfile.mkdtemp(prefix="elastic_preempt_")
+    mgr = CheckpointManager(d, keep_n=3)
+    faultinject.clear()
+    os.environ["FF_FAULTS"] = "preempt+reshape@step=5:mesh=2x1"
+    target = None
+    try:
+        m1.fit(m1.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+               verbose=False, checkpoint_manager=mgr,
+               checkpoint_every_n_steps=2)
+        return "reshape preemption never fired"
+    except Reshape as e:
+        target = e.mesh_shape
+    finally:
+        os.environ.pop("FF_FAULTS", None)
+    faultinject.clear()
+    if target != {"data": 2, "model": 1}:
+        return f"Reshape carried {target}, want data=2,model=1"
+    # resumed run: a fresh process on the NEW topology — the model is
+    # compiled under the mesh the fault spec named, and the resilient
+    # loop reshards the checkpoint on its own
+    _, m2 = make_model(mesh=ff.make_mesh(target))
+    with event_log() as log:
+        s2, _ = m2.fit(m2.init(seed=0), make_loader(cfg), epochs=EPOCHS,
+                       verbose=False, checkpoint_manager=mgr,
+                       checkpoint_every_n_steps=2, resume=True)
+    ev = log.last("elastic")
+    if ev is None or ev.get("phase") != "reshard":
+        return f"no elastic reshard event on resume ({ev!r})"
+    if ev["from_mesh"] != "single" or ev["to_mesh"] != "data=2":
+        return (f"reshard event names {ev['from_mesh']} -> "
+                f"{ev['to_mesh']}, want single -> data=2")
+    if m2._fit_loss_steps[0] != 5:
+        return f"resumed at step {m2._fit_loss_steps[0]}, want 5"
+    # trajectory equivalence: tolerance, not bitwise — the data axis
+    # splits every batch in two and the psum reorders the reduction
+    for st, lo in zip(m2._fit_loss_steps.tolist(),
+                      m2._fit_loss_trace.tolist()):
+        want = ref_trace[st]
+        if not np.isclose(lo, want, rtol=1e-3, atol=1e-6):
+            return (f"loss at step {st}: {lo} vs baseline {want} — "
+                    f"beyond reduction-reorder tolerance")
+    for op, dd in s_ref.params.items():
+        for k, v in dd.items():
+            a, b = np.asarray(v), np.asarray(s2.params[op][k])
+            if not np.allclose(a, b, rtol=1e-3, atol=1e-6):
+                return (f"param {op}/{k} off by "
+                        f"{np.abs(a - b).max()} after elastic resume")
+    return ""
+
+
+def scenario_reshard_round_trips() -> str:
+    import jax
+
+    if jax.device_count() < 4:
+        return f"platform has {jax.device_count()} devices, need 4"
+    models = {
+        "single": make_model(mesh=False)[1],
+        "dataxmodel": make_model(mesh=ff.make_mesh(
+            {"data": 2, "model": 2}), table_parallel=True)[1],
+        "model-only": make_model(mesh=ff.make_mesh(
+            {"model": 2}), table_parallel=True)[1],
+    }
+    # one reference state with NONZERO optimizer slots (two steps of
+    # Adam), gathered once; each topology then carries/saves it
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 64],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m0 = models["single"]
+    st = m0.init(seed=0)
+    rng = np.random.default_rng(5)
+    for _ in range(2):
+        x = {"dense": rng.standard_normal((BATCH, 4)).astype(np.float32),
+             "sparse": np.stack(
+                 [rng.integers(0, 64, size=(BATCH, 2), dtype=np.int64)
+                  for _ in cfg.embedding_size], axis=1)}
+        y = rng.standard_normal((BATCH, 1)).astype(np.float32)
+        st, _ = m0.train_step(st, x, y)
+    ref = gather_state(st)
+
+    def leaves_equal(tree_a, tree_b, where) -> str:
+        for op, dd in tree_a.items():
+            for k, v in dd.items():
+                a, b = np.asarray(v), np.asarray(tree_b[op][k])
+                if not np.array_equal(a, b):
+                    return (f"{where}: {op}/{k} differs by "
+                            f"{np.abs(a.astype(np.float64) - b).max()}")
+        return ""
+
+    guard_checked = False
+    for src_name, src in models.items():
+        placed = reshard_state(ref, src)
+        d = tempfile.mkdtemp(prefix=f"elastic_rt_{src_name}_")
+        mgr = CheckpointManager(d, keep_n=1)
+        if mgr.save(placed, model=src, step=1) is None:
+            return f"save on {src_name} failed"
+        for dst_name, dst in models.items():
+            if dst_name == src_name:
+                continue
+            if not guard_checked:
+                # satellite: the PLAIN restore must refuse, naming both
+                # topologies and pointing at reshard_restore
+                try:
+                    restore_checkpoint(mgr.latest(), model=dst)
+                    return (f"plain restore {src_name} -> {dst_name} "
+                            f"did not raise CheckpointError")
+                except CheckpointError as e:
+                    msg = str(e)
+                    if "reshard_restore" not in msg or "mesh" not in msg:
+                        return f"guard error unhelpful: {msg[:120]}"
+                guard_checked = True
+            st2, _extra, _path = reshard_restore(mgr, dst)
+            where = f"{src_name} -> {dst_name}"
+            err = leaves_equal(ref.params, st2.params, f"{where} params")
+            if err:
+                return err
+            for slot in ("m", "v"):
+                err = leaves_equal(ref.opt_state[slot],
+                                   st2.opt_state[slot],
+                                   f"{where} slot {slot}")
+                if err:
+                    return err
+    return ""
+
+
+class _SlowEngine(InferenceEngine):
+    """Fixed +delay per dispatch: keeps requests in flight long enough
+    that a resize demonstrably overlaps live traffic."""
+
+    def __init__(self, *args, delay_s: float = 0.008, **kwargs):
+        self._delay_s = delay_s
+        super().__init__(*args, **kwargs)
+
+    def predict(self, inputs, queue_wait_us: float = 0.0):
+        time.sleep(self._delay_s)
+        return super().predict(inputs, queue_wait_us)
+
+
+def _served_total() -> float:
+    """The monotone served counter as /metrics would expose it."""
+    rendered = tmetrics.REGISTRY.render()
+    for line in rendered.splitlines():
+        if line.startswith("dlrm_serve_requests_total "):
+            return float(line.split()[1])
+    return -1.0
+
+
+def scenario_scale_under_load() -> str:
+    from dlrm_flexflow_tpu.parallel.parallel_config import Strategy
+    from dlrm_flexflow_tpu.sim import tune
+
+    cfg, m = make_model(mesh=False)
+    engine = _SlowEngine(m, m.init(seed=0))
+    rng = np.random.default_rng(11)
+    pool = [{"dense": rng.standard_normal((1, 4)).astype(np.float32),
+             "sparse": np.stack(
+                 [rng.integers(0, 64, size=(1, 2), dtype=np.int64)
+                  for _ in cfg.embedding_size], axis=1)}
+            for _ in range(16)]
+    art = tempfile.mkdtemp(prefix="elastic_art_")
+    # the 1-replica topology has an incumbent; 4 and 2 start bare
+    _p, doc1 = tune.save_strategy_artifact(
+        art, Strategy(), app="dlrm", num_devices=1, sim_step_s=0.001,
+        seed=0, budget=1)
+    tune.promote(art, doc1)
+    _p, cand4 = tune.save_strategy_artifact(
+        art, Strategy(), app="dlrm", num_devices=4, sim_step_s=0.001,
+        seed=0, budget=1)
+
+    with event_log() as log:
+        router = ReplicaRouter([engine], max_batch_size=1,
+                               queue_depth=64)
+        ctl = ElasticController(router, artifacts_dir=art, app="dlrm")
+        if ctl.verdicts != ["incumbent"]:
+            return f"attach regate verdicts {ctl.verdicts}"
+        counters, errs = [], []
+
+        def scaler():
+            try:
+                time.sleep(0.10)
+                counters.append(_served_total())
+                ctl.scale_to(4, candidate=cand4,
+                             bench_fn=lambda d: d["sim_step_s"])
+                counters.append(_served_total())
+                time.sleep(0.15)
+                ctl.scale_to(2)
+                counters.append(_served_total())
+            except Exception as e:  # noqa: BLE001 — reported below
+                errs.append(e)
+
+        t = threading.Thread(target=scaler, name="elastic-scaler")
+        t.start()
+        futures, shed, k = [], 0, 0
+        period = 1.0 / 300.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.45:
+            tgt = t0 + k * period
+            now = time.perf_counter()
+            if tgt > now:
+                time.sleep(tgt - now)
+            try:
+                futures.append(router.submit(pool[k % len(pool)]))
+            except Exception:  # noqa: BLE001 — sheds counted, not fatal
+                shed += 1
+            k += 1
+        t.join()
+        if errs:
+            return f"scaler thread raised {errs[0]!r}"
+        mid_replicas = tmetrics.REGISTRY.render()
+        if len(router) != 2:
+            return f"router ended at {len(router)} replicas, want 2"
+        # zero accepted requests dropped across 1 -> 4 -> 2
+        for i, f in enumerate(futures):
+            try:
+                f.result(30.0)
+            except Exception as e:  # noqa: BLE001 — reported below
+                return f"accepted future {i} failed: {e!r}"
+        summary = ctl.close()
+    if "dlrm_serve_replicas 2" not in mid_replicas:
+        return "dlrm_serve_replicas gauge does not read 2 post-resize"
+    if sorted(counters) != counters or counters[0] < 0:
+        return f"served counter not monotone across resizes: {counters}"
+    if summary["requests"] != len(futures):
+        return (f"pooled summary counts {summary['requests']} of "
+                f"{len(futures)} accepted (retired replicas must fold)")
+    scale_evs = [e for e in log.events("elastic")
+                 if e.get("phase") == "scale"]
+    if [(e["replicas_from"], e["replicas_to"]) for e in scale_evs] \
+            != [(1, 4), (4, 2)]:
+        return f"scale events wrong: {scale_evs!r}"
+    regates = [e["verdict"] for e in log.events("elastic")
+               if e.get("phase") == "regate"]
+    if regates != ["incumbent", "first", "none"]:
+        return f"regate verdicts {regates}, want incumbent/first/none"
+    if tune.load_incumbent(art, "dlrm", 4) is None:
+        return "4-replica candidate was not promoted"
+    if ctl.strategy is not None:
+        return "controller still serves a strategy for the bare 2-topo"
+    return ""
+
+
+def scenario_mesh_rebuild() -> str:
+    import jax
+
+    if jax.device_count() < 2:
+        return f"platform has {jax.device_count()} devices, need 2"
+    cfg, m1 = make_model(mesh=False)
+    st = m1.init(seed=0)
+    e1 = InferenceEngine(m1, st)
+    rng = np.random.default_rng(13)
+    reqs = [{"dense": rng.standard_normal((1, 4)).astype(np.float32),
+             "sparse": np.stack(
+                 [rng.integers(0, 64, size=(1, 2), dtype=np.int64)
+                  for _ in cfg.embedding_size], axis=1)}
+            for _ in range(8)]
+    # the reference is the single-device ENGINE's answer (docs/serving.md:
+    # a full-mesh replica is bit-identical to the single-device engine;
+    # direct model.predict traces a batch-1 shape whose XLA lane packing
+    # can differ by 1 ULP from the padded bucket program)
+    want = [np.asarray(e1.predict(r)) for r in reqs]
+    # the new topology: a data-parallel full-mesh replica — params
+    # re-placed from the live single-device state via reshard_state
+    _, m2 = make_model(mesh=ff.make_mesh({"data": 2}))
+    e2 = InferenceEngine(m2, reshard_state(st, m2))
+    router = ReplicaRouter([e1], max_batch_size=1, queue_depth=32,
+                           autostart=False)  # queue requests pre-swap
+    futs = [router.submit(r) for r in reqs[:4]]
+    res = router.rebuild([e2])  # old replica drains: starts + delivers
+    if (res["replicas_from"], res["replicas_to"]) != (1, 1):
+        return f"rebuild counted {res}"
+    for i, f in enumerate(futs):
+        try:
+            got = f.result(30.0)
+        except Exception as e:  # noqa: BLE001 — reported below
+            return f"pre-swap request {i} dropped by rebuild: {e!r}"
+        if not np.array_equal(got, want[i]):
+            return f"pre-swap request {i} answer differs"
+    for i, r in enumerate(reqs[4:], start=4):
+        got = router.predict(r, result_timeout_s=30.0)
+        if not np.array_equal(got, want[i]):
+            return (f"post-rebuild request {i} differs — the full-mesh "
+                    f"replica must stay bit-identical")
+    router.close()
+    return ""
+
+
+SCENARIOS = [
+    ("preempt+reshape kill-resume trajectory equivalence",
+     scenario_preempt_reshape_resume),
+    ("reshard round-trip matrix", scenario_reshard_round_trips),
+    ("router scale 1->4->2 under load + regate",
+     scenario_scale_under_load),
+    ("mesh rebuild keeps in-flight requests", scenario_mesh_rebuild),
+]
+
+
+def main() -> int:
+    failed = 0
+    for name, fn in SCENARIOS:
+        try:
+            err = fn()
+        except Exception as e:  # a scenario must fail loudly, not crash
+            err = f"raised {e!r}"
+        finally:
+            faultinject.clear()
+        if err:
+            print(f"check_elastic: {name}: FAIL — {err}")
+            failed += 1
+        else:
+            print(f"check_elastic: {name}: OK")
+    if failed:
+        return 1
+    print(f"check_elastic: OK ({len(SCENARIOS)} elastic paths)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,14 @@ field without the schema and the report CLI seeing it:
      family must be declared, both must be documented in
      docs/pipeline.md (next to the ``prefetch_depth``/``--prefetch``
      knobs), and the overhead/stall names must gate UPWARD in the
-     regress CLI so a host-path regression reads as a regression.
+     regress CLI so a host-path regression reads as a regression;
+  7. elastic contract — the ``elastic`` event type must carry the
+     reshard/scale/regate phases, its metric families
+     (``dlrm_elastic_reshard_total``, ``dlrm_serve_replicas``) must be
+     declared, docs/elastic.md must document the subsystem's entry
+     points next to them, and the regress anchor keys must keep the
+     ``:mesh=``/``:replicas=`` topology suffixes so an elastic run can
+     never gate against a different topology's baseline.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -299,6 +306,55 @@ def check_pipeline_contract(doc_path: str) -> list:
     return errs
 
 
+ELASTIC_PHASES = ("reshard", "scale", "regate")
+ELASTIC_FAMILIES = ("dlrm_elastic_reshard_total", "dlrm_serve_replicas")
+
+
+def check_elastic_contract(doc_path: str) -> list:
+    """The elastic-topology observability contract (docs/elastic.md):
+    the event phases, metric families, and topology-scoped regress
+    anchors the subsystem documents must actually exist."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+
+    errs = []
+    phases = SCHEMA.get("elastic", {}).get("phases") or {}
+    for ph in ELASTIC_PHASES:
+        if ph not in phases:
+            errs.append(f"elastic: phase {ph!r} missing from the "
+                        f"elastic event schema")
+    for name in ELASTIC_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"elastic: metric family {name!r} not declared "
+                        f"in telemetry.metrics.FAMILIES")
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented elastic "
+                    f"subsystem)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in ELASTIC_FAMILIES + (
+                "reshard_restore", "scale_to", "rebuild",
+                "preempt+reshape", "partition_rules"):
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/elastic.md does not document "
+                            f"`{needle}`")
+    # elastic runs gate per-topology: the regress anchor keys must keep
+    # the :mesh=/:replicas= suffixes, or a resharded run's headline
+    # would gate against a different topology's baseline
+    anchors = _history_metrics([
+        {"metric": "m", "value": 1.0, "fenced": True},
+        {"metric": "m", "value": 1.0, "fenced": True, "replicas": 4},
+        {"metric": "m", "value": 1.0, "fenced": True,
+         "mesh": "2x2"}])
+    for key in ("m", "m:replicas=4", "m:mesh=2x2"):
+        if key not in anchors:
+            errs.append(f"elastic: regress anchor key {key!r} missing — "
+                        f"topology-scoped gating broke "
+                        f"(telemetry/regress.py _history_metrics)")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -308,7 +364,9 @@ def main() -> int:
             + check_tuning_artifacts(os.path.join(REPO, "docs",
                                                   "tuning.md"))
             + check_pipeline_contract(os.path.join(REPO, "docs",
-                                                   "pipeline.md")))
+                                                   "pipeline.md"))
+            + check_elastic_contract(os.path.join(REPO, "docs",
+                                                  "elastic.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

@@ -1,0 +1,456 @@
+"""Elastic topology tests (dlrm_flexflow_tpu/elastic/, docs/elastic.md):
+reshard-on-restore across mesh shapes, the preempt+reshape fault spec,
+live replica scaling, and topology-scoped strategy re-gating."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.checkpoint import (CheckpointError,
+                                          restore_checkpoint,
+                                          save_checkpoint, saved_topology)
+from dlrm_flexflow_tpu.elastic import (ElasticController, gather_state,
+                                       regate_strategy, reshard_restore,
+                                       reshard_state)
+from dlrm_flexflow_tpu.parallel.mesh import (format_topology, mesh_topology,
+                                             same_topology)
+from dlrm_flexflow_tpu.parallel.parallel_config import Strategy
+from dlrm_flexflow_tpu.resilience import (CheckpointManager, Preemption,
+                                          Reshape, faultinject)
+from dlrm_flexflow_tpu.serving import InferenceEngine, ReplicaRouter
+from dlrm_flexflow_tpu.sim import tune
+from dlrm_flexflow_tpu.telemetry import event_log
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def make_model(mesh=False):
+    m = ff.FFModel(ff.FFConfig(batch_size=8, serve_buckets="1,2"))
+    x = m.create_tensor((8, 4), name="x")
+    m.dense(x, 8, activation="relu")
+    m.dense(m.layers[-1].outputs[0], 1)
+    m.compile(optimizer=ff.AdamOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=mesh)
+    return m
+
+
+def train_once(m, state, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = rng.standard_normal((8, 1)).astype(np.float32)
+    state, _ = m.train_step(state, {"x": x}, y)
+    return state
+
+
+# ------------------------------------------------------------ fault spec
+
+class TestPreemptReshapeSpec:
+    def test_parse_carries_mesh(self):
+        (f,) = faultinject.parse("preempt+reshape@step=5:mesh=2x1")
+        assert f.kind == "preempt+reshape" and f.value == 5
+        assert f.mesh == {"data": 2, "model": 1}
+        assert f.spec() == "preempt+reshape@step=5:mesh=2x1"
+
+    def test_parse_without_mesh(self):
+        (f,) = faultinject.parse("preempt+reshape@step=3")
+        assert f.mesh is None and f.spec() == "preempt+reshape@step=3"
+
+    def test_mesh_shorthand_and_errors(self):
+        assert faultinject.parse_mesh_shape("4") == {"data": 4,
+                                                     "model": 1}
+        with pytest.raises(ValueError, match="mesh shape"):
+            faultinject.parse_mesh_shape("2x0x1")
+        with pytest.raises(ValueError, match="preempt\\+reshape"):
+            faultinject.parse("preempt@step=5:mesh=2x1")
+        with pytest.raises(ValueError, match="step boundary"):
+            faultinject.parse("preempt+reshape@save")
+
+    def test_fires_as_reshape_with_mesh(self):
+        faultinject.install("preempt+reshape@step=7:mesh=2x2")
+        faultinject.maybe_preempt("step", step=6)  # not yet
+        with event_log() as log:
+            with pytest.raises(Reshape) as ei:
+                faultinject.maybe_preempt("step", step=7)
+        assert ei.value.mesh_shape == {"data": 2, "model": 2}
+        assert isinstance(ei.value, Preemption)  # a kill first of all
+        ev = log.last("fault")
+        assert ev["kind"] == "preempt+reshape" and ev["step"] == 7
+        faultinject.maybe_preempt("step", step=7)  # consumed
+
+
+# -------------------------------------------------------------- topology
+
+class TestTopology:
+    def test_mesh_topology_and_equivalence(self):
+        assert mesh_topology(None) == {}
+        mesh = ff.make_mesh({"data": 2, "model": 1})
+        assert mesh_topology(mesh) == {"data": 2, "model": 1}
+        # size-1 axes replicate: not a reshape
+        assert same_topology({"data": 1}, {})
+        assert same_topology({"data": 2, "model": 1}, {"data": 2})
+        assert not same_topology({"data": 2}, {"model": 2})
+
+    def test_format(self):
+        assert format_topology({}) == "single"
+        assert format_topology({"data": 1}) == "single"
+        assert format_topology({"model": 2, "data": 4}) == \
+            "data=4,model=2"
+
+
+# ------------------------------------------------- checkpoint topology guard
+
+class TestTopologyGuard:
+    def test_meta_records_topology(self, tmp_path):
+        m = make_model()
+        save_checkpoint(str(tmp_path / "c"), m.init(seed=0), model=m)
+        assert saved_topology(str(tmp_path / "c")) == {}
+        mesh = ff.make_mesh({"data": 2})
+        mm = make_model(mesh=mesh)
+        save_checkpoint(str(tmp_path / "cm"), mm.init(seed=0), model=mm)
+        assert saved_topology(str(tmp_path / "cm")) == {"data": 2}
+
+    def test_cross_topology_restore_refuses_and_names_both(self, tmp_path):
+        m = make_model()
+        st = train_once(m, m.init(seed=0))
+        p = save_checkpoint(str(tmp_path / "c"), st, model=m)
+        mm = make_model(mesh=ff.make_mesh({"data": 2}))
+        with pytest.raises(CheckpointError) as ei:
+            restore_checkpoint(p, model=mm)
+        msg = str(ei.value)
+        assert "[single]" in msg and "[data=2]" in msg
+        assert "reshard_restore" in msg
+
+    def test_on_mesh_change_reshard_crosses(self, tmp_path):
+        m = make_model()
+        st = train_once(m, m.init(seed=0))
+        p = save_checkpoint(str(tmp_path / "c"), st, model=m)
+        mm = make_model(mesh=ff.make_mesh({"data": 2}))
+        st2 = restore_checkpoint(p, model=mm, on_mesh_change="reshard")
+        for op, dd in st.params.items():
+            for k, v in dd.items():
+                assert np.array_equal(np.asarray(v),
+                                      np.asarray(st2.params[op][k]))
+
+    def test_legacy_checkpoint_without_topology_is_unguarded(self,
+                                                             tmp_path):
+        import json
+        m = make_model()
+        st = train_once(m, m.init(seed=0))
+        p = save_checkpoint(str(tmp_path / "c"), st, model=m)
+        meta_path = os.path.join(p, "meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        del meta["mesh"]  # a pre-elastic checkpoint
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        assert saved_topology(p) is None
+        mm = make_model(mesh=ff.make_mesh({"data": 2}))
+        restore_checkpoint(p, model=mm)  # unknown topology: no guard
+
+    def test_bad_on_mesh_change_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="on_mesh_change"):
+            restore_checkpoint(str(tmp_path), on_mesh_change="maybe")
+
+    def test_unknown_topology_reshard_still_gathers(self, tmp_path):
+        """A legacy checkpoint (no recorded topology) saved under a
+        mesh restored with on_mesh_change="reshard" must still gather —
+        'can't tell' is treated as changed, or the orbax path would
+        hand the meshless model leaves sharded under the dead mesh."""
+        import json
+        from jax.sharding import NamedSharding
+        mm = make_model(mesh=ff.make_mesh({"data": 2}))
+        p = save_checkpoint(str(tmp_path / "c"), mm.init(seed=0),
+                            model=mm)
+        meta_path = os.path.join(p, "meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        del meta["mesh"]  # a pre-elastic checkpoint
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        m = make_model()  # no mesh
+        st = restore_checkpoint(p, model=m, on_mesh_change="reshard")
+        for dd in st.params.values():
+            for v in dd.values():
+                shd = getattr(v, "sharding", None)
+                assert not (isinstance(shd, NamedSharding)
+                            and dict(shd.mesh.shape)), \
+                    "leaf still sharded under the dead mesh"
+
+
+# ------------------------------------------------------------- resharding
+
+class TestReshardState:
+    def test_gather_state_is_host_numpy(self):
+        m = make_model(mesh=ff.make_mesh({"data": 2}))
+        g = gather_state(m.init(seed=0))
+        for dd in g.params.values():
+            for v in dd.values():
+                assert isinstance(v, np.ndarray)
+
+    def test_reshard_state_preserves_values_and_places_slots(self):
+        m = make_model()
+        st = train_once(m, m.init(seed=0))
+        mesh = ff.make_mesh({"data": 2})
+        mm = make_model(mesh=mesh)
+        placed = reshard_state(st, mm)
+        from jax.sharding import NamedSharding
+        w = placed.params[mm.layers[0].name]["kernel"]
+        assert isinstance(w.sharding, NamedSharding)
+        assert w.sharding.mesh.shape == {"data": 2}
+        for slot in ("m", "v"):
+            for op, dd in st.opt_state[slot].items():
+                for k, v in dd.items():
+                    assert np.array_equal(
+                        np.asarray(v),
+                        np.asarray(placed.opt_state[slot][op][k]))
+
+    def test_reshard_restore_mesh_assertion(self, tmp_path):
+        m = make_model()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(train_once(m, m.init(seed=0)), model=m, step=1)
+        with pytest.raises(ValueError, match="compile the model"):
+            reshard_restore(mgr, m, mesh=ff.make_mesh({"data": 2}))
+
+    def test_reshard_restore_same_topology_is_plain(self, tmp_path):
+        m = make_model()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(train_once(m, m.init(seed=0)), model=m, step=1)
+        with event_log() as log:
+            _st, _extra, path = reshard_restore(mgr, m)
+        assert path.endswith("ckpt-1")
+        assert log.last("elastic") is None  # nothing was resharded
+
+    def test_reshard_restore_emits_event_and_counter(self, tmp_path):
+        from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+        m = make_model()
+        st = train_once(m, m.init(seed=0))
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(st, model=m, step=1)
+        mm = make_model(mesh=ff.make_mesh({"data": 2}))
+        before = tmetrics.ELASTIC_RESHARDS.value
+        with event_log() as log:
+            st2, _extra, _path = reshard_restore(mgr, mm)
+        ev = log.last("elastic")
+        assert ev["phase"] == "reshard"
+        assert ev["from_mesh"] == "single" and ev["to_mesh"] == "data=2"
+        assert ev["leaves"] > 0 and ev["step"] == 1
+        assert tmetrics.ELASTIC_RESHARDS.value == before + 1
+        for op, dd in st.params.items():
+            for k, v in dd.items():
+                assert np.array_equal(np.asarray(v),
+                                      np.asarray(st2.params[op][k]))
+
+
+# -------------------------------------------------------- router scaling
+
+class TestRouterScaling:
+    def _engine(self):
+        m = make_model()
+        return make_request_fn(), InferenceEngine(m, m.init(seed=0))
+
+    def test_scale_up_and_down_counts_and_labels(self):
+        _req, engine = self._engine()
+        with event_log() as log:
+            r = ReplicaRouter([engine], name="ts", max_batch_size=1)
+            assert len(r) == 1 and r.replica_labels() == ["ts0"]
+            out = r.scale_to(3)
+            assert out == {"replicas_from": 1, "replicas_to": 3,
+                           "drained": 0}
+            assert r.replica_labels() == ["ts0", "ts1", "ts2"]
+            r.scale_to(1)
+            # labels are never reused: a later grow mints fresh ones
+            r.scale_to(2)
+            assert r.replica_labels() == ["ts0", "ts3"]
+            r.close()
+        evs = [(e["replicas_from"], e["replicas_to"])
+               for e in log.events("elastic") if e.get("phase") == "scale"]
+        assert evs == [(1, 3), (3, 1), (1, 2)]
+
+    def test_scale_down_folds_served_requests_into_summary(self):
+        req, engine = self._engine()
+        r = ReplicaRouter([engine], name="tf", max_batch_size=1,
+                          max_wait_us=100)
+        futs = [r.submit(req()) for _ in range(4)]
+        for f in futs:
+            f.result(30.0)
+        r.scale_to(3)
+        futs += [r.submit(req()) for _ in range(2)]
+        for f in futs[-2:]:
+            f.result(30.0)
+        r.scale_to(1)  # retires 2 replicas; their counts must survive
+        summary = r.close()
+        assert summary["requests"] == 6
+        assert summary["replicas"] == 1  # at close time
+        assert len(summary["per_replica"]) == 3  # 2 folded + 1 live
+
+    def test_scale_validation_and_closed_router(self):
+        _req, engine = self._engine()
+        r = ReplicaRouter([engine], max_batch_size=1)
+        with pytest.raises(ValueError, match="n >= 1"):
+            r.scale_to(0)
+        r.close()
+        with pytest.raises(RuntimeError, match="shut down"):
+            r.scale_to(2)
+        with pytest.raises(RuntimeError, match="shut down"):
+            r.rebuild([engine])
+
+    def test_rebuild_swaps_all_replicas(self):
+        req, engine = self._engine()
+        m2 = make_model()
+        engine2 = InferenceEngine(m2, m2.init(seed=0))
+        r = ReplicaRouter([engine, engine], name="tr", max_batch_size=1)
+        out = r.rebuild([engine2])
+        assert out["replicas_from"] == 2 and out["replicas_to"] == 1
+        assert len(r) == 1
+        assert r.batchers[0].engine is engine2
+        r.predict(req(), result_timeout_s=30.0)
+        r.close()
+
+
+def make_request_fn():
+    rng = np.random.default_rng(0)
+
+    def req():
+        return {"x": rng.standard_normal((1, 4)).astype(np.float32)}
+
+    return req
+
+
+# ---------------------------------------------------------------- regate
+
+def _artifact(art_dir, num_devices, sim_step_s=0.001):
+    _p, doc = tune.save_strategy_artifact(
+        art_dir, Strategy(), app="dlrm", num_devices=num_devices,
+        sim_step_s=sim_step_s, seed=0, budget=1)
+    return doc
+
+
+class TestRegate:
+    def test_none_then_incumbent(self, tmp_path):
+        art = str(tmp_path)
+        with event_log() as log:
+            winner, verdict = regate_strategy(art, "dlrm", 4)
+            assert winner is None and verdict == "none"
+            doc = _artifact(art, 4)
+            tune.promote(art, doc)
+            winner, verdict = regate_strategy(art, "dlrm", 4)
+            assert verdict == "incumbent"
+            assert winner["version"] == doc["version"]
+        evs = [e for e in log.events("elastic")
+               if e.get("phase") == "regate"]
+        assert [e["verdict"] for e in evs] == ["none", "incumbent"]
+        assert evs[-1]["num_devices"] == 4
+        assert evs[-1]["version"] == doc["version"]
+
+    def test_candidate_first_then_rejected(self, tmp_path):
+        art = str(tmp_path)
+        fast = _artifact(art, 2, sim_step_s=0.001)
+        winner, verdict = regate_strategy(
+            art, "dlrm", 2, candidate=fast,
+            bench_fn=lambda d: d["sim_step_s"])
+        assert verdict == "first" and winner is fast
+        assert tune.load_incumbent(art, "dlrm", 2) is not None
+        slow = _artifact(art, 2, sim_step_s=0.9)
+        winner, verdict = regate_strategy(
+            art, "dlrm", 2, candidate=slow,
+            bench_fn=lambda d: d["sim_step_s"])
+        assert verdict == "rejected"
+        assert winner["version"] == fast["version"]  # incumbent stays
+
+    def test_candidate_topology_mismatch_refused(self, tmp_path):
+        art = str(tmp_path)
+        cand = _artifact(art, 8)
+        with pytest.raises(ValueError, match="FOR the new topology"):
+            regate_strategy(art, "dlrm", 2, candidate=cand,
+                            bench_fn=lambda d: 1.0)
+        with pytest.raises(ValueError, match="bench_fn"):
+            regate_strategy(art, "dlrm", 8, candidate=cand)
+
+    def test_controller_tracks_strategy_across_scales(self, tmp_path):
+        art = str(tmp_path)
+        doc1 = _artifact(art, 1)
+        tune.promote(art, doc1)
+        m = make_model()
+        engine = InferenceEngine(m, m.init(seed=0))
+        router = ReplicaRouter([engine], max_batch_size=1)
+        ctl = ElasticController(router, artifacts_dir=art, app="dlrm")
+        assert ctl.strategy["version"] == doc1["version"]
+        out = ctl.scale_to(2)
+        assert out["strategy"] is None  # nothing promoted for 2 yet
+        assert ctl.verdicts == ["incumbent", "none"]
+        ctl.close()
+
+
+# -------------------------------------------------- regress anchor keys
+
+class TestTopologyScopedAnchors:
+    def test_mesh_and_replicas_suffix_anchor_separately(self):
+        from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+        out = _history_metrics([
+            {"metric": "dlrm_serving_qps", "value": 100.0,
+             "fenced": True},
+            {"metric": "dlrm_serving_qps", "value": 350.0,
+             "fenced": True, "replicas": 4},
+            {"metric": "dlrm_serving_qps", "value": 90.0,
+             "fenced": True, "mesh": "2x2"},
+        ])
+        assert out["dlrm_serving_qps"] == 100.0
+        assert out["dlrm_serving_qps:replicas=4"] == 350.0
+        assert out["dlrm_serving_qps:mesh=2x2"] == 90.0
+
+
+# ------------------------------------------------------- schema + tooling
+
+class TestElasticTelemetry:
+    def test_event_phases_validate(self):
+        from dlrm_flexflow_tpu.telemetry.schema import validate_event
+        base = {"type": "elastic", "ts": 1.0}
+        assert validate_event({**base, "phase": "reshard",
+                               "from_mesh": "single",
+                               "to_mesh": "data=2"}) == []
+        assert validate_event({**base, "phase": "scale",
+                               "replicas_from": 1,
+                               "replicas_to": 4}) == []
+        assert validate_event({**base, "phase": "regate",
+                               "verdict": "none"}) == []
+        assert validate_event({**base, "phase": "reshard"})  # missing
+
+    def test_families_declared(self):
+        from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+        assert "dlrm_elastic_reshard_total" in tmetrics.FAMILIES
+        assert "dlrm_serve_replicas" in tmetrics.FAMILIES
+
+    def test_replicas_gauge_tracks_router(self):
+        from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+        m = make_model()
+        engine = InferenceEngine(m, m.init(seed=0))
+        r = ReplicaRouter([engine], name="tg", max_batch_size=1)
+        try:
+            assert "dlrm_serve_replicas 1" in tmetrics.REGISTRY.render()
+            r.scale_to(3)
+            assert "dlrm_serve_replicas 3" in tmetrics.REGISTRY.render()
+        finally:
+            r.close()
+
+
+class TestElasticTooling:
+    def test_smoke_matrix_passes(self):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_elastic.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "FF_FAULTS": ""})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK (4 elastic paths)" in r.stdout
