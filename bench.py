@@ -67,6 +67,8 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
                 hv = h.get(k)
                 if k == "app" and hv is None:
                     hv = "dlrm"  # records written before the app field
+                if k == "overlap" and hv is None:
+                    hv = "off"  # records written before exchange overlap
                 if k == "emb_dtype" and hv is None:
                     hv = "float32"  # records written before emb_dtype
                 if k == "act_dtype" and hv is None:
@@ -432,7 +434,35 @@ def main():
     # by tests/test_pipeline.py).
     prefetch = int(os.environ.get("BENCH_PREFETCH", "0") or 0)
     ffconfig.prefetch_depth = prefetch
-    model = build_dlrm(cfg, ffconfig)
+    # BENCH_OVERLAP={off,auto,on}: build bottom-MLP + stacked embedding
+    # as ONE OverlappedEmbedBottom op so the manual table exchange
+    # (BENCH_EXCHANGE={allgather,all_to_all}) pipelines each
+    # microbatch's ICI collective under its dense slice
+    # (parallel/overlap.py, docs/pipeline.md).  Overlap REORDERS
+    # collective reductions, so unlike BENCH_FUSED it IS part of the
+    # anchor key (the regress CLI suffixes ":overlap=" the same way);
+    # BENCH_OVERLAP_K is the pipeline depth (provenance), BENCH_MESH
+    # ("data=2,model=2") the mesh the run shards over (the mesh string
+    # rides the anchor key like serving entries).
+    overlap = (os.environ.get("BENCH_OVERLAP", "off")
+               .strip().lower() or "off")
+    overlap_k = int(os.environ.get("BENCH_OVERLAP_K", "2") or 2)
+    exchange = (os.environ.get("BENCH_EXCHANGE", "off")
+                .strip().lower() or "off")
+    cfg.exchange_overlap = overlap
+    cfg.exchange_microbatches = overlap_k
+    ffconfig.table_exchange = exchange
+    mesh_env = os.environ.get("BENCH_MESH", "").strip()
+    if mesh_env:
+        ffconfig.mesh_shape = {
+            a: int(s) for a, s in
+            (kv.split("=") for kv in mesh_env.split(","))}
+    # table_parallel follows the EXCHANGE knob alone: BENCH_OVERLAP
+    # without an exchange is a documented no-op for the graph shape
+    # ("auto" engages only with a manual exchange), and silently
+    # flipping the classic graph's sharding would confound the
+    # serial-vs-overlap A/B the ":overlap=" anchors exist to keep clean
+    model = build_dlrm(cfg, ffconfig, table_parallel=exchange != "off")
     # BENCH_STRATEGY=<strategy artifact>: run the headline under a
     # search-tune winner (sim/tune.py, docs/tuning.md).  The artifact is
     # schema-checked before it can steer a measurement; its version is
@@ -492,11 +522,20 @@ def main():
     # advisor r1); compute "dtype" is not: bf16 MXU matmuls with f32
     # accumulation and f32 master weights track the fp32 loss trajectory
     # (pinned by test) and are credited as a framework optimization.
+    # the mesh shape rides the anchor key whenever one is active: a
+    # sharded training run and the single-device headline must never
+    # share an anchor (the serving entries' "mesh" convention)
+    mesh_str = ("" if model.mesh is None else
+                ",".join(f"{a}={s}" for a, s in
+                         zip(model.mesh.axis_names,
+                             model.mesh.devices.shape)))
     _emit("dlrm_synthetic_samples_per_sec", thpt,
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
-           "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype},
+           "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype,
+           "overlap": overlap, "mesh": mesh_str},
           extra={"dtype": dtype, "fused": cfg.fused_interaction,
-                 "prefetch": prefetch,
+                 "prefetch": prefetch, "exchange": exchange,
+                 "overlap_k": overlap_k,
                  "probe_us": round(probe_us, 1), **prov,
                  **({"strategy_version": strategy_version}
                     if strategy_version is not None else {}),

@@ -45,6 +45,25 @@ class DLRMConfig:
     # "on" forces the fused graph everywhere (the emitter path runs
     # off-TPU, bit-exact); "off" (default) keeps the classic graph.
     fused_interaction: str = "off"
+    # --exchange-overlap {off,auto,on}: build the bottom MLP + stacked
+    # embedding as ONE OverlappedEmbedBottom op (ops/overlap_embed.py)
+    # so the manual table-parallel exchange (FFConfig.table_exchange)
+    # runs as a microbatched pipeline overlapping each microbatch's
+    # ICI collective with its bottom-MLP dense slice
+    # (parallel/overlap.py).  "auto" builds the overlapped graph when a
+    # manual exchange is configured and lets the per-trace cost gate
+    # (ops/kernel_costs.exchange_overlap_wins) pick pipeline vs serial;
+    # "on" forces the overlapped graph (and the pipeline wherever it
+    # can run); "off" (default) keeps the classic separate-ops graph.
+    # Numerics: overlap reorders collective reductions — tolerance-
+    # pinned vs the serial exchange, so bench anchors carry
+    # ":overlap=" (tests/test_overlap.py, telemetry/regress.py).
+    exchange_overlap: str = "off"
+    # --exchange-microbatches N: the pipeline depth K (>= 2 to overlap;
+    # the per-data-shard batch must divide K — and mp*K for the
+    # all_to_all exchange form — or the op falls back to the serial
+    # exchange for that traced shape).
+    exchange_microbatches: int = 2
     loss_threshold: float = 0.0            # --loss-threshold
     sigmoid_bot: int = -1                  # -1 = no sigmoid in bottom MLP
     sigmoid_top: int = -1                  # -1 = sigmoid on the last top layer
@@ -76,6 +95,10 @@ class DLRMConfig:
                 c.arch_interaction_op = nxt()
             elif a == "--fused-interaction":
                 c.fused_interaction = nxt()
+            elif a == "--exchange-overlap":
+                c.exchange_overlap = nxt()
+            elif a == "--exchange-microbatches":
+                c.exchange_microbatches = int(nxt())
             elif a == "--loss-threshold":
                 c.loss_threshold = float(nxt())
             elif a == "--dataset":
@@ -179,7 +202,6 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
     d = cfg.sparse_feature_size
 
     dense_in = model.create_tensor((b, cfg.mlp_bot[0]), "float32", name="dense")
-    bottom = _create_mlp(model, dense_in, cfg.mlp_bot, cfg.sigmoid_bot, "bot")
 
     fmode = getattr(cfg, "fused_interaction", "off")
     if fmode not in ("off", "auto", "on"):
@@ -190,6 +212,52 @@ def build_dlrm(cfg: DLRMConfig, ffconfig: Optional[FFConfig] = None,
             "fused_interaction='on' needs the stacked input convention "
             "(one (B, T, bag) ids tensor); per-table inputs "
             "(stacked_embeddings=False) cannot feed the fused op")
+    omode = getattr(cfg, "exchange_overlap", "off")
+    if omode not in ("off", "auto", "on"):
+        raise ValueError(
+            f"exchange_overlap must be 'off'|'auto'|'on', got {omode!r}")
+    if omode == "on" and (not stacked_embeddings or not uniform):
+        raise ValueError(
+            "exchange_overlap='on' needs uniform stacked tables (the "
+            "manual table exchange pins whole same-shape tables per "
+            "model rank, parallel/table_exchange.py)")
+    if omode == "on" and fmode == "on":
+        raise ValueError(
+            "fused_interaction='on' and exchange_overlap='on' both "
+            "replace the embedding chain — pick one graph shape")
+    # the overlapped graph replaces bottom-MLP + stacked embedding with
+    # ONE op; "auto" engages it only when a manual exchange is actually
+    # configured (FFConfig.table_exchange) — without one the op would
+    # run its serial fallback for no graph-shape benefit
+    xmode = getattr(ffconfig, "table_exchange", "off")
+    use_overlap = stacked_embeddings and uniform and (
+        omode == "on" or (omode == "auto" and xmode != "off"))
+    if use_overlap:
+        t0 = cfg.embedding_size[0]
+        ids = model.create_tensor((b, t, cfg.embedding_bag_size), "int64",
+                                  name="sparse")
+        emb, bottom = model.overlapped_embed_bottom(
+            ids, dense_in, t, t0, d, cfg.mlp_bot,
+            sigmoid_bot=cfg.sigmoid_bot, aggr="sum", overlap=omode,
+            microbatches=getattr(cfg, "exchange_microbatches", 2),
+            name="emb_bot")
+        if table_parallel:
+            # shard the table axis of the (T, R, d) weight over "model"
+            # (the bottom-MLP weights stay replicated — the op's specs
+            # declare them sharded_dim=None)
+            model.get_op("emb_bot").parallel_config = ParallelConfig(
+                dims=(1, t, 1))
+        flat = model.reshape(emb, (b, t * d), name="emb_flat")
+        z = _interact_features(model, bottom, [flat], cfg)
+        assert z.shape[1] == cfg.mlp_top[0], (
+            f"interaction width {z.shape[1]} != mlp_top[0] {cfg.mlp_top[0]}")
+        sig = cfg.sigmoid_top if cfg.sigmoid_top >= 0 else len(cfg.mlp_top) - 2
+        _create_mlp(model, z, cfg.mlp_top, sig, "top")
+        model._dlrm_stacked = True
+        return model
+
+    bottom = _create_mlp(model, dense_in, cfg.mlp_bot, cfg.sigmoid_bot, "bot")
+
     use_fused = stacked_embeddings and not table_parallel and (
         fmode == "on" or (fmode == "auto" and _on_single_tpu()))
     if use_fused:

@@ -46,8 +46,9 @@ from .optim import Optimizer, SGDOptimizer
 from .ops import (BatchMatmul, BatchNorm, Concat, Conv2D, Dropout,
                   ElementBinary, ElementUnary, Embedding, Flat,
                   FusedEmbedInteract, Linear, MultiHeadAttention, Op,
-                  Pool2D, RaggedStackedEmbedding, Reshape, Reverse,
-                  Softmax, Split, StackedEmbedding, Transpose)
+                  OverlappedEmbedBottom, Pool2D, RaggedStackedEmbedding,
+                  Reshape, Reverse, Softmax, Split, StackedEmbedding,
+                  Transpose)
 from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
                             param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
@@ -191,6 +192,27 @@ class FFModel:
             self._name("fused_embed_interact", name), ids_tensor,
             bottom_tensor, row_counts, out_dim, interact, aggr,
             kernel_initializer, table_dtype=self._table_dtype(table_dtype),
+            compute_dtype=self._op_compute_dtype())
+        return self._add(op)
+
+    def overlapped_embed_bottom(self, ids_tensor, dense_tensor, num_tables,
+                                num_entries, out_dim, mlp_bot,
+                                sigmoid_bot=-1, aggr="sum", overlap="auto",
+                                microbatches=2, kernel_initializer=None,
+                                name=None, table_dtype=None):
+        """Stacked embedding + bottom-MLP dense stack as ONE node
+        (ops/overlap_embed.py): under a manual table exchange
+        (FFConfig.table_exchange + a model mesh axis) the forward runs
+        the microbatched lag-1 pipeline of parallel/overlap.py —
+        microbatch i's exchange collective rides ICI while microbatch
+        i's dense slice runs on the MXU — so the exchange cost hides
+        behind compute instead of serializing before the interaction.
+        Returns ``(emb, bottom)`` tensors."""
+        op = OverlappedEmbedBottom(
+            self._name("overlapped_embed_bottom", name), ids_tensor,
+            dense_tensor, num_tables, num_entries, out_dim, mlp_bot,
+            sigmoid_bot, aggr, overlap, microbatches, kernel_initializer,
+            table_dtype=self._table_dtype(table_dtype),
             compute_dtype=self._op_compute_dtype())
         return self._add(op)
 
@@ -769,12 +791,17 @@ class FFModel:
             """THE per-op eligibility both packed storage and the
             sparse-update loop share: a device-resident embedding op on
             the standard lookup path (not hetero-CPU, not the pallas-bag
-            forward, not the manual shard_map exchange)."""
+            forward, not the manual shard_map exchange, and not an op
+            whose params carry more than the table — the sparse loop's
+            rows__ injection rebuilds the op's params dict with the
+            table alone, which would drop e.g. OverlappedEmbedBottom's
+            bottom-MLP weights)."""
             return (isinstance(op, (Embedding, StackedEmbedding,
                                     RaggedStackedEmbedding))
                     and getattr(op, "placement", "tpu") != "cpu"
                     and not getattr(op, "use_pallas", False)
-                    and not getattr(op, "exchange_mode", None))
+                    and not getattr(op, "exchange_mode", None)
+                    and getattr(op, "sparse_path_ok", True))
 
         for op in self.layers:
             if isinstance(op, (Embedding, StackedEmbedding,

@@ -5,6 +5,7 @@ from .linear import Linear
 from .embedding import (Embedding, RaggedStackedEmbedding,
                         StackedEmbedding)
 from .fused_interact import FusedEmbedInteract
+from .overlap_embed import OverlappedEmbedBottom
 from .elementwise import ElementBinary, ElementUnary
 from .shape_ops import (BatchMatmul, Concat, Flat, Reshape, Reverse, Split,
                         Transpose)
@@ -17,7 +18,7 @@ from .moe import MixtureOfExperts
 __all__ = [
     "Op", "activation_fn", "matmul",
     "Linear", "Embedding", "StackedEmbedding", "RaggedStackedEmbedding",
-    "FusedEmbedInteract",
+    "FusedEmbedInteract", "OverlappedEmbedBottom",
     "ElementBinary", "ElementUnary",
     "BatchMatmul", "Concat", "Flat", "Reshape", "Reverse", "Split", "Transpose",
     "BatchNorm", "Conv2D", "Pool2D",

@@ -64,6 +64,16 @@ OP_BOUNDARY_NS = 2000.0
 #: a kernel must beat the emitter by this factor before dispatch flips.
 DISPATCH_MARGIN = 2.0
 
+#: ICI link bandwidth per direction (GB/s) — the v5e constant the
+#: machine model prices collectives with (sim/cost_model.py
+#: TPUMachineModel.ici_bandwidth = 45e9); kernel_costs sits BELOW sim
+#: in the layering DAG, so the number is mirrored here with its source.
+ICI_GBPS = 45.0
+
+#: effective MXU throughput for the dense-stack estimate (FLOP/ns):
+#: f32 peak 49 TFLOP/s at the machine model's 60% utilisation.
+MXU_F32_FLOPS_PER_NS = 49e3 * 0.6
+
 
 def row_set_wins(parent_rows: int, dim: int, n: int,
                  itemsize: int) -> bool:
@@ -127,3 +137,42 @@ def fused_interact_wins(batch: int, num_tables: int, bag: int, dim: int,
                   + inter_bytes / HBM_GBPS
                   + boundaries * OP_BOUNDARY_NS)
     return kernel_ns < emitter_ns
+
+
+def exchange_overlap_wins(local_batch: int, num_tables: int, dim: int,
+                          itemsize: int, model_parallel: int,
+                          dense_flops: int, microbatches: int,
+                          mode: str = "allgather") -> bool:
+    """Static dispatch gate for the microbatched exchange/compute
+    pipeline (parallel/overlap.py) vs the serial manual exchange.
+
+    The pipeline hides ``min(exchange, dense)`` of the step behind the
+    other rail (per microbatch the step pays ``max`` instead of the
+    sum), but splitting into K microbatches costs K-1 extra collective
+    launches and K-1 extra dense fusion roots — each ~``OP_BOUNDARY_NS``
+    like every other fusion boundary this module prices.  Overlap wins
+    when the hidden time beats that added boundary cost by the shared
+    2x ``DISPATCH_MARGIN``, so a call near the crossover keeps the
+    battle-tested serial exchange.
+
+    ``local_batch`` is the per-data-shard batch (the rows one exchange
+    actually moves); ``dense_flops`` the bottom stack's forward FLOPs
+    at that batch.  Regimes this selects (pinned in
+    tests/test_overlap.py / scripts/check_overlap.py): the
+    run_random.sh shape at per-shard batch ~512 and up — exchange
+    ~17us and dense ~11us per step, both big enough that hiding one
+    clears the margin — overlap wins; per-shard batch 64 (a probe
+    shape, dense ~1.4us) keeps the serial exchange, as do K=1 and a
+    single model rank."""
+    mp = max(int(model_parallel), 1)
+    k = max(int(microbatches), 1)
+    if mp <= 1 or k <= 1:
+        return False
+    ex_bytes = float(local_batch) * num_tables * dim * itemsize
+    if mode == "all_to_all":
+        ex_bytes /= mp  # each rank exchanges ~1/mp of allgather's bytes
+    ex_ns = ex_bytes * (mp - 1) / mp / ICI_GBPS
+    dense_ns = float(dense_flops) / MXU_F32_FLOPS_PER_NS
+    hidden_ns = min(ex_ns, dense_ns)
+    boundary_ns = 2.0 * (k - 1) * OP_BOUNDARY_NS
+    return hidden_ns > DISPATCH_MARGIN * boundary_ns

@@ -278,16 +278,193 @@ def kernel_eligible(table_dtype, dim: int, bag: int) -> bool:
             and dim % 8 == 0)
 
 
+def interact_backward(g, bottom, pooled, interact: str):
+    """Manual VJP of ``interact_features`` at f32, mirroring XLA
+    autodiff primitive-for-primitive (concat VJP = slice; batched
+    ``z @ z^T`` VJP = ``G @ z + (z^T G)^T`` as two matmuls + add) so
+    the kernel backward is BIT-EXACT against ``jax.vjp`` of the
+    emitter formulation — pinned in interpret mode by
+    tests/test_kernels.py.  Returns ``(dbottom, dpooled)``.
+
+    ``pooled`` may be None for ``cat`` (its dpooled is a pure slice of
+    ``g`` — the backward never touches the table rows)."""
+    if interact == "cat":
+        bot_dim = bottom.shape[1]
+        return g[:, :bot_dim], g[:, bot_dim:]  # dpooled (B, T*d) flat
+    if interact != "dot":
+        raise ValueError(f"unknown interaction op {interact!r}")
+    b = g.shape[0]
+    dim = bottom.shape[1]
+    t = pooled.shape[1]
+    f = t + 1
+    G = g[:, dim:].reshape(b, f, f)
+    z = jnp.concatenate([bottom[:, None, :], pooled], axis=1)  # (B,F,d)
+    # zz = matmul(z, z^T): dz = G @ (z^T)^T  +  ((z)^T @ G)^T — the two
+    # dot_general transposes autodiff emits, accumulated with one add
+    dz = (jnp.matmul(G, z, preferred_element_type=jnp.float32)
+          + jnp.swapaxes(
+              jnp.matmul(jnp.swapaxes(z, -1, -2), G,
+                         preferred_element_type=jnp.float32), -1, -2))
+    dbottom = g[:, :dim] + dz[:, 0]
+    return dbottom, dz[:, 1:]
+
+
+def _fused_bwd_kernel(ids_ref, table_hbm, bottom_ref, g_ref, dbot_ref,
+                      rowg_ref, scratch, sems, *, num_tables: int,
+                      bag: int, dim: int, bot_dim: int, interact: str,
+                      aggr: str, block_b: int, num_rows: int):
+    """Backward twin of ``_fused_kernel``: one grid step = ``block_b``
+    samples.  For ``dot`` the live rows stream HBM->VMEM exactly the
+    way the forward does (per-row async DMAs, start-all-then-wait) to
+    re-pool the residual-free pooled vectors; the interact backward
+    then runs in-register (``interact_backward``'s formulation) and
+    the per-slot row grads are written out as one contiguous block —
+    dropped slots emit exact 0.0 so the caller's scatter-add leaves
+    their clip-addressed rows untouched (the emitter-VJP semantics)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    nslots = num_tables * bag
+
+    def row_id(i, s):
+        return ids_ref[blk * block_b + i, s]
+
+    def dma(i, s):
+        slot = i * nslots + s
+        return pltpu.make_async_copy(
+            table_hbm.at[pl.ds(row_id(i, s), 1)],
+            scratch.at[pl.ds(slot, 1)], sems.at[slot])
+
+    def live(i, s):
+        return (row_id(i, s) >= 0) & (row_id(i, s) < num_rows)
+
+    bottom_blk = bottom_ref[:, :].astype(jnp.float32)
+    g_blk = g_ref[:, :].astype(jnp.float32)
+
+    if interact == "dot":
+        # re-stream the rows to rebuild pooled (no residual bounced
+        # through HBM) — the forward's DMA pattern verbatim
+        for i in range(block_b):
+            for s in range(nslots):
+                @pl.when(live(i, s))
+                def _():
+                    dma(i, s).start()
+
+                @pl.when(jnp.logical_not(live(i, s)))
+                def _():
+                    scratch[pl.ds(i * nslots + s, 1), :] = jnp.zeros(
+                        (1, dim), scratch.dtype)
+        for i in range(block_b):
+            for s in range(nslots):
+                @pl.when(live(i, s))
+                def _():
+                    dma(i, s).wait()
+        pooled = []
+        for i in range(block_b):
+            bags = scratch[pl.ds(i * nslots, nslots), :]
+            bags = bags.reshape(num_tables, bag, dim)
+            pt = jnp.sum(bags, axis=1)
+            if aggr == "avg":
+                pt = pt / bag
+            pooled.append(pt.astype(jnp.float32))
+        pooled_blk = jnp.stack(pooled)                # (block_b, T, d)
+        dbot, dpooled = interact_backward(g_blk, bottom_blk, pooled_blk,
+                                          "dot")
+    else:
+        dbot, dpooled = interact_backward(g_blk, bottom_blk, None, "cat")
+        dpooled = dpooled.reshape(block_b, num_tables, dim)
+
+    if aggr == "avg":
+        dpooled = dpooled / bag
+    # expand pooled grads to per-slot row grads (sum VJP = broadcast),
+    # zeroing dropped slots like the emitter's where-mask VJP
+    rows = jnp.repeat(dpooled.reshape(block_b * num_tables, dim), bag,
+                      axis=0)                         # (blk*T*bag, d)
+    mask = []
+    for i in range(block_b):
+        for s in range(nslots):
+            mask.append(live(i, s))
+    rows = jnp.where(jnp.stack(mask)[:, None], rows,
+                     jnp.zeros((), rows.dtype))
+    dbot_ref[:, :] = dbot.astype(dbot_ref.dtype)
+    rowg_ref[:, :] = rows.astype(rowg_ref.dtype)
+
+
+def fused_interact_bwd_pallas(table, gids, bottom, g, *,
+                              interact: str = "cat", aggr: str = "sum",
+                              interpret: bool = False):
+    """Run the backward kernel.  Inputs mirror the forward
+    (``gids`` pre-masked, invalid = -1); ``g`` is the interaction
+    output cotangent (B, width).  Returns ``(row_grads, dbottom)``
+    with ``row_grads`` (B, T, bag, d) — exact 0.0 at dropped slots —
+    for the caller's table scatter-add, and ``dbottom`` (B, bot_dim).
+    f32 only (bf16-compute programs keep the emitter VJP)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bsz, t, bag = gids.shape
+    rows_n, dim = table.shape
+    bot_dim = bottom.shape[1]
+    assert bag > 0, "empty bags run the reference path (nothing to DMA)"
+    width = interact_width(interact, t, dim, bot_dim)
+    assert g.shape == (bsz, width), (g.shape, (bsz, width))
+    block_b = _BLOCK_B
+    pad = (-bsz) % block_b
+    if pad:
+        gids = jnp.concatenate(
+            [gids, jnp.full((pad, t, bag), -1, gids.dtype)])
+        bottom = jnp.concatenate(
+            [bottom, jnp.zeros((pad, bot_dim), bottom.dtype)])
+        g = jnp.concatenate([g, jnp.zeros((pad, width), g.dtype)])
+    bp = bsz + pad
+    nslots = t * bag
+    ids2 = gids.reshape(bp, nslots).astype(jnp.int32)
+    kern = functools.partial(
+        _fused_bwd_kernel, num_tables=t, bag=bag, dim=dim,
+        bot_dim=bot_dim, interact=interact, aggr=aggr, block_b=block_b,
+        num_rows=rows_n)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # ids
+        grid=(bp // block_b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # table stays in HBM
+            pl.BlockSpec((block_b, bot_dim), lambda b, ids: (b, 0)),
+            pl.BlockSpec((block_b, width), lambda b, ids: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, bot_dim), lambda b, ids: (b, 0)),
+            pl.BlockSpec((block_b * nslots, dim), lambda b, ids: (b, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_b * nslots, dim), table.dtype),
+            pltpu.SemaphoreType.DMA((block_b * nslots,)),
+        ],
+    )
+    dbot, rowg = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((bp, bot_dim), jnp.float32),
+                   jax.ShapeDtypeStruct((bp * nslots, dim), jnp.float32)],
+        interpret=interpret,
+    )(ids2, table, bottom, g)
+    return (rowg[:bsz * nslots].reshape(bsz, t, bag, dim),
+            dbot[:bsz].astype(bottom.dtype))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def fused_embed_interact(table, gids, bottom, interact: str = "cat",
                          aggr: str = "sum", use_kernel: bool = False,
                          interpret: bool = False, compute_dtype=None):
     """Differentiable fused gather->pool->interact with the kernel/
     emitter dispatch already decided by the caller (the op consults
-    ``kernel_costs.fused_interact_wins``).  Backward re-derives through
-    the reference formulation — identical to autodiff of the unfused
-    graph (the training fast path instead injects pre-gathered rows
-    and never reaches this custom_vjp)."""
+    ``kernel_costs.fused_interact_wins``).  Backward: the fused
+    backward kernel when the forward ran the kernel at f32 (row grads
+    built in VMEM, no re-gather through the emitter's dense chain —
+    bit-exact vs the emitter VJP, pinned in interpret mode);
+    otherwise re-derives through the reference formulation — identical
+    to autodiff of the unfused graph (the training fast path instead
+    injects pre-gathered rows and never reaches this custom_vjp)."""
     if use_kernel:
         return fused_interact_pallas(table, gids, bottom,
                                      interact=interact, aggr=aggr,
@@ -306,6 +483,18 @@ def _fwd(table, gids, bottom, interact, aggr, use_kernel, interpret,
 
 def _bwd(interact, aggr, use_kernel, interpret, compute_dtype, res, g):
     table, gids, bottom = res
+    if use_kernel and compute_dtype is None:
+        # the fused backward kernel (f32 only — the bf16 dot cast's
+        # autodiff chain stays on the emitter VJP): per-slot row grads
+        # stream out of VMEM, then ONE scatter-add touches exactly the
+        # looked-up rows.  Same updates at the same indices as the
+        # emitter VJP's take-transpose, so dtable is bit-identical.
+        rowg, db = fused_interact_bwd_pallas(
+            table, gids, bottom, g, interact=interact, aggr=aggr,
+            interpret=interpret)
+        safe = jnp.maximum(gids, 0).astype(jnp.int32)
+        dt = jnp.zeros_like(table).at[safe].add(rowg)
+        return dt, None, db
     _, vjp = jax.vjp(
         lambda t, b: fused_interact_ref(t, gids, b, interact=interact,
                                         aggr=aggr,

@@ -75,8 +75,14 @@ def quantize_embedding_params(layers, params: Dict[str, dict],
                               mode: str) -> Tuple[Dict[str, dict], dict]:
     """Quantize every eligible embedding table in a (copied) params
     tree.  ``layers`` is the model's op list; an op is eligible when it
-    carries an ``"embedding"`` param, is device-resident, and is not a
-    manual-exchange op (its shard_map body reads raw f32 tables).
+    carries an ``"embedding"`` param and is device-resident.
+    Manual-exchange ops (``table_exchange``) are eligible too: their
+    shard_map body dequantizes the GATHERED int8 rows in place
+    (``parallel/table_exchange.py``, the ``qscale`` operand), so f32
+    rows ride the collective while the swept table stays 4x smaller —
+    except under packed storage, where the exchange body's (T, R, d)
+    addressing does not exist; that combination refuses loudly instead
+    of serving wrong bytes.
 
     Returns ``(new_params, report)`` where ``report`` records the mode
     and per-table byte savings (printed by the engine at load)."""
@@ -92,12 +98,19 @@ def quantize_embedding_params(layers, params: Dict[str, dict],
     for op in layers:
         p = params.get(op.name)
         if (not isinstance(p, dict) or "embedding" not in p
-                or getattr(op, "placement", "tpu") == "cpu"
-                or getattr(op, "exchange_mode", None)):
+                or getattr(op, "placement", "tpu") == "cpu"):
             continue
         d = int(getattr(op, "out_dim", 0))
         if d <= 0:
             continue
+        if (getattr(op, "exchange_mode", None)
+                and getattr(op, "storage_pack", 1) > 1):
+            raise ValueError(
+                f"{op.name}: quantized tables under the manual "
+                f"exchange need logical (T, R, d) storage — the "
+                f"shard_map body cannot address a lane-packed view; "
+                f"serve with packed_tables='off' or serve_quantize="
+                f"'off'")
         table = np.asarray(p["embedding"])
         stored, scale = quantize_table(table, mode, d)
         q = dict(p)

@@ -1,6 +1,7 @@
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, apply_partition_rules,
                    constrain, make_mesh, match_partition_rule, param_pspec,
                    partition_rules, pspec_for_config, sharding)
+from .overlap import microbatch_ok, overlapped_embed_bottom
 from .parallel_config import ParallelConfig, Strategy
 from .ring_attention import ring_attention, ring_attention_sharded
 from .table_exchange import table_parallel_lookup
@@ -13,5 +14,6 @@ __all__ = [
     "ParallelConfig", "Strategy",
     "ring_attention", "ring_attention_sharded",
     "table_parallel_lookup",
+    "microbatch_ok", "overlapped_embed_bottom",
     "ulysses_attention", "ulysses_attention_sharded",
 ]

@@ -1,0 +1,177 @@
+"""Overlapped embedding exchange: microbatched comm/compute pipeline.
+
+The classic distributed-DLRM bottleneck is the table-parallel embedding
+exchange sitting SERIALLY before the interaction (the reference pins
+tables per device and exchanges at the interaction point,
+dlrm_strategy.cc:242-296): the bottom-MLP dense compute and the
+exchange collective are dataflow-independent, yet one monolithic
+all_gather/all_to_all gives the scheduler nothing to hide — the ICI
+time is fully exposed on the step's critical path.
+
+This module splits the batch into K microbatches INSIDE one
+``shard_map`` body and software-pipelines them at lag 1: microbatch
+k's exchange collective is issued, then microbatch k's slice of the
+bottom-MLP dense stack computes while that collective is in flight on
+ICI, then the next microbatch's local lookup + exchange issue.  On TPU
+the collectives lower to async ICI DMAs, so XLA's latency-hiding
+scheduler overlaps each in-flight exchange with the MXU matmuls issued
+after it — per microbatch the step pays ``max(exchange, dense)``
+instead of their sum (the model ``sim/cost_model.py`` prices for the
+search).  Off-TPU the pipeline is semantically identical (the CPU
+backend runs the collectives synchronously); numerics differ from the
+serial exchange only by collective-reorder rounding, tolerance-pinned
+in ``tests/test_overlap.py``.
+
+Both exchange modes of ``table_exchange.py`` pipeline:
+
+- ``allgather`` — microbatch i is a contiguous batch slice; each mb's
+  all_gather returns its full rows, so concatenating over i restores
+  the serial row order exactly.
+- ``all_to_all`` — each rank keeps only ITS batch-chunk of every
+  microbatch, so a contiguous split would permute the assembled global
+  batch.  Microbatch i instead takes sub-slice i OF EACH of the mp
+  chunks (a strided split), so rank j's concatenated output is exactly
+  the contiguous ``[j*B_loc/mp, (j+1)*B_loc/mp)`` rows the serial
+  all_to_all emits — the global row order is preserved by construction
+  (pinned in tests/test_overlap.py).
+
+Autodiff flows through the pipeline the same way it flows through the
+serial exchange (collectives transpose to their mirror collectives);
+the backward schedule is the mirrored pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, shard_map
+from .table_exchange import _local_lookup, qscale_operand, rank_qscale
+
+
+def microbatch_ok(local_batch: int, mp: int, microbatches: int,
+                  mode: str) -> bool:
+    """Whether the per-data-shard batch admits a K-way pipeline: every
+    microbatch must be equal-sized, and ``all_to_all`` additionally
+    chunks each microbatch mp ways (the strided split above)."""
+    k = int(microbatches)
+    if k <= 1 or local_batch <= 0:
+        return False
+    if mode == "all_to_all":
+        return local_batch % (mp * k) == 0
+    return local_batch % k == 0
+
+
+def overlapped_embed_bottom(tables, ids, dense_in, mesh: Mesh, dense_fn,
+                            dense_params, aggr: str = "sum",
+                            mode: str = "allgather",
+                            microbatches: int = 2, qscale=None):
+    """Pipelined table-parallel lookup + bottom-MLP compute.
+
+    ``tables`` (T, R, d) sharded P("model", None, None); ``ids``
+    (B, T, bag) int, batch-sharded over "data"; ``dense_in`` (B, f)
+    the bottom-MLP input, batch-sharded over "data";
+    ``dense_fn(dense_params, x)`` the dense stack applied per
+    microbatch slice (pure, (n, f) -> (n, bot_out)) — ``dense_params``
+    travels as an explicit replicated shard_map operand because the
+    body cannot close over traced arrays.  ``qscale`` flat (T*R, 1)
+    f32 dequantizes
+    int8 rows inside the body (ops/quantized.py): the gathered rows
+    dequantize BEFORE the exchange, so f32 rows ride ICI and the int8
+    table is never expanded in HBM.
+
+    Returns ``(emb, bottom)`` with the SAME shapes/shardings as the
+    serial path: ``emb`` (B, T, d) — replicated over "model" for
+    ``allgather``, batch-sharded over ("data","model") for
+    ``all_to_all`` — and ``bottom`` (B, bot_out) sharded to match.
+    """
+    assert mode in ("allgather", "all_to_all")
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    k = int(microbatches)
+    assert mp > 1, "overlap needs a model axis to exchange over"
+    t, r = tables.shape[0], tables.shape[1]
+    assert t % mp == 0, f"{t} tables over {mp} model ranks"
+    # the scale column shards WITH the tables — ONE threading contract
+    # shared with the serial exchange (table_exchange.qscale_operand)
+    qspec, qargs = qscale_operand(qscale, t, r)
+
+    if mode == "allgather":
+        def body(tbl_loc, ids_all, dense_loc, dp_, *qs):
+            j = jax.lax.axis_index(MODEL_AXIS)
+            t_loc = tbl_loc.shape[0]
+            ids_loc = jax.lax.dynamic_slice_in_dim(
+                ids_all, j * t_loc, t_loc, axis=1)   # (B_loc, T_loc, bag)
+            b_loc = ids_loc.shape[0]
+            mb = b_loc // k
+            qs_loc = rank_qscale(qs)
+            # lag-1 software pipeline: issue mb i's exchange, then run
+            # mb i's dense slice while the collective is in flight; the
+            # Python loop unrolls, so XLA sees K independent
+            # (collective, matmul-chain) pairs to overlap
+            exchanged, bottoms = [], []
+            for i in range(k):
+                look = _local_lookup(
+                    tbl_loc, ids_loc[i * mb:(i + 1) * mb], aggr,
+                    qscale=qs_loc)
+                exchanged.append(jax.lax.all_gather(
+                    look, MODEL_AXIS, axis=1, tiled=True))
+                bottoms.append(dense_fn(dp_,
+                                        dense_loc[i * mb:(i + 1) * mb]))
+            return (jnp.concatenate(exchanged, axis=0),
+                    jnp.concatenate(bottoms, axis=0))
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None),
+                      P(DATA_AXIS, None), P()) + qspec,
+            out_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None)),
+            # like table_exchange: the all_gather replicates the output
+            # over "model" but the per-rank dynamic_slice hides that
+            # from the static replication checker
+            check_vma=False,
+        )(tables, ids, dense_in, dense_params, *qargs)
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    b = ids.shape[0]
+    assert (b // max(dp, 1)) % (mp * k) == 0, (
+        f"all_to_all overlap needs the per-data-shard batch "
+        f"({b}//{dp}) divisible by model axis * microbatches "
+        f"({mp}*{k})")
+
+    def body(tbl_loc, ids_all, dense_loc, dp_, *qs):
+        j = jax.lax.axis_index(MODEL_AXIS)
+        t_loc = tbl_loc.shape[0]
+        ids_loc = jax.lax.dynamic_slice_in_dim(
+            ids_all, j * t_loc, t_loc, axis=1)       # (B_loc, T_loc, bag)
+        b_loc = ids_loc.shape[0]
+        csz = b_loc // mp          # the chunk each rank keeps
+        ssz = csz // k             # one microbatch's share of a chunk
+        qs_loc = rank_qscale(qs)
+        # strided microbatch split (module docstring): mb i = sub-slice
+        # i of EACH of the mp chunks, so this rank's kept pieces
+        # concatenate back to the contiguous chunk j the serial
+        # all_to_all emits
+        ids_r = ids_loc.reshape(mp, k, ssz, *ids_loc.shape[1:])
+        exchanged, bottoms = [], []
+        for i in range(k):
+            ids_mb = ids_r[:, i].reshape(mp * ssz, *ids_loc.shape[1:])
+            look = _local_lookup(tbl_loc, ids_mb, aggr, qscale=qs_loc)
+            exchanged.append(jax.lax.all_to_all(
+                look, MODEL_AXIS, split_axis=0, concat_axis=1,
+                tiled=True))                          # (ssz, T, d)
+            # the dense slice for the rows THIS rank keeps of mb i
+            dense_mb = jax.lax.dynamic_slice_in_dim(
+                dense_loc, j * csz + i * ssz, ssz, axis=0)
+            bottoms.append(dense_fn(dp_, dense_mb))
+        return (jnp.concatenate(exchanged, axis=0),
+                jnp.concatenate(bottoms, axis=0))
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None),
+                  P(DATA_AXIS, None), P()) + qspec,
+        out_specs=(P((DATA_AXIS, MODEL_AXIS), None, None),
+                   P((DATA_AXIS, MODEL_AXIS), None)),
+        check_vma=False,
+    )(tables, ids, dense_in, dense_params, *qargs)

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import shard_map
+
 PIPE_AXIS = "pipe"
 
 
@@ -79,7 +81,7 @@ def spmd_pipeline(stage_fn: Callable, mesh: Mesh, num_microbatches: int,
 
     def apply(stacked_params, x):
         pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-        staged = jax.shard_map(
+        staged = shard_map(
             per_device, mesh=mesh,
             in_specs=(pspec, P()),
             out_specs=P(axis),

@@ -115,5 +115,6 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
     spec = P(batch_axis, None, seq_axis, None)
 
     f = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+    from .mesh import shard_map
+    return shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -36,22 +36,48 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 
-def _local_lookup(tables, ids, aggr):
-    """(T_loc, R, d) x (B_loc, T_loc, bag) -> (B_loc, T_loc, d)."""
+def _local_lookup(tables, ids, aggr, qscale=None):
+    """(T_loc, R, d) x (B_loc, T_loc, bag) -> (B_loc, T_loc, d).
+
+    ``qscale`` (T_loc*R, 1) f32: this rank's slice of a per-row
+    quantization scale column (ops/quantized.py int8 serving tables) —
+    the GATHERED rows dequantize here, inside the exchange body, so
+    f32 rows ride the collective and the int8 table is never expanded
+    in HBM.  None = plain f32 tables (training)."""
     t, r, d = tables.shape
     flat = tables.reshape(t * r, d)
     gids = ids + (jnp.arange(t, dtype=ids.dtype)[:, None] * r)
     rows = jnp.take(flat, gids, axis=0)          # (B, T_loc, bag, d)
+    if qscale is not None:
+        rows = rows.astype(jnp.float32) * jnp.take(qscale, gids, axis=0)
     if aggr == "sum":
         return jnp.sum(rows, axis=2)
     return jnp.mean(rows, axis=2)
 
 
+def qscale_operand(qscale, t: int, r: int):
+    """THE qscale shard_map-threading contract, shared by every
+    exchange body (serial and overlapped): the flat (T*R, 1) scale
+    column rides as a (T, R, 1) view sharded WITH the tables on the
+    model axis, so each rank's block arrives pre-sliced.  Returns
+    ``(extra_in_specs, extra_args)`` — both empty for f32 tables."""
+    if qscale is None:
+        return (), ()
+    return (P(MODEL_AXIS, None, None),), (qscale.reshape(t, r, 1),)
+
+
+def rank_qscale(qs):
+    """Body-side twin of :func:`qscale_operand`: the varargs tuple
+    holding this rank's (T_loc, R, 1) block -> the flat (T_loc*R, 1)
+    form ``_local_lookup`` addresses, or None when unquantized."""
+    return qs[0].reshape(-1, 1) if qs else None
+
+
 def table_parallel_lookup(tables, ids, mesh: Mesh, aggr: str = "sum",
-                          mode: str = "allgather"):
+                          mode: str = "allgather", qscale=None):
     """Bagged lookup of model-axis-sharded stacked tables with an
     explicit exchange.
 
@@ -61,37 +87,47 @@ def table_parallel_lookup(tables, ids, mesh: Mesh, aggr: str = "sum",
     Returns (B, T, d) batch-sharded over "data" (replicated over
     "model" for ``allgather``; sharded over ("data","model") on the
     batch dim for ``all_to_all``).
+
+    ``qscale``: flat (T*R, 1) f32 per-row scale of an int8-quantized
+    table (ops/quantized.py) — each rank dequantizes its GATHERED rows
+    inside the body before the exchange.  Quantized ids follow the
+    in-table clamp contract (callers clamp to [0, R), matching the
+    dense quantized path's semantics).
     """
     assert mode in ("allgather", "all_to_all")
     mp = mesh.shape.get(MODEL_AXIS, 1)
     if mp == 1:  # no table axis to exchange over
-        return _local_lookup(tables, ids, aggr)
+        return _local_lookup(tables, ids, aggr, qscale=qscale)
     t = tables.shape[0]
+    r = tables.shape[1]
     assert t % mp == 0, f"{t} tables over {mp} model ranks"
+    qspec, qargs = qscale_operand(qscale, t, r)
 
     if mode == "allgather":
-        def body(tbl_loc, ids_all):
+        def body(tbl_loc, ids_all, *qs):
             # this rank's tables x its data-shard of the batch
             j = jax.lax.axis_index(MODEL_AXIS)
             t_loc = tbl_loc.shape[0]
             ids_loc = jax.lax.dynamic_slice_in_dim(
                 ids_all, j * t_loc, t_loc, axis=1)
-            out_loc = _local_lookup(tbl_loc, ids_loc, aggr)
+            out_loc = _local_lookup(tbl_loc, ids_loc, aggr,
+                                    qscale=rank_qscale(qs))
             # assemble all table-chunks on every model rank (the
             # interaction input is consumed data-parallel)
             out = jax.lax.all_gather(out_loc, MODEL_AXIS, axis=1,
                                      tiled=True)
             return out
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
-            in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None)),
+            in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None))
+            + qspec,
             out_specs=P(DATA_AXIS, None, None),
             # the all_gather makes the output replicated over "model",
             # but the per-rank dynamic_slice hides that from the static
             # replication checker
             check_vma=False,
-        )(tables, ids)
+        )(tables, ids, *qargs)
 
     dp = mesh.shape.get(DATA_AXIS, 1)
     b = ids.shape[0]
@@ -99,7 +135,7 @@ def table_parallel_lookup(tables, ids, mesh: Mesh, aggr: str = "sum",
         f"all_to_all exchange needs the per-data-shard batch "
         f"({b}//{dp}) divisible by the model axis ({mp})")
 
-    def body(tbl_loc, ids_all):
+    def body(tbl_loc, ids_all, *qs):
         # phase 1: local lookup — this rank's tables for its data-shard's
         # FULL local batch (same compute as allgather mode; the modes
         # differ only in the exchange)
@@ -107,15 +143,17 @@ def table_parallel_lookup(tables, ids, mesh: Mesh, aggr: str = "sum",
         t_loc = tbl_loc.shape[0]
         ids_loc = jax.lax.dynamic_slice_in_dim(
             ids_all, j * t_loc, t_loc, axis=1)       # (B_loc, T_loc, bag)
-        out_loc = _local_lookup(tbl_loc, ids_loc, aggr)  # (B_loc, T_loc, d)
+        out_loc = _local_lookup(tbl_loc, ids_loc, aggr,
+                                qscale=rank_qscale(qs))  # (B_loc, T_loc, d)
         # phase 2: swap table-chunks for batch-chunks; after this, each
         # rank holds ALL tables for B_loc/mp rows
         out = jax.lax.all_to_all(out_loc, MODEL_AXIS, split_axis=0,
                                  concat_axis=1, tiled=True)
         return out                                    # (B_loc/mp, T, d)
 
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh,
-        in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None)),
+        in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS, None, None))
+        + qspec,
         out_specs=P((DATA_AXIS, MODEL_AXIS), None, None),
-    )(tables, ids)
+    )(tables, ids, *qargs)

@@ -84,6 +84,29 @@ class TPUMachineModel:
         return bytes_moved / self.dcn_bandwidth
 
 
+def overlapped_exchange_time(machine: "TPUMachineModel", exchange_s: float,
+                             dense_s: float, microbatches: int,
+                             overlapped: bool = True) -> float:
+    """Time for an embedding exchange running NEXT TO a dense stack.
+
+    Serial (``overlapped=False`` or K<=1): the two rails pay their sum
+    — the monolithic collective sits fully exposed before the
+    interaction.  Pipelined (parallel/overlap.py): the batch splits
+    into K microbatches and each microbatch pays
+    ``max(exchange/K, dense/K)``, plus one fill term — the first
+    exchange (or the last dense slice, whichever rail is shorter) has
+    nothing to hide under, so ``min(exchange, dense)/K`` stays
+    exposed.  This is the op-class pricing hook
+    ``OverlappedEmbedBottom.exchange_overlap_cost`` feeds the
+    simulator, so MCMC search under the (calibrated) analytic model
+    can rank overlap-winning strategies above serial ones."""
+    if not overlapped or microbatches <= 1:
+        return exchange_s + dense_s
+    k = max(int(microbatches), 1)
+    return k * max(exchange_s / k, dense_s / k) + min(exchange_s,
+                                                      dense_s) / k
+
+
 class CostModel:
     """Memoized per-op timing (reference simulator.cc:235-273).
 
@@ -182,6 +205,16 @@ class CostModel:
 
     def _analytic_op(self, op, num_parts: int) -> Tuple[float, float]:
         m = self.machine
+        # overlap-aware op classes price themselves (per-microbatch
+        # max(exchange, dense) instead of the roofline sum — see
+        # overlapped_exchange_time); calibration still applies on top
+        # in op_times, so the fitted per-class correction covers the
+        # new class like any other
+        hook = getattr(op, "exchange_overlap_cost", None)
+        if hook is not None:
+            est = hook(m, num_parts)
+            if est is not None:
+                return est
         batch = op.outputs[0].shape[0] if op.outputs[0].ndim else 1
         flops = op.flops(batch) / max(num_parts, 1)
         compute_dtype = getattr(op, "compute_dtype", None) or "float32"

@@ -81,6 +81,14 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         q = h.get("quantize")
         if q and q != "off":
             name = f"{name}:quantize={q}"
+        # overlapped-exchange entries anchor separately too (bench.py
+        # keys "overlap" the same way): the microbatched pipeline
+        # reorders collective reductions, so an overlapped run is
+        # tolerance-equivalent — not bit-identical — to the serial
+        # exchange and must never gate a serial baseline
+        ov = h.get("overlap")
+        if ov and ov != "off":
+            name = f"{name}:overlap={ov}"
         # per-bucket latency headlines likewise: the largest dispatched
         # bucket is load-dependent, and a bucket-8 p99 must never
         # anchor a bucket-64 run (bench.py keys the entry the same way)

@@ -43,7 +43,15 @@ field without the schema and the report CLI seeing it:
      declared, docs/elastic.md must document the subsystem's entry
      points next to them, and the regress anchor keys must keep the
      ``:mesh=``/``:replicas=`` topology suffixes so an elastic run can
-     never gate against a different topology's baseline.
+     never gate against a different topology's baseline;
+  8. exchange-overlap contract — the overlapped-exchange knobs
+     (``exchange_overlap``/``--exchange-overlap``/``BENCH_OVERLAP``,
+     the ``FF_EXCHANGE_OVERLAP`` dispatch override, the microbatch
+     count) must be documented in docs/pipeline.md next to the
+     host-side pipeline they mirror, and the regress anchor keys must
+     keep the ``:overlap=`` suffix (the pipeline reorders collective
+     reductions, so an overlapped run must never gate a serial
+     baseline).
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -355,6 +363,39 @@ def check_elastic_contract(doc_path: str) -> list:
     return errs
 
 
+OVERLAP_DOC_NEEDLES = ("exchange_overlap", "--exchange-overlap",
+                       "BENCH_OVERLAP", "FF_EXCHANGE_OVERLAP",
+                       "exchange_microbatches")
+
+
+def check_overlap_contract(doc_path: str) -> list:
+    """The exchange-overlap observability contract (docs/pipeline.md):
+    every knob of the device-side microbatched pipeline documented
+    next to the host-side pipeline, and overlapped runs anchored
+    separately in the regress gate."""
+    from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+
+    errs = []
+    if not os.path.exists(doc_path):
+        return [f"missing {doc_path} (the documented pipelines)"]
+    with open(doc_path) as f:
+        doc = f.read()
+    for needle in OVERLAP_DOC_NEEDLES:
+        if f"`{needle}" not in doc:
+            errs.append(f"docs/pipeline.md does not document "
+                        f"`{needle}`")
+    anchors = _history_metrics([
+        {"metric": "m", "value": 1.0, "fenced": True},
+        {"metric": "m", "value": 1.0, "fenced": True, "overlap": "on"}])
+    for key in ("m", "m:overlap=on"):
+        if key not in anchors:
+            errs.append(f"overlap: regress anchor key {key!r} missing — "
+                        f"an overlapped run could gate a serial "
+                        f"baseline (telemetry/regress.py "
+                        f"_history_metrics)")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -366,7 +407,9 @@ def main() -> int:
             + check_pipeline_contract(os.path.join(REPO, "docs",
                                                    "pipeline.md"))
             + check_elastic_contract(os.path.join(REPO, "docs",
-                                                  "elastic.md")))
+                                                  "elastic.md"))
+            + check_overlap_contract(os.path.join(REPO, "docs",
+                                                  "pipeline.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

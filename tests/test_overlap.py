@@ -1,0 +1,404 @@
+"""Overlapped embedding exchange (parallel/overlap.py,
+ops/overlap_embed.py), the fused backward kernel
+(ops/pallas_fused_interact.py), overlap-aware simulator pricing
+(sim/cost_model.py), the ``:overlap=`` regress anchoring, the
+FF_EXCHANGE_OVERLAP dispatch-knob ffcheck fixtures, and the tier-1
+smoke matrix (scripts/check_overlap.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+from dlrm_flexflow_tpu.ops.kernel_costs import exchange_overlap_wins
+from dlrm_flexflow_tpu.parallel import (microbatch_ok,
+                                        overlapped_embed_bottom,
+                                        table_parallel_lookup)
+from dlrm_flexflow_tpu.sim.cost_model import (TPUMachineModel,
+                                              overlapped_exchange_time)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T, R, D, B = 4, 32, 8, 48
+
+
+def _mesh22():
+    if jax.device_count() < 4:
+        pytest.skip("needs the multi-device virtual mesh")
+    return ff.make_mesh({"data": 2, "model": 2})
+
+
+def _fixtures(rng):
+    tables = jnp.asarray(rng.standard_normal((T, R, D)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, R, size=(B, T, 2), dtype=np.int64))
+    dense = jnp.asarray(rng.standard_normal((B, 5)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((5, 7)).astype(np.float32))
+    return tables, ids, dense, w
+
+
+def _dense_fn(p, x):
+    return x @ p["w"]
+
+
+class TestOverlappedPipeline:
+    """The microbatched shard_map pipeline vs the serial exchange."""
+
+    @pytest.mark.parametrize("mode", ["allgather", "all_to_all"])
+    @pytest.mark.parametrize("k", [2, 6])
+    def test_matches_serial_exchange(self, rng, mode, k):
+        """Pipelined emb output == serial exchange output (the strided
+        all_to_all split preserves the global row order by
+        construction), and the bottom slices reassemble the full-batch
+        dense product."""
+        mesh = _mesh22()
+        tables, ids, dense, w = _fixtures(rng)
+        serial = table_parallel_lookup(tables, ids, mesh, "sum", mode)
+        emb, bot = overlapped_embed_bottom(
+            tables, ids, dense, mesh, _dense_fn, {"w": w}, aggr="sum",
+            mode=mode, microbatches=k)
+        np.testing.assert_array_equal(np.asarray(emb), np.asarray(serial))
+        np.testing.assert_allclose(np.asarray(bot),
+                                   np.asarray(dense @ w), rtol=1e-6)
+
+    def test_gradients_match_serial(self, rng):
+        """Autodiff flows through the pipeline: table and dense grads
+        match the serial formulation within collective-reorder
+        tolerance."""
+        mesh = _mesh22()
+        tables, ids, dense, w = _fixtures(rng)
+
+        def loss_pipe(tb, w_):
+            emb, bot = overlapped_embed_bottom(
+                tb, ids, dense, mesh, _dense_fn, {"w": w_}, aggr="sum",
+                mode="all_to_all", microbatches=2)
+            return jnp.sum(emb ** 2) + jnp.sum(bot ** 2)
+
+        def loss_serial(tb, w_):
+            emb = table_parallel_lookup(tb, ids, mesh, "sum",
+                                        "all_to_all")
+            return jnp.sum(emb ** 2) + jnp.sum((dense @ w_) ** 2)
+
+        gp = jax.grad(loss_pipe, argnums=(0, 1))(tables, w)
+        gs = jax.grad(loss_serial, argnums=(0, 1))(tables, w)
+        np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gs[0]),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gs[1]),
+                                   atol=1e-3)
+
+    def test_quantized_rows_dequantize_in_body(self, rng):
+        """int8 codes + qscale through the pipeline == the quantized
+        serial exchange, bit-for-bit."""
+        from dlrm_flexflow_tpu.ops.quantized import quantize_table
+        mesh = _mesh22()
+        tables, ids, dense, w = _fixtures(rng)
+        codes, scale = quantize_table(np.asarray(tables), "int8", D)
+        codes, scale = jnp.asarray(codes), jnp.asarray(scale)
+        serial = table_parallel_lookup(codes, ids, mesh, "sum",
+                                       "allgather", qscale=scale)
+        emb, _ = overlapped_embed_bottom(
+            codes, ids, dense, mesh, _dense_fn, {"w": w}, aggr="sum",
+            mode="allgather", microbatches=2, qscale=scale)
+        np.testing.assert_array_equal(np.asarray(emb),
+                                      np.asarray(serial))
+
+    def test_microbatch_divisibility(self):
+        assert microbatch_ok(64, 2, 2, "allgather")
+        assert microbatch_ok(63, 2, 3, "allgather")  # 63 % 3 == 0
+        assert not microbatch_ok(63, 2, 2, "allgather")
+        assert not microbatch_ok(64, 2, 1, "allgather")  # K=1: no pipe
+        assert microbatch_ok(64, 2, 2, "all_to_all")
+        assert not microbatch_ok(64, 2, 3, "all_to_all")  # % (2*3)
+
+
+class TestOverlappedOp:
+    """OverlappedEmbedBottom inside the DLRM graph."""
+
+    def _model(self, overlap="on", exchange="allgather", mesh=True,
+               microbatches=2):
+        cfg = DLRMConfig(sparse_feature_size=D,
+                         embedding_size=[R] * T,
+                         mlp_bot=[13, 16, D],
+                         mlp_top=[D + T * D, 16, 1])
+        cfg.exchange_overlap = overlap
+        cfg.exchange_microbatches = microbatches
+        fc = ff.FFConfig(batch_size=B, table_exchange=exchange)
+        m = build_dlrm(cfg, fc, table_parallel=True)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05),
+                  loss_type="mean_squared_error", metrics=(),
+                  mesh=_mesh22() if mesh else False)
+        return m
+
+    def test_builds_one_op_and_engages_exchange(self):
+        m = self._model()
+        op = m.get_op("emb_bot")
+        assert op.exchange_mode == "allgather"
+        assert not any(o.name.startswith("bot_") for o in m.layers)
+        # bottom weights replicate; the table shards over "model"
+        shard = m._param_shardings()["emb_bot"]
+        assert "model" in str(shard["embedding"].spec)
+        assert "model" not in str(shard["bot0_kernel"].spec)
+
+    def test_sparse_path_never_adopts_it(self):
+        m = self._model()
+        assert m.get_op("emb_bot").sparse_path_ok is False
+        assert "emb_bot" not in getattr(m, "_sparse_emb_ops", [])
+
+    def test_env_off_forces_serial(self, monkeypatch):
+        import dlrm_flexflow_tpu.ops.overlap_embed as oe
+        m = self._model()
+        op = m.get_op("emb_bot")
+        ids = jnp.zeros((B, T, 1), jnp.int32)
+        monkeypatch.setattr(oe, "_IMPL", "off")
+        assert op._overlap_now(ids) is False
+        monkeypatch.setattr(oe, "_IMPL", "on")
+        assert op._overlap_now(ids) is True
+
+    def test_on_requires_uniform_stacked(self):
+        cfg = DLRMConfig(sparse_feature_size=D,
+                         embedding_size=[R, R * 2],
+                         mlp_bot=[13, D],
+                         mlp_top=[D + 2 * D, 1])
+        cfg.exchange_overlap = "on"
+        with pytest.raises(ValueError, match="uniform stacked"):
+            build_dlrm(cfg, ff.FFConfig(batch_size=B))
+
+    def test_on_excludes_fused_interaction(self):
+        cfg = DLRMConfig(sparse_feature_size=D, embedding_size=[R] * T,
+                         mlp_bot=[13, D], mlp_top=[D + T * D, 1])
+        cfg.exchange_overlap = "on"
+        cfg.fused_interaction = "on"
+        with pytest.raises(ValueError, match="one graph shape"):
+            build_dlrm(cfg, ff.FFConfig(batch_size=B))
+
+
+class TestBackwardKernel:
+    """jax.grad through the fused kernel's custom_vjp vs the emitter
+    VJP — interpret mode, both jitted (scripts/check_overlap.py runs
+    the full cat/dot x sum/avg matrix; one arm here pins the unit)."""
+
+    def test_bit_exact_dot_avg(self):
+        import functools
+        from dlrm_flexflow_tpu.ops.pallas_fused_interact import (
+            fused_embed_interact, mask_local_ids)
+        rng = np.random.default_rng(3)
+        t, r, bag, d = 3, 24, 2, 8
+        table = jnp.asarray(
+            rng.standard_normal((t * r, d)).astype(np.float32))
+        local = rng.integers(-2, r + 2, size=(13, t, bag))
+        gids = mask_local_ids(jnp.asarray(local), np.arange(t) * r,
+                              [r] * t)
+        bottom = jnp.asarray(
+            rng.standard_normal((13, d)).astype(np.float32))
+
+        def loss(tb, bt, use_kernel, interpret):
+            out = fused_embed_interact(tb, gids, bt, "dot", "avg",
+                                       use_kernel, interpret)
+            return jnp.sum(out ** 2)
+
+        gk = jax.jit(functools.partial(
+            jax.grad(loss, argnums=(0, 1)), use_kernel=True,
+            interpret=True))(table, bottom)
+        ge = jax.jit(functools.partial(
+            jax.grad(loss, argnums=(0, 1)), use_kernel=False,
+            interpret=False))(table, bottom)
+        np.testing.assert_array_equal(np.asarray(gk[0]),
+                                      np.asarray(ge[0]))
+        np.testing.assert_array_equal(np.asarray(gk[1]),
+                                      np.asarray(ge[1]))
+
+    def test_bf16_compute_keeps_emitter_vjp(self):
+        """compute_dtype='bfloat16' programs fall back to the emitter
+        VJP (the kernel backward is f32-only) — grads still flow."""
+        from dlrm_flexflow_tpu.ops.pallas_fused_interact import (
+            fused_embed_interact, mask_local_ids)
+        rng = np.random.default_rng(4)
+        t, r, bag, d = 2, 16, 1, 8
+        table = jnp.asarray(
+            rng.standard_normal((t * r, d)).astype(np.float32))
+        gids = mask_local_ids(
+            jnp.asarray(rng.integers(0, r, size=(8, t, bag))),
+            np.arange(t) * r, [r] * t)
+        bottom = jnp.asarray(
+            rng.standard_normal((8, d)).astype(np.float32))
+
+        def loss(tb):
+            out = fused_embed_interact(tb, gids, bottom, "dot", "sum",
+                                       True, True, "bfloat16")
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(table)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestOverlapPricing:
+    """sim/cost_model.py overlap-aware exchange pricing."""
+
+    def test_max_plus_fill_model(self):
+        # pipelined: K * max(ex/K, dense/K) + min/K; serial: sum
+        assert overlapped_exchange_time(None, 1e-3, 1e-3, 2) == 1.5e-3
+        assert overlapped_exchange_time(None, 4e-3, 1e-3, 4) == 4.25e-3
+        assert overlapped_exchange_time(None, 1e-3, 1e-3, 1) == 2e-3
+        assert overlapped_exchange_time(None, 1e-3, 1e-3, 4,
+                                        overlapped=False) == 2e-3
+
+    def test_gate_anchor_points(self):
+        def bot_flops(b):
+            return 2 * b * (64 * 512 + 512 * 512 + 512 * 64)
+        assert exchange_overlap_wins(512, 8, 64, 4, 4, bot_flops(512), 2)
+        assert not exchange_overlap_wins(64, 8, 64, 4, 4, bot_flops(64),
+                                         2)
+        assert not exchange_overlap_wins(512, 8, 64, 4, 1,
+                                         bot_flops(512), 2)
+        assert not exchange_overlap_wins(512, 8, 64, 4, 4,
+                                         bot_flops(512), 1)
+
+    def test_hook_prices_overlap_below_serial(self):
+        from dlrm_flexflow_tpu.ops.overlap_embed import (
+            OverlappedEmbedBottom)
+        from dlrm_flexflow_tpu.tensor import Tensor
+        ids = Tensor((256, T, 1), jnp.int64, name="ids")
+        dense = Tensor((256, 13), jnp.float32, name="dense")
+        op = OverlappedEmbedBottom("eb", ids, dense, T, R, D,
+                                   [13, 512, D], overlap="on",
+                                   microbatches=4)
+        op.exchange_mode = "allgather"
+        machine = TPUMachineModel()
+        on = op.exchange_overlap_cost(machine, 4)
+        op.overlap = "off"
+        off = op.exchange_overlap_cost(machine, 4)
+        assert on[0] < off[0] and on[1] < off[1]
+        # 'auto' mirrors the runtime gate: a shape the dispatch would
+        # refuse prices serial, a winning shape prices the pipeline
+        op.overlap = "auto"
+        assert op.exchange_overlap_cost(machine, 4) == off
+        big_ids = Tensor((4096, 8, 1), jnp.int64, name="big_ids")
+        big_dense = Tensor((4096, 64), jnp.float32, name="big_dense")
+        big = OverlappedEmbedBottom("eb2", big_ids, big_dense, 8, R, 64,
+                                    [64, 512, 512, 64], overlap="auto",
+                                    microbatches=2)
+        big.exchange_mode = "allgather"
+        big_serial = OverlappedEmbedBottom(
+            "eb3", big_ids, big_dense, 8, R, 64, [64, 512, 512, 64],
+            overlap="off", microbatches=2)
+        big_serial.exchange_mode = "allgather"
+        assert (big.exchange_overlap_cost(machine, 4)[0]
+                < big_serial.exchange_overlap_cost(machine, 4)[0])
+
+    def test_calibration_covers_the_class(self):
+        """fit_calibration keys per type(op).__name__ — the new class
+        gets its own fitted scale like any other (satellite
+        acceptance: calibration-fit covered)."""
+        from dlrm_flexflow_tpu.ops.overlap_embed import (
+            OverlappedEmbedBottom)
+        from dlrm_flexflow_tpu.sim.tune import op_class_map
+        from dlrm_flexflow_tpu.tensor import Tensor
+        ids = Tensor((B, T, 1), jnp.int64, name="ids")
+        dense = Tensor((B, 13), jnp.float32, name="dense")
+        op = OverlappedEmbedBottom("eb", ids, dense, T, R, D, [13, D])
+
+        class _M:
+            layers = [op]
+        assert op_class_map(_M())["eb"] == "OverlappedEmbedBottom"
+
+
+class TestOverlapAnchoring:
+    """bench/regress: an overlapped run never gates a serial baseline."""
+
+    def test_history_metrics_overlap_suffix(self):
+        from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+        entries = [
+            {"metric": "dlrm_synthetic_samples_per_sec", "value": 100.0,
+             "fenced": True},
+            {"metric": "dlrm_synthetic_samples_per_sec", "value": 80.0,
+             "fenced": True, "overlap": "on", "mesh": "data=2,model=2"},
+            {"metric": "dlrm_synthetic_samples_per_sec", "value": 90.0,
+             "fenced": True, "overlap": "off"},
+        ]
+        got = _history_metrics(entries)
+        key = "dlrm_synthetic_samples_per_sec"
+        # overlap=off is the plain name (and overwrites the serial
+        # anchor); overlap=on anchors separately, with its mesh
+        assert got[key] == 90.0
+        assert got[f"{key}:overlap=on:mesh=data=2,model=2"] == 80.0
+
+    def test_newer_serial_entry_keeps_overlap_anchor(self):
+        from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+        entries = [
+            {"metric": "m", "value": 80.0, "fenced": True,
+             "overlap": "on"},
+            {"metric": "m", "value": 100.0, "fenced": True},
+        ]
+        got = _history_metrics(entries)
+        assert got["m:overlap=on"] == 80.0  # not swept by the newer f32
+        assert got["m"] == 100.0
+
+
+class TestDispatchKnobFixtures:
+    """ffcheck trace-staleness fixtures for the FF_EXCHANGE_OVERLAP
+    idiom: the real op's env-derived module constant read under a
+    traced forward FIRES (and is waived by name in
+    ANALYSIS_WAIVERS.txt); the sanctioned read-at-import-into-a-local
+    pattern stays silent."""
+
+    def _run(self, tmp_path, files):
+        from dlrm_flexflow_tpu.analysis.engine import (FunctionIndex,
+                                                       load_modules)
+        from dlrm_flexflow_tpu.analysis.passes.staleness import (
+            TraceStalenessPass)
+        root = tmp_path
+        for rel, src in files.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(src)
+        roots = sorted({rel.split("/")[0] for rel in files})
+        modules = load_modules(roots=roots, repo=str(root))
+        return TraceStalenessPass().run(modules, FunctionIndex(modules))
+
+    def test_fires_on_knob_read_in_traced_forward(self, tmp_path):
+        fs = self._run(tmp_path, {"pkg/knob.py": (
+            "import os\n"
+            "import jax\n"
+            "_IMPL = os.environ.get('FF_EXCHANGE_OVERLAP', 'auto')\n"
+            "def _overlap_now():\n"
+            "    return _IMPL != 'off'\n"
+            "def step(x):\n"
+            "    return x if _overlap_now() else -x\n"
+            "f = jax.jit(step)\n")})
+        assert sorted({f.code for f in fs}) == ["env-read-in-trace"]
+        assert any("_IMPL" in f.message for f in fs)
+
+    def test_silent_when_knob_resolved_outside_trace(self, tmp_path):
+        fs = self._run(tmp_path, {"pkg/ok.py": (
+            "import os\n"
+            "import jax\n"
+            "def build(x):\n"
+            "    impl = os.environ.get('FF_EXCHANGE_OVERLAP', 'auto')\n"
+            "    sign = 1.0 if impl != 'off' else -1.0\n"
+            "    def step(y):\n"
+            "        return y * sign\n"
+            "    return jax.jit(step)(x)\n")})
+        assert fs == []
+
+    def test_real_knob_is_waived_by_name(self):
+        waivers = open(os.path.join(REPO, "ANALYSIS_WAIVERS.txt")).read()
+        assert ("trace-staleness:dlrm_flexflow_tpu/ops/overlap_embed.py:"
+                "OverlappedEmbedBottom._overlap_now:env-read-in-trace"
+                in waivers)
+
+
+class TestCheckOverlapSmoke:
+    def test_check_overlap_smoke(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "check_overlap.py")],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "check_overlap: OK (5 scenarios)" in out.stdout
