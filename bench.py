@@ -77,6 +77,10 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
                     hv = "off"  # records written before serve quantize
                 if k == "replicas" and hv is None:
                     hv = 1  # records written before the replica router
+                if k == "hosts" and hv is None:
+                    hv = 1  # records written before multi-host keys
+                if k == "slices" and hv is None:
+                    hv = 1  # records written before pod topology keys
                 if k == "mesh" and hv is None:
                     hv = ""  # records written before mesh-native serving
                 if k == "metric" and hv is None:
@@ -529,10 +533,20 @@ def main():
                 ",".join(f"{a}={s}" for a, s in
                          zip(model.mesh.axis_names,
                              model.mesh.devices.shape)))
+    # the multi-host / pod shape rides the anchor key (the PR 9
+    # :replicas=/:mesh= pattern): a 2-host or 2-slice run trains a
+    # different physical topology — different collectives on different
+    # links — and must never gate the single-host baseline
+    # (telemetry/regress.py suffixes ":hosts="/":slices=" the same
+    # way; entries predating the fields count as 1 in matches())
+    from dlrm_flexflow_tpu.distributed import pod_topology
+    hosts = jax.process_count()
+    slices = pod_topology().num_slices
     _emit("dlrm_synthetic_samples_per_sec", thpt,
           {"app": "dlrm", "batch": batch, "num_batches": num_batches,
            "epochs": epochs, "rows": rows, "emb_dtype": emb_dtype,
-           "overlap": overlap, "mesh": mesh_str},
+           "overlap": overlap, "mesh": mesh_str, "hosts": hosts,
+           "slices": slices},
           extra={"dtype": dtype, "fused": cfg.fused_interaction,
                  "prefetch": prefetch, "exchange": exchange,
                  "overlap_k": overlap_k,

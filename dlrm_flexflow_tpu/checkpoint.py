@@ -123,7 +123,8 @@ def _reshape_to(state: TrainState, model, target: str) -> TrainState:
 
 
 def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
-                    use_orbax: Optional[bool] = None, model=None) -> str:
+                    use_orbax: Optional[bool] = None, model=None,
+                    multihost: bool = False) -> str:
     """Write a checkpoint directory; returns the path written.
 
     Pass ``model`` to include its CPU-placed (hetero) embedding tables —
@@ -132,10 +133,30 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
     their LOGICAL shapes, making the checkpoint portable across
     backends/meshes/storage modes.  Without ``model``, packed arrays are
     saved in storage form and restore_checkpoint(model=...) re-forms
-    them."""
+    them.
+
+    ``multihost=True`` is the pod format (docs/distributed.md): EVERY
+    process calls this on a shared directory and writes only the array
+    shards it owns (``shard-pNNN.npz`` + index sidecar,
+    :func:`save_pod_shards`); process 0 alone writes ``meta.json``.
+    The caller (``resilience.CheckpointManager``) owns the cross-host
+    barriers around the call."""
     if model is not None:
         state = _reshape_to(state, model, "logical")
     os.makedirs(path, exist_ok=True)
+    if multihost:
+        import jax
+        save_pod_shards(path, state, _host_tables_of(model))
+        if jax.process_index() == 0:
+            meta = {"step": int(_local_value(state.step))
+                    if step is None else step,
+                    "format": "podshard",
+                    "process_count": jax.process_count()}
+            if model is not None:
+                meta["mesh"] = mesh_topology(getattr(model, "mesh", None))
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+        return path
     if use_orbax is None:
         use_orbax = _orbax_available()
     meta = {"step": int(state.step) if step is None else step,
@@ -160,17 +181,7 @@ def save_checkpoint(path: str, state: TrainState, step: Optional[int] = None,
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(os.path.join(path, "state"), ckpt, force=True)
     else:
-        flat = {}
-        flat.update({f"params/{k}": v for k, v in
-                     _flatten(state.params).items()})
-        flat.update({f"opt_state/{k}": v for k, v in
-                     _flatten(state.opt_state).items()})
-        flat.update({f"bn_state/{k}": v for k, v in
-                     _flatten(state.bn_state).items()})
-        flat.update({f"host_tables/{_esc(k)}": v
-                     for k, v in host_tables.items()})
-        flat["rng"] = state.rng
-        flat["step"] = state.step
+        flat = _flat_state(state, host_tables)
         np.savez(os.path.join(path, "state.npz"),
                  **{k: np.asarray(v) for k, v in flat.items()})
     with open(os.path.join(path, "meta.json"), "w") as f:
@@ -212,6 +223,149 @@ def host_gather(tree):
     if hasattr(tree, "__array__"):
         return np.asarray(tree)
     return tree
+
+
+# ------------------------------------------------------- pod shard format
+#
+# The multi-host checkpoint layout (docs/distributed.md): every process
+# writes ONE ``shard-pNNN.npz`` holding exactly the array blocks it
+# owns (plus a ``shard-pNNN.json`` sidecar mapping each block to its
+# rectangle of the global shape), process 0 adds ``meta.json``
+# (format="podshard") and — through CheckpointManager — the manifest.
+# Together the shard files cover every leaf completely, so a restore
+# needs only the DIRECTORY, not the fleet that wrote it: after losing
+# a host (or any reshape) the remaining/new processes reassemble the
+# full host-logical arrays from all shard files and re-place them
+# under their own topology — the reshard-on-restore composition
+# (docs/elastic.md).
+
+def _local_value(leaf) -> np.ndarray:
+    """A host copy of a (possibly multi-host) array's value: plain
+    ``np.asarray`` when the whole array is addressable, else the
+    process-local replica (only valid for REPLICATED leaves — the
+    sharded ones go through the shard path)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is not None and not leaf.is_fully_addressable:
+        return np.asarray(shards[0].data)
+    return np.asarray(leaf)
+
+
+def _flat_state(state: TrainState, host_tables: dict) -> dict:
+    """The one flat key -> leaf map both checkpoint writers share."""
+    flat = {}
+    flat.update({f"params/{k}": v
+                 for k, v in _flatten(state.params).items()})
+    flat.update({f"opt_state/{k}": v
+                 for k, v in _flatten(state.opt_state).items()})
+    flat.update({f"bn_state/{k}": v
+                 for k, v in _flatten(state.bn_state).items()})
+    flat.update({f"host_tables/{_esc(k)}": v
+                 for k, v in (host_tables or {}).items()})
+    flat["rng"] = state.rng
+    flat["step"] = state.step
+    return flat
+
+
+def _norm_rect(index, shape):
+    """A shard's ``index`` (tuple of slices) as JSON-able lo/hi lists."""
+    lo, hi = [], []
+    for s, dim in zip(index, shape):
+        lo.append(int(s.start) if s.start is not None else 0)
+        hi.append(int(s.stop) if s.stop is not None else int(dim))
+    return lo, hi
+
+
+def save_pod_shards(path: str, state: TrainState,
+                    host_tables: Optional[dict] = None) -> list:
+    """Write THIS process' shard file pair into ``path``; returns the
+    relative filenames written (for the manager's fsync).  Ownership:
+    a block is written by the process holding its ``replica_id == 0``
+    shard (the orbax dedup rule) — replicated leaves land once, in
+    whichever process owns replica 0 (process 0 for host-resident
+    numpy leaves), and block-sharded leaves land exactly once per
+    rectangle, so the union of all shard files tiles every leaf with
+    no overlap."""
+    import jax
+
+    pidx, n = jax.process_index(), jax.process_count()
+    data: dict = {}
+    parts = []
+    arrays = {}
+    for key, leaf in sorted(_flat_state(state, host_tables or {}).items()):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None or getattr(leaf, "is_fully_addressable", True):
+            # host numpy / single-host array: one canonical copy, p0's
+            if pidx == 0:
+                data[key] = np.asarray(leaf)
+            continue
+        arrays[key] = {"shape": [int(d) for d in leaf.shape],
+                       "dtype": str(np.dtype(leaf.dtype))}
+        for j, sh in enumerate(shards):
+            if sh.replica_id != 0:
+                continue
+            lo, hi = _norm_rect(sh.index, leaf.shape)
+            data[f"{key}@@{j}"] = np.asarray(sh.data)
+            parts.append({"key": key, "npz": f"{key}@@{j}",
+                          "lo": lo, "hi": hi})
+    npz = f"shard-p{pidx:03d}.npz"
+    idx = f"shard-p{pidx:03d}.json"
+    np.savez(os.path.join(path, npz), **data)
+    with open(os.path.join(path, idx), "w") as f:
+        json.dump({"process_index": pidx, "process_count": n,
+                   "arrays": arrays, "parts": parts}, f)
+    return [npz, idx]
+
+
+def _load_pod_shards(path: str) -> dict:
+    """Reassemble the flat key -> full host-logical numpy array map
+    from EVERY shard file pair in a podshard checkpoint; raises
+    :class:`CheckpointError` when the union of rectangles does not
+    cover an array (a shard file is missing — the save lost a writer
+    before the manifest, which verification would also have caught)."""
+    import glob as _glob
+
+    idx_paths = sorted(_glob.glob(os.path.join(path, "shard-p*.json")))
+    if not idx_paths:
+        raise CheckpointError(
+            f"{path!r} holds no shard-p*.json index files (meta.json "
+            f"says format='podshard') — the save was killed before any "
+            f"shard landed")
+    flat: dict = {}
+    covered: dict = {}
+    shapes: dict = {}
+    for ip in idx_paths:
+        try:
+            with open(ip) as f:
+                idx = json.load(f)
+            npz = np.load(ip[:-len(".json")] + ".npz")
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise CheckpointError(
+                f"{ip!r}: unreadable shard file pair ({e})") from e
+        for key, meta in idx.get("arrays", {}).items():
+            if key not in flat:
+                shapes[key] = tuple(int(d) for d in meta["shape"])
+                flat[key] = np.empty(shapes[key],
+                                     dtype=np.dtype(meta["dtype"]))
+                covered[key] = 0
+        for part in idx.get("parts", []):
+            key = part["key"]
+            rect = tuple(slice(int(a), int(b))
+                         for a, b in zip(part["lo"], part["hi"]))
+            block = npz[part["npz"]]
+            flat[key][rect] = block
+            covered[key] += int(np.prod([b - a for a, b in
+                                         zip(part["lo"], part["hi"])]))
+        for k in npz.files:
+            if "@@" not in k:
+                flat[k] = npz[k]
+    for key, want in shapes.items():
+        if covered.get(key, 0) != int(np.prod(want)):
+            raise CheckpointError(
+                f"{path!r}: array {key!r} is only partially covered by "
+                f"the shard files ({covered.get(key, 0)} of "
+                f"{int(np.prod(want))} elements) — a writer's shard "
+                f"file is missing")
+    return flat
 
 
 def restore_checkpoint(path: str, model=None,
@@ -309,23 +463,33 @@ def restore_checkpoint(path: str, model=None,
                            jnp.asarray(ckpt["step"]))
         host_tables = ckpt.get("host_tables", {}) or {}
     else:
-        import zipfile
-        npz_path = os.path.join(path, "state.npz")
-        try:
-            data = np.load(npz_path)
-        except FileNotFoundError:
-            raise CheckpointError(
-                f"{path!r} has no state.npz (meta.json says format="
-                f"'npz') — the save was killed before the state was "
-                f"written") from None
-        except (ValueError, OSError, zipfile.BadZipFile) as e:
-            raise CheckpointError(
-                f"{npz_path!r} is unreadable ({e}) — truncated or "
-                f"corrupt state payload") from e
+        if meta["format"] == "podshard":
+            # multi-host layout: reassemble the full host-logical
+            # arrays from EVERY process' shard file — the directory is
+            # self-contained, so any fleet shape (including fewer
+            # hosts than wrote it) can restore; placement below
+            # re-shards under the RESTORING topology
+            data = _load_pod_shards(path)
+            files = sorted(data)
+        else:
+            import zipfile
+            npz_path = os.path.join(path, "state.npz")
+            try:
+                data = np.load(npz_path)
+            except FileNotFoundError:
+                raise CheckpointError(
+                    f"{path!r} has no state.npz (meta.json says format="
+                    f"'npz') — the save was killed before the state was "
+                    f"written") from None
+            except (ValueError, OSError, zipfile.BadZipFile) as e:
+                raise CheckpointError(
+                    f"{npz_path!r} is unreadable ({e}) — truncated or "
+                    f"corrupt state payload") from e
+            files = data.files
         groups: dict = {"params": {}, "opt_state": {}, "bn_state": {},
                         "host_tables": {}}
         rng = step = None
-        for k in data.files:
+        for k in files:
             if k == "rng":
                 rng = jnp.asarray(data[k])
             elif k == "step":

@@ -1,4 +1,4 @@
-"""Multi-host distributed execution.
+"""Multi-host distributed execution (docs/distributed.md).
 
 TPU-native equivalent of the reference's multi-node story (reference:
 GASNet transport README.md:20; control replication + sharding functor
@@ -11,7 +11,11 @@ On TPU pods the transport is ICI within a slice and DCN across slices;
 the same Mesh/pjit code then spans hosts with zero changes — the moral
 equivalent of Legion control replication.  Per-host data feeding uses
 ``host_local_batch`` (each host loads its shard of the global batch, the
-analogue of DataParallelShardingFunctor's last-dim sharding).
+analogue of DataParallelShardingFunctor's last-dim sharding);
+:class:`HostShardLoader` packages that as a loader any training loop
+(and the PrefetchLoader, docs/pipeline.md) can consume, and
+:func:`pod_topology` reports the slice/DCN structure the two-level
+simulator cost model (``sim.cost_model.PodTopology``) prices.
 """
 
 from __future__ import annotations
@@ -29,7 +33,9 @@ def initialize(coordinator_address: Optional[str] = None,
     """Bootstrap multi-host JAX (one call per host process, before any
     device use).  Arguments default from the standard env vars
     (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) or the TPU pod
-    metadata when running on Cloud TPU.  Returns topology info."""
+    metadata when running on Cloud TPU.  Returns topology info and
+    emits one ``distributed`` ``phase="init"`` telemetry event so a
+    recorded run says which process of how many produced it."""
     if num_processes is None:
         num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
     if num_processes > 1 or coordinator_address is not None:
@@ -40,24 +46,76 @@ def initialize(coordinator_address: Optional[str] = None,
             process_id=process_id
             if process_id is not None
             else int(os.environ.get("PROCESS_ID", "0")))
-    return topology()
+    info = topology()
+    # telemetry sits a layer above this foundation module — deferred
+    # import is the sanctioned break (analysis/passes/layering.py)
+    from .telemetry import emit
+    emit("distributed", phase="init", **info)
+    return info
 
 
 def topology() -> dict:
     """Global/local device layout (the reference prints
-    workersPerNode/numNodes at startup, alexnet.cc:46-48)."""
+    workersPerNode/numNodes at startup, alexnet.cc:46-48).  ``slices``
+    is the ICI/DCN hierarchy's top level (:func:`pod_topology`)."""
+    pod = pod_topology()
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "global_devices": jax.device_count(),
         "local_devices": jax.local_device_count(),
+        "slices": pod.num_slices,
     }
+
+
+def pod_topology():
+    """The running fleet's two-level interconnect shape as a
+    ``sim.cost_model.PodTopology`` — what the hierarchy-aware search
+    and the two-level simulator price (docs/distributed.md).
+
+    Real TPU pods expose ``slice_index`` per device and the metadata
+    is authoritative — distinct values are DCN-joined slices, and a
+    UNIFORM value means one ICI-connected slice even across hosts
+    (e.g. a multi-host v5e-16: 4 processes, every inter-host link
+    still ICI — pricing those hops as DCN would be ~3.6x wrong).
+    Off-TPU fleets report slice metadata that means nothing (CPU
+    devices all say slice 0), so multi-process CPU/GPU falls back to
+    one "slice" per host process — the process boundary IS the
+    slow-link boundary there.  A single process with no multi-slice
+    metadata is one flat slice."""
+    from .sim.cost_model import PodTopology
+
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    on_tpu = jax.default_backend() == "tpu"
+    if None not in slice_ids and (len(slice_ids) > 1 or on_tpu):
+        n = len(slice_ids)
+        return PodTopology(n, max(len(devices) // n, 1))
+    if jax.process_count() > 1:
+        return PodTopology(jax.process_count(),
+                           max(jax.local_device_count(), 1))
+    return PodTopology(1, max(len(devices), 1))
 
 
 def host_local_batch(global_batch: int) -> slice:
     """This host's slice of the global batch (the sharding-functor
-    equivalent: contiguous last-dim... here first-dim blocks per host)."""
-    per_host = global_batch // jax.process_count()
+    equivalent: contiguous first-dim blocks per host).
+
+    CONTRACT: ``global_batch`` must divide evenly by the process
+    count.  A remainder used to be dropped silently — every host fed
+    ``global_batch // n`` rows and the tail rows of every batch simply
+    vanished from training; now it refuses loudly.  Callers pad the
+    batch (or pick a divisible global batch) explicitly — an invisible
+    data loss is never an acceptable default."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} does not divide over "
+            f"{n} host processes ({global_batch % n} rows would be "
+            f"silently dropped) — pad the batch or choose a "
+            f"process-count-divisible global batch "
+            f"(docs/distributed.md)")
+    per_host = global_batch // n
     lo = jax.process_index() * per_host
     return slice(lo, lo + per_host)
 
@@ -72,3 +130,86 @@ def make_global_array(host_shard: np.ndarray, mesh, pspec):
         host_shard.shape[1:]
     return jax.make_array_from_process_local_data(
         sharding, host_shard, global_shape)
+
+
+class HostShardLoader:
+    """Per-host view of a global-batch loader (docs/distributed.md).
+
+    Wraps any loader yielding ``(inputs_dict, labels)`` host batches of
+    the GLOBAL batch size: each host keeps only its
+    :func:`host_local_batch` rows and assembles the globally-sharded
+    ``jax.Array`` via :func:`make_global_array` under ``mesh`` — so
+    every process materializes (and, wrapped in a
+    :class:`~dlrm_flexflow_tpu.data.prefetch.PrefetchLoader`, prefetches)
+    only ``1/process_count`` of each batch while the training step sees
+    one global array, exactly like the single-process path.  On one
+    process it degrades to a pass-through assembly of the full batch.
+
+    The wrapped loader yields the full global batch on every host (the
+    CPU-emulation contract — deterministic across processes because
+    every host runs the same loader with the same seed); an out-of-core
+    loader (ROADMAP item 4) would instead read only its own rows and
+    skip the slicing.  Resume (``state_dict``/``load_state_dict``) and
+    the shape attributes proxy the inner loader, so the PrefetchLoader
+    wrap-contract applies unchanged."""
+
+    def __init__(self, loader, mesh, pspec=None):
+        from jax.sharding import PartitionSpec
+
+        self._inner = loader
+        self.mesh = mesh
+        self.pspec = pspec if pspec is not None else PartitionSpec("data")
+
+    def _global(self, arr):
+        sl = host_local_batch(int(arr.shape[0]))
+        return make_global_array(np.asarray(arr[sl]), self.mesh,
+                                 self.pspec)
+
+    def __iter__(self):
+        for inputs, labels in self._inner:
+            yield ({k: self._global(v) for k, v in inputs.items()},
+                   self._global(labels))
+
+    def peek(self):
+        # placed exactly like an iterated batch: fit's warmup peek
+        # must see the SAME input sharding the loop batches arrive
+        # with, or the warmup trace compiles a second program
+        inputs, labels = self._inner.peek()
+        return ({k: self._global(v) for k, v in inputs.items()},
+                self._global(labels))
+
+    # ------------------------------------------------------------- resume
+    def state_dict(self):
+        sd = getattr(self._inner, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def load_state_dict(self, sd) -> None:
+        self._inner.load_state_dict(sd)
+
+    # ------------------------------------------------- shape passthroughs
+    @property
+    def num_batches(self) -> int:
+        return self._inner.num_batches
+
+    @property
+    def batch_size(self) -> int:
+        return self._inner.batch_size
+
+    @property
+    def inputs(self):
+        return getattr(self._inner, "inputs", None)
+
+    @property
+    def labels(self):
+        return getattr(self._inner, "labels", None)
+
+    @property
+    def drop_last(self):
+        return getattr(self._inner, "drop_last", False)
+
+    @property
+    def shuffle(self):
+        return getattr(self._inner, "shuffle", False)
+
+    def __len__(self):
+        return len(self._inner)

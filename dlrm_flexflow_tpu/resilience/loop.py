@@ -52,6 +52,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..checkpoint import _local_value
 from ..data.prefetch import PrefetchLoader
 from ..metrics import MetricsAccumulator
 from ..telemetry import active_log, sample_memory
@@ -165,7 +166,11 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             dataloader.load_state_dict(extra["loader"])
         start_epoch = int(extra.get("epoch", 0))
 
-    global_step = int(np.asarray(state.step))
+    # _local_value, not np.asarray: on a multi-process fleet the step
+    # (and the loss folds below) are replicated-but-not-fully-
+    # addressable global arrays — np.asarray raises on those
+    # (docs/distributed.md; the same read CheckpointManager.save uses)
+    global_step = int(_local_value(state.step))
     donate = sentinel is None  # rejection needs the pre-dispatch state live
     # hetero CPU tables are updated IN the dispatch (host-side SGD after
     # the backward callback) — a rejection must roll them back too.
@@ -239,7 +244,7 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             new_state, mets = model.train_step(retry_state, binputs,
                                                blabels, donate=False)
             dispatch_s[0] += time.perf_counter() - td
-            loss_f = float(np.asarray(mets["loss"]))
+            loss_f = float(_local_value(mets["loss"]))
             if sentinel.observe(loss_f, new_state, step=p.step, lr=lr):
                 rspan.end()
                 state = new_state
@@ -267,7 +272,7 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         p, pending[0] = pending[0], None
         if p is None:
             return True
-        loss_f = float(np.asarray(p.mets["loss"]))
+        loss_f = float(_local_value(p.mets["loss"]))
         if sentinel is None or sentinel.observe(loss_f, p.new_state,
                                                 step=p.step, lr=p.lr):
             p.span.end()

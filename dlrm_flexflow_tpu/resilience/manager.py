@@ -168,13 +168,27 @@ class CheckpointManager:
 
     def __init__(self, directory: str, keep_n: int = 3, retries: int = 2,
                  backoff_s: float = 0.05, use_orbax: Optional[bool] = None,
-                 fsync: bool = True):
+                 fsync: bool = True, multihost: Optional[bool] = None):
         self.directory = str(directory)
         self.keep_n = max(1, int(keep_n))
         self.retries = max(0, int(retries))
         self.backoff_s = float(backoff_s)
         self.use_orbax = use_orbax
         self.fsync = fsync
+        # multi-host pod mode (docs/distributed.md): every process
+        # writes its own shard files into one shared directory, process
+        # 0 commits the single cross-host manifest.  None = auto-detect
+        # (on when jax runs >1 process).  Multihost saves are
+        # single-attempt: the write is fenced by cross-host barriers,
+        # and a per-process retry loop would deadlock the peers parked
+        # at them — one lost save still never loses the run.
+        self.multihost = multihost
+
+    def _is_multihost(self) -> bool:
+        if self.multihost is not None:
+            return bool(self.multihost)
+        import jax
+        return jax.process_count() > 1
 
     # ------------------------------------------------------------------ save
     def save(self, state, model=None, extra: Optional[Dict[str, Any]] = None,
@@ -185,7 +199,8 @@ class CheckpointManager:
         training run continues (only :class:`faultinject.Preemption`,
         i.e. a simulated/real kill, propagates)."""
         if step is None:
-            step = int(np.asarray(state.step))
+            from ..checkpoint import _local_value
+            step = int(_local_value(state.step))
         t0 = time.perf_counter()
         # ckpt.save span parents to the caller's ambient span (the
         # resilient loop's epoch/fit span) — the training trace shows
@@ -193,6 +208,47 @@ class CheckpointManager:
         # abandons it, like every other bookkeeping of a killed run.
         sspan = start_span("ckpt.save", attrs={"step": step})
         last_err: Optional[BaseException] = None
+
+        # ONE success / ONE failure epilogue shared by the single-host
+        # retry loop and the multihost single-attempt branch, so the
+        # save telemetry (event, counter, span) cannot drift between
+        # them
+        def committed(final: str, attempt: int,
+                      sweep: bool = True) -> str:
+            if sweep:
+                self.gc()
+            emit("checkpoint", action="save", step=step, path=final,
+                 duration_s=time.perf_counter() - t0, attempt=attempt,
+                 files=len(_walk_files(final)))
+            _tmetrics.note_checkpoint_save()
+            sspan.set_attr("attempt", attempt)
+            sspan.end()
+            return final
+
+        def failed(err: BaseException, attempt: int,
+                   what: str) -> None:
+            emit("checkpoint", action="save_failed", step=step,
+                 attempt=attempt, error=repr(err),
+                 duration_s=time.perf_counter() - t0)
+            sspan.set_attr("error", repr(err))
+            sspan.end(status="error")
+            import sys
+            print(f"# {what} checkpoint save failed, continuing "
+                  f"without it: {err!r}", file=sys.stderr)
+            return None
+
+        if self._is_multihost():
+            # one attempt, barrier-fenced (see __init__) — a failure
+            # logs and returns None like an exhausted single-host retry
+            try:
+                final = self._write_and_commit_multihost(state, model,
+                                                         extra, step)
+            except Exception as e:  # noqa: BLE001 — never abort the run
+                return failed(e, 0, "multihost")
+            import jax
+            # one sweeper (process 0) — concurrent rmtree would race
+            return committed(final, 0,
+                             sweep=jax.process_index() == 0)
         for attempt in range(self.retries + 1):
             if attempt:
                 emit("checkpoint", action="retry", step=step,
@@ -207,24 +263,10 @@ class CheckpointManager:
                 # gc()/latest_checkpoint() to tolerate.
                 last_err = e
                 continue
-            self.gc()
-            emit("checkpoint", action="save", step=step, path=final,
-                 duration_s=time.perf_counter() - t0, attempt=attempt,
-                 files=len(_walk_files(final)))
-            _tmetrics.note_checkpoint_save()
-            sspan.set_attr("attempt", attempt)
-            sspan.end()
-            return final
-        emit("checkpoint", action="save_failed", step=step,
-             attempt=self.retries, error=repr(last_err),
-             duration_s=time.perf_counter() - t0)
-        sspan.set_attr("error", repr(last_err))
-        sspan.end(status="error")
-        import sys
-        print(f"# checkpoint save failed after {self.retries + 1} "
-              f"attempts, continuing without it: {last_err!r}",
-              file=sys.stderr)
-        return None
+            return committed(final, attempt)
+        return failed(
+            last_err, self.retries,
+            f"(after {self.retries + 1} attempts)")
 
     def _write_and_commit(self, state, model, extra, step: int) -> str:
         os.makedirs(self.directory, exist_ok=True)
@@ -270,6 +312,121 @@ class CheckpointManager:
         os.rename(tmp, final)  # THE commit
         if self.fsync:
             _fsync_dir(self.directory)
+        return final
+
+    def _barrier(self, tag: str, pidx: int, nproc: int,
+                 timeout_s: float = 300.0) -> None:
+        """Shared-filesystem barrier: each process drops a marker file
+        under ``.barrier-<tag>/`` and waits until all ``nproc`` are
+        present.  Every process creates its marker BEFORE polling, so
+        once anyone counts ``nproc`` the set is complete — a later
+        sweep of the directory (gc, or the next save) therefore reads
+        as "barrier passed" to stragglers still polling.  File-based
+        because the checkpoint directory is already assumed shared
+        (the orbax assumption) and device collectives may not exist
+        between training steps on every backend (this container's CPU
+        jaxlib has none — docs/distributed.md)."""
+        bdir = os.path.join(self.directory, f".barrier-{tag}")
+        os.makedirs(bdir, exist_ok=True)
+        with open(os.path.join(bdir, f"p{pidx}"), "w"):
+            pass
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                seen = len(os.listdir(bdir))
+            except FileNotFoundError:
+                return  # swept by a process that counted everyone
+            if seen >= nproc:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"multihost checkpoint barrier {tag!r}: only "
+                    f"{seen}/{nproc} processes arrived within "
+                    f"{timeout_s:.0f}s — a peer died mid-save")
+            time.sleep(0.01)
+
+    def _write_and_commit_multihost(self, state, model, extra,
+                                    step: int) -> str:
+        """The pod commit protocol (docs/distributed.md): every process
+        writes its own ``shard-pNNN`` pair into ONE shared tmp dir
+        (the directory must be a shared filesystem — the same
+        assumption orbax makes), then process 0 alone writes the
+        cross-host manifest over ALL files and publishes with the one
+        atomic rename.  Barriers fence the three phases so the
+        manifest can never hash a shard still being written and no
+        process returns before the commit is visible.  ``save`` is a
+        COLLECTIVE call: every process must call it for the same
+        step, in the same order."""
+        import jax
+
+        pidx, nproc = jax.process_index(), jax.process_count()
+        self._mh_saves = getattr(self, "_mh_saves", 0) + 1
+        tag = f"{step}-{self._mh_saves}"
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(self.directory, f"tmp-{step}-mh")
+        final = os.path.join(self.directory, f"ckpt-{step}")
+        if pidx == 0:
+            # sweep fences of PAST saves only (tag-mismatched): this
+            # save's -tmp fence may already hold a fast peer's marker
+            for name in os.listdir(self.directory):
+                if name.startswith(".barrier-") \
+                        and not name.startswith(f".barrier-{tag}-"):
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+        self._barrier(f"{tag}-tmp", pidx, nproc)
+        save_checkpoint(tmp, state, step=step, model=model,
+                        multihost=True)
+        # the same injection window as the single-host path: a kill
+        # here leaves tmp debris the (process-0) gc sweeps
+        faultinject.maybe_io_error("save", step=step)
+        faultinject.maybe_preempt("save", step=step)
+        if self.fsync:
+            for name in os.listdir(tmp):
+                if name.startswith(f"shard-p{pidx:03d}") \
+                        or (pidx == 0
+                            and not name.startswith("shard-")):
+                    _fsync_file(os.path.join(tmp, name))
+        self._barrier(f"{tag}-written", pidx, nproc)
+        if pidx == 0:
+            if extra is not None:
+                with open(os.path.join(tmp, EXTRA), "w") as f:
+                    json.dump(extra, f)
+            files = _walk_files(tmp)
+            manifest = {"step": step,
+                        "files": {rel: _sha256(os.path.join(tmp, rel))
+                                  for rel in files}}
+            with open(os.path.join(tmp, MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+            if self.fsync:
+                for rel in [EXTRA] * bool(extra is not None) + [MANIFEST]:
+                    _fsync_file(os.path.join(tmp, rel))
+                _fsync_dir(tmp)
+            if os.path.isdir(final):
+                # same never-un-publish rule as the single-host commit
+                if not verify_checkpoint(final):
+                    shutil.rmtree(tmp)
+                else:
+                    shutil.rmtree(final)
+            if os.path.isdir(tmp):
+                os.rename(tmp, final)  # THE commit
+            if self.fsync:
+                _fsync_dir(self.directory)
+        self._barrier(f"{tag}-commit", pidx, nproc)
+        if pidx == 0:
+            # sweep THIS save's fences: safe once everyone reached the
+            # commit barrier (a straggler still polling reads a missing
+            # dir as "passed"), and the next save's fences carry a
+            # different tag — so the LAST save of a run leaves no
+            # .barrier-* debris behind (the prologue sweep above only
+            # covers runs that save again)
+            for phase in ("tmp", "written", "commit"):
+                shutil.rmtree(
+                    os.path.join(self.directory,
+                                 f".barrier-{tag}-{phase}"),
+                    ignore_errors=True)
         return final
 
     # --------------------------------------------------------------- restore
@@ -326,8 +483,16 @@ class CheckpointManager:
             names = os.listdir(self.directory)
         except (FileNotFoundError, NotADirectoryError):
             names = []
+        # .barrier-* dirs are the multihost commit fences.  Sweeping
+        # them here is safe only when no multihost save can be in
+        # flight (a peer may have pre-created the NEXT save's fence;
+        # deleting a fence that has not collected every marker would
+        # let a straggler read "missing = passed" early) — in
+        # multihost mode the save prologue sweeps stale fences by tag.
+        sweep_barriers = not self._is_multihost()
         for name in names:
-            if name.startswith("tmp-") or name.endswith(".old"):
+            if name.startswith("tmp-") or name.endswith(".old") \
+                    or (sweep_barriers and name.startswith(".barrier-")):
                 shutil.rmtree(os.path.join(self.directory, name),
                               ignore_errors=True)
                 removed_tmp += 1

@@ -1,7 +1,7 @@
-from .cost_model import CostModel, TPUMachineModel
+from .cost_model import CostModel, PodTopology, TPUMachineModel
 from .simulator import Simulator
 from .search import mcmc_search
 from .tune import Calibration, fit_calibration, search_tune
 
-__all__ = ["CostModel", "TPUMachineModel", "Simulator", "mcmc_search",
-           "Calibration", "fit_calibration", "search_tune"]
+__all__ = ["CostModel", "PodTopology", "TPUMachineModel", "Simulator",
+           "mcmc_search", "Calibration", "fit_calibration", "search_tune"]

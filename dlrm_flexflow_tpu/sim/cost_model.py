@@ -27,9 +27,87 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+@dataclass(frozen=True)
+class PodTopology:
+    """Two-level pod interconnect shape: ``num_slices`` ICI slices of
+    ``chips_per_slice`` chips each, joined by DCN (docs/distributed.md).
+
+    The reference prices inter-node links separately from intra-node
+    ones (simulator.cu:27-29: inter-GPU 20 MB/ms vs inter-node
+    12 MB/ms); on TPU the analogue is ICI within a slice vs the ~4x
+    slower DCN across slices.  Flat device ids map to slices
+    contiguously: device ``d`` lives on slice ``d // chips_per_slice``
+    — the order ``jax.devices()`` lists a pod.  ``num_slices=1``
+    degrades to today's flat model (every transfer is ICI) and is
+    priced BIT-identically to a topology-less machine, pinned by
+    tests/test_pod.py."""
+
+    num_slices: int = 1
+    chips_per_slice: int = 1
+
+    def __post_init__(self):
+        if int(self.num_slices) < 1 or int(self.chips_per_slice) < 1:
+            raise ValueError(
+                f"PodTopology needs >=1 slices of >=1 chips, got "
+                f"{self.num_slices}x{self.chips_per_slice}")
+        object.__setattr__(self, "num_slices", int(self.num_slices))
+        object.__setattr__(self, "chips_per_slice",
+                           int(self.chips_per_slice))
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_slices * self.chips_per_slice
+
+    def slice_of(self, device: int) -> int:
+        """The slice a flat device id lives on (ids beyond the pod fold
+        modulo, matching the simulator's ``dev % num_devices``)."""
+        return (int(device) % self.num_devices) // self.chips_per_slice
+
+    def same_slice(self, a: int, b: int) -> bool:
+        return self.slice_of(a) == self.slice_of(b)
+
+    def slices_spanned(self, devices: Sequence[int]) -> int:
+        """How many distinct slices a device list touches (>=1)."""
+        if not devices:
+            return 1
+        return len({self.slice_of(d) for d in devices})
+
+    def local_group(self, devices: Sequence[int]) -> int:
+        """Largest per-slice participant count of a device list — the
+        within-slice group size the hierarchical collectives ring
+        over."""
+        if not devices:
+            return 1
+        counts: Dict[int, int] = {}
+        for d in devices:
+            s = self.slice_of(d)
+            counts[s] = counts.get(s, 0) + 1
+        return max(counts.values())
+
+    def to_json(self) -> dict:
+        return {"num_slices": self.num_slices,
+                "chips_per_slice": self.chips_per_slice}
+
+    @staticmethod
+    def from_json(d: dict) -> "PodTopology":
+        return PodTopology(int(d["num_slices"]),
+                           int(d["chips_per_slice"]))
+
+    @staticmethod
+    def parse(spec: str) -> "PodTopology":
+        """``"<slices>x<chips>"`` (e.g. ``"2x4"``) -> PodTopology."""
+        try:
+            s, c = spec.lower().split("x")
+            return PodTopology(int(s), int(c))
+        except (ValueError, AttributeError):
+            raise ValueError(
+                f"pod topology spec must look like '2x4' "
+                f"(slices x chips-per-slice), got {spec!r}") from None
 
 
 @dataclass
@@ -37,7 +115,10 @@ class TPUMachineModel:
     """TPU chip/interconnect constants (defaults ~ v5e).
 
     Replaces reference simulator.cu:27-29.  All bandwidths bytes/sec,
-    compute FLOP/sec.
+    compute FLOP/sec.  ``topology`` (a :class:`PodTopology`) makes the
+    collective and transfer estimates two-level: ICI within a slice,
+    DCN across slices.  ``None`` keeps the flat single-slice model —
+    every existing call site prices exactly as before.
     """
 
     name: str = "tpu-v5e"
@@ -49,6 +130,7 @@ class TPUMachineModel:
     ici_links_per_chip: int = 4
     dcn_bandwidth: float = 12.5e9     # per host
     kernel_launch_overhead: float = 2e-6  # fused-step dispatch amortized
+    topology: Optional[PodTopology] = None
 
     def matmul_time(self, flops: float, dtype: str = "bfloat16") -> float:
         peak = (self.peak_flops_bf16 if dtype in ("bfloat16", "bf16")
@@ -63,22 +145,80 @@ class TPUMachineModel:
         """One neighbour transfer on the ICI ring (per-axis bidirectional)."""
         return hops * bytes_moved / self.ici_bandwidth
 
-    def all_reduce_time(self, bytes_per_chip: float, n: int) -> float:
-        """Ring all-reduce: 2(n-1)/n * bytes over one ICI link."""
-        if n <= 1:
-            return 0.0
-        return self.ici_time(2.0 * (n - 1) / n * bytes_per_chip)
+    def xfer_time(self, bytes_moved: float, src: Optional[int] = None,
+                  dst: Optional[int] = None) -> float:
+        """One point-to-point transfer, routed by the pod topology:
+        ICI when ``src``/``dst`` share a slice (or no topology / no
+        device info is available — the flat model), DCN when they
+        cross slices.  The simulator prices every producer->consumer
+        comm task through this, so a cross-slice hop costs the ~4x
+        slower link instead of the flat ``ici_time``."""
+        t = self.topology
+        if (t is None or t.num_slices <= 1 or src is None or dst is None
+                or t.same_slice(src, dst)):
+            return self.ici_time(bytes_moved)
+        return self.dcn_time(bytes_moved)
 
-    def all_gather_time(self, bytes_per_chip: float, n: int) -> float:
-        if n <= 1:
-            return 0.0
-        return self.ici_time((n - 1) / n * bytes_per_chip * n)
+    # Collective group shape: ``devices`` (when the caller knows the
+    # placement — the simulator's grad sync does) pins which slices
+    # participate; without it the flat-id contiguity assumption applies:
+    # n participants fill ceil(n / chips_per_slice) slices.
+    def _group(self, n: int, devices: Optional[Sequence[int]]
+               ) -> Tuple[int, int]:
+        """(slices_spanned, within_slice_group) for an n-chip collective."""
+        t = self.topology
+        if t is None or t.num_slices <= 1 or n <= 1:
+            return 1, n
+        if devices:
+            return t.slices_spanned(devices), t.local_group(devices)
+        s = min(t.num_slices, -(-n // t.chips_per_slice))  # ceil
+        return s, min(n, t.chips_per_slice)
 
-    def all_to_all_time(self, bytes_per_chip: float, n: int) -> float:
-        """All-to-all over the ring: each chip sends (n-1)/n of its shard."""
+    def all_reduce_time(self, bytes_per_chip: float, n: int,
+                        devices: Optional[Sequence[int]] = None) -> float:
+        """Ring all-reduce: 2(n-1)/n * bytes over one ICI link when the
+        group sits inside one slice.  Spanning slices it goes
+        hierarchical (the canonical two-level all-reduce —
+        docs/distributed.md): ring reduce-scatter within each slice
+        over ICI, a cross-slice all-reduce of the scattered 1/m shard
+        over DCN, and the ICI broadcast (all-gather) back."""
         if n <= 1:
             return 0.0
-        return self.ici_time(bytes_per_chip * (n - 1) / n)
+        s, m = self._group(n, devices)
+        if s <= 1:
+            return self.ici_time(2.0 * (n - 1) / n * bytes_per_chip)
+        m = max(m, 1)
+        within = 2.0 * self.ici_time((m - 1) / m * bytes_per_chip)
+        across = self.dcn_time(2.0 * (s - 1) / s * bytes_per_chip / m)
+        return within + across
+
+    def all_gather_time(self, bytes_per_chip: float, n: int,
+                        devices: Optional[Sequence[int]] = None) -> float:
+        if n <= 1:
+            return 0.0
+        s, m = self._group(n, devices)
+        if s <= 1:
+            return self.ici_time((n - 1) / n * bytes_per_chip * n)
+        m = max(m, 1)
+        # within-slice all-gather, DCN exchange of each slice's block to
+        # the s-1 peers, ICI broadcast of the foreign blocks
+        within = self.ici_time((m - 1) * bytes_per_chip)
+        across = self.dcn_time((s - 1) * m * bytes_per_chip)
+        bcast = self.ici_time((s - 1) * m * bytes_per_chip)
+        return within + across + bcast
+
+    def all_to_all_time(self, bytes_per_chip: float, n: int,
+                        devices: Optional[Sequence[int]] = None) -> float:
+        """All-to-all over the ring: each chip sends (n-1)/n of its
+        shard; on a pod the cross-slice fraction (n-m)/n rides DCN."""
+        if n <= 1:
+            return 0.0
+        s, m = self._group(n, devices)
+        if s <= 1:
+            return self.ici_time(bytes_per_chip * (n - 1) / n)
+        m = max(m, 1)
+        return (self.ici_time(bytes_per_chip * (m - 1) / n)
+                + self.dcn_time(bytes_per_chip * (n - m) / n))
 
     def dcn_time(self, bytes_moved: float) -> float:
         return bytes_moved / self.dcn_bandwidth

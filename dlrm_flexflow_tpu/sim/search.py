@@ -33,13 +33,46 @@ def _factorizations(n: int, ndim: int) -> List[tuple]:
     return out
 
 
+def placement_variants(n: int, num_devices: int,
+                       topology=None) -> List[List[int]]:
+    """Candidate device lists for an ``n``-part op on a (possibly
+    sliced) pod — the "O" of SOAP at pod scale.  Flat machines (no
+    topology / one slice) have one canonical placement, ``range(n)``:
+    every permutation prices identically under a single link class, so
+    enumerating more would only bloat the chain's proposal set.  On a
+    multi-slice :class:`~..sim.cost_model.PodTopology` the SAME parts
+    can land packed (``range(n)`` — consecutive parts share a slice)
+    or strided (consecutive parts on different slices), and the
+    two-level cost model prices the resulting ICI-vs-DCN crossings
+    differently; both variants join the proposal set so ``mcmc_search``
+    can move a part's device list within/across slices (the per-node
+    strategy freedom of the reference's mapper, mapper.cc:222-322)."""
+    packed = list(range(n))
+    if (topology is None or topology.num_slices <= 1 or n <= 1
+            or n > num_devices):
+        return [packed]
+    cps = topology.chips_per_slice
+    # strided: walk slice-by-slice through same-index chips (0, cps,
+    # 2*cps, ..., 1, cps+1, ...) so consecutive parts cross slices
+    order = [s * cps + c for c in range(cps)
+             for s in range(topology.num_slices)]
+    strided = [d for d in order if d < num_devices][:n]
+    if strided == packed or len(strided) < n:
+        return [packed]
+    return [packed, strided]
+
+
 def legal_configs(op, num_devices: int,
-                  max_dims: Optional[int] = None) -> List[ParallelConfig]:
+                  max_dims: Optional[int] = None,
+                  topology=None) -> List[ParallelConfig]:
     """Candidate ParallelConfigs for an op (reference model.cc:295-324
     samples one; we enumerate to give the chain a uniform proposal set).
 
     Legality: every partition count must divide the corresponding output
-    dim; device counts are divisors of num_devices.
+    dim; device counts are divisors of num_devices.  With a multi-slice
+    ``topology`` each partitioning additionally appears once per
+    distinct device placement (:func:`placement_variants`), so the
+    chain can trade a DCN crossing for an ICI hop.
     """
     shape = op.outputs[0].shape
     ndim = len(shape)
@@ -57,8 +90,9 @@ def legal_configs(op, num_devices: int,
             if full in seen:
                 continue
             seen.add(full)
-            cands.append(ParallelConfig(
-                dims=full, device_ids=list(range(n))))
+            for devs in placement_variants(n, num_devices, topology):
+                cands.append(ParallelConfig(dims=full,
+                                            device_ids=list(devs)))
     return cands
 
 
@@ -84,7 +118,8 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
                 on_iteration: Optional[Callable] = None,
                 backend: str = "auto",
                 measure: Optional[bool] = None,
-                measure_budget_s: float = 300.0) -> Strategy:
+                measure_budget_s: float = 300.0,
+                topology=None) -> Strategy:
     """Simulated-annealing search (reference model.cc:1093-1144).
 
     Returns the best Strategy found; ``model.strategy`` is not mutated.
@@ -100,8 +135,19 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
     reference's approach — real kernels on simulator scratch,
     simulator.cc:235-273, linear.cu:973-1049); elsewhere (CPU test
     meshes) the analytic roofline is used.
+
+    ``topology`` (a :class:`~.cost_model.PodTopology`) makes the search
+    hierarchy-aware (docs/distributed.md): the proposal set grows
+    slice-aware placement moves (a part's device list remapped
+    within/across slices, :func:`placement_variants`) and — when no
+    ``simulator`` is passed — the default cost model prices transfers
+    two-level (ICI within a slice, DCN across), so the chain can
+    discover the canonical pod strategy (table-parallel within a
+    slice, data-parallel across).  A multi-slice topology forces the
+    Python backend: the native chain's machine model is flat.
     """
     rng = random.Random(seed)
+    sliced = topology is not None and topology.num_slices > 1
 
     # ``measure``: None = auto (measure on a real TPU; previously this
     # auto-measurement could silently spend up to measure_budget_s
@@ -116,23 +162,37 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
     if simulator is None and measure is not False:
         import jax
 
-        from .cost_model import CostModel
+        from .cost_model import CostModel, TPUMachineModel
         if measure or jax.default_backend() == "tpu":
-            cost_model = CostModel(measure=True,
-                                   measure_budget_s=measure_budget_s)
+            # measured COMPUTE costs; comm tasks still price through
+            # the machine model, so it must know the slice structure
+            cost_model = CostModel(
+                machine=(TPUMachineModel(topology=topology)
+                         if sliced else None),
+                measure=True, measure_budget_s=measure_budget_s)
+    if simulator is None and cost_model is None and sliced:
+        from .cost_model import CostModel, TPUMachineModel
+        cost_model = CostModel(
+            machine=TPUMachineModel(topology=topology))
 
     # start from data-parallel (reference model.cc:1102)
     current = data_parallel_strategy(model, num_devices)
 
-    candidates = {op.name: legal_configs(op, num_devices)
+    candidates = {op.name: legal_configs(op, num_devices,
+                                         topology=topology)
                   for op in model.layers}
 
     if backend == "native" and on_iteration is not None:
         raise ValueError("on_iteration callbacks require backend='python' "
                          "(the native chain reports only the final best)")
+    if backend == "native" and sliced:
+        raise ValueError("a multi-slice topology requires "
+                         "backend='python' (the native chain prices a "
+                         "flat machine and would ignore the slice "
+                         "structure)")
     want_native = (backend == "native"
                    or (backend == "auto" and simulator is None
-                       and on_iteration is None))
+                       and on_iteration is None and not sliced))
     if want_native:
         import subprocess
 
@@ -213,7 +273,14 @@ def mcmc_search(model, num_devices: int, budget: int = 1000,
             # what the simulator-guided search actually did — reference
             # FFModel::optimize only prints; docs/telemetry.md)
             log.emit("search", phase="iteration", it=it, op=op.name,
-                     dims=list(new_pc.dims), accepted=bool(accepted),
+                     dims=list(new_pc.dims),
+                     # the placement is part of the proposal on a
+                     # sliced pod (within- vs cross-slice device lists
+                     # price differently); flat searches omit it —
+                     # every placement is equivalent there
+                     **({"devices": list(new_pc.device_ids)}
+                        if sliced and new_pc.device_ids else {}),
+                     accepted=bool(accepted),
                      current_s=current_time, best_s=best_time)
         if on_iteration is not None:
             on_iteration(it, current_time, best_time)

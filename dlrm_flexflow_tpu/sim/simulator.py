@@ -167,14 +167,20 @@ class Simulator:
                             sf.add_next(df)
                             db.add_next(sb)
                         else:
+                            # two-level routing (PodTopology): a hop
+                            # between chips of one slice rides ICI, a
+                            # cross-slice hop the ~4x slower DCN —
+                            # without a topology xfer_time IS ici_time
                             ct = SimTask(f"{src.name}->{op.name}", ddev,
-                                         self.machine.ici_time(nbytes),
+                                         self.machine.xfer_time(
+                                             nbytes, sdev, ddev),
                                          "comm")
                             tasks.append(ct)
                             sf.add_next(ct)
                             ct.add_next(df)
                             cb = SimTask(f"{op.name}->{src.name}:grad", sdev,
-                                         self.machine.ici_time(nbytes),
+                                         self.machine.xfer_time(
+                                             nbytes, ddev, sdev),
                                          "comm")
                             tasks.append(cb)
                             db.add_next(cb)
@@ -210,7 +216,19 @@ class Simulator:
             # replicas all-reduce
             replicas = pc.dims[0] if pc.dims else 1
             shard = wbytes / max(k // max(replicas, 1), 1)
-            ar = self.machine.all_reduce_time(shard, replicas)
+            # which chips each replica group actually sits on decides
+            # whether the ring stays on ICI or pays the two-level DCN
+            # exchange (PodTopology): part index order is dim-0 fastest
+            # (ops/base.part_coords), so one group per non-batch
+            # coordinate = one contiguous run of the device list; the
+            # groups all-reduce concurrently, the slowest one is the
+            # modeled cost.  Flat machines price every group alike.
+            devs_all = [d % self.num_devices for d in _part_devices(pc)]
+            groups = [devs_all[g * replicas:(g + 1) * replicas]
+                      for g in range(max(k // max(replicas, 1), 1))]
+            ar = max(self.machine.all_reduce_time(shard, replicas,
+                                                  devices=g)
+                     for g in groups)
             dev0 = _part_devices(pc)[0]
             upd = SimTask(f"{op.name}:update", dev0,
                           self.machine.memory_time(2 * shard), "update")

@@ -511,27 +511,35 @@ def strategy_from_artifact(doc: dict) -> Strategy:
 
 
 def incumbent_path(artifacts_dir: str, app: str,
-                   num_devices: int) -> str:
+                   num_devices: int, topology=None) -> str:
     """The incumbent pointer is TOPOLOGY-SCOPED — one pointer per
     (app, device count), so a tune run on a laptop mesh can never
     evict the production 8-chip incumbent without ever benching
-    against it."""
+    against it.  The scope key grows the SLICE shape when the tune
+    ran under a multi-slice :class:`~.cost_model.PodTopology`
+    (``..._2x4pod.json`` — docs/tuning.md): a strategy whose
+    placements were chosen for one ICI/DCN hierarchy is priced wrong
+    on another, so pod lineages never share a pointer with flat ones
+    (single-slice topologies keep the legacy name unchanged)."""
+    pod = ""
+    if topology is not None and topology.num_slices > 1:
+        pod = f"_{topology.num_slices}x{topology.chips_per_slice}pod"
     return os.path.join(
         artifacts_dir,
-        f"strategy_incumbent_{app}_{int(num_devices)}dev.json")
+        f"strategy_incumbent_{app}_{int(num_devices)}dev{pod}.json")
 
 
 def load_incumbent(artifacts_dir: str, app: str,
-                   num_devices: int) -> Optional[dict]:
+                   num_devices: int, topology=None) -> Optional[dict]:
     """The currently-promoted strategy artifact for this topology, or
     None before its first promotion."""
-    p = incumbent_path(artifacts_dir, app, num_devices)
+    p = incumbent_path(artifacts_dir, app, num_devices, topology)
     if not os.path.exists(p):
         return None
     return load_strategy_artifact(p)
 
 
-def promote(artifacts_dir: str, doc: dict) -> str:
+def promote(artifacts_dir: str, doc: dict, topology=None) -> str:
     """Move the artifact's topology's incumbent pointer to ``doc`` (an
     atomic whole-artifact copy — the pointer file IS a valid strategy
     artifact, so consumers never chase a dangling path) and refresh
@@ -540,7 +548,8 @@ def promote(artifacts_dir: str, doc: dict) -> str:
     if errs:
         raise ValueError("refusing to promote invalid strategy "
                          "artifact: " + "; ".join(errs))
-    p = incumbent_path(artifacts_dir, doc["app"], doc["num_devices"])
+    p = incumbent_path(artifacts_dir, doc["app"], doc["num_devices"],
+                       topology)
     _atomic_write_json(p, doc)
     from ..telemetry.metrics import note_strategy_promotion
 
@@ -616,7 +625,7 @@ def search_tune(model, num_devices: int, telemetry_path: str,
                 artifacts_dir: str, *, app: str = "dlrm",
                 budget: int = 300, seed: int = 0, alpha: float = 0.05,
                 bench_fn: Optional[Callable[[dict], float]] = None,
-                tolerance_pct: float = 5.0) -> dict:
+                tolerance_pct: float = 5.0, topology=None) -> dict:
     """The closed loop, end to end: ingest -> recalibrate -> re-search
     -> versioned artifact -> gated promotion.  Returns a summary dict
     (what ``scripts/search_tune.py`` prints as its one JSON line).
@@ -635,9 +644,16 @@ def search_tune(model, num_devices: int, telemetry_path: str,
     topology runs its own lineage and gate — the first run on a new
     topology gates as ``"first"`` without touching any other
     topology's incumbent.  A hand-edited pointer whose content
-    contradicts its own name is skipped the same way."""
+    contradicts its own name is skipped the same way.
+
+    ``topology`` (a :class:`~.cost_model.PodTopology`) runs the whole
+    loop hierarchy-aware: the recalibrated simulator prices ICI/DCN
+    two-level, the search proposes slice-aware placements, and the
+    incumbent pointer's scope key grows the slice shape
+    (:func:`incumbent_path`) so pod and flat lineages never gate each
+    other."""
     from ..telemetry.report import load_events
-    from .cost_model import CostModel
+    from .cost_model import CostModel, TPUMachineModel
     from .search import mcmc_search
     from .simulator import Simulator
 
@@ -645,13 +661,16 @@ def search_tune(model, num_devices: int, telemetry_path: str,
     cal = fit_calibration(events, model, source=telemetry_path)
     cal_path = save_calibration_artifact(artifacts_dir, cal)
 
-    cost = CostModel(calibration=cal)
+    machine = (TPUMachineModel(topology=topology)
+               if topology is not None else None)
+    cost = CostModel(machine=machine, calibration=cal)
     sim = Simulator(model, num_devices, cost_model=cost)
     best = mcmc_search(model, num_devices, budget=budget, alpha=alpha,
-                       simulator=sim, seed=seed, backend="python")
+                       simulator=sim, seed=seed, backend="python",
+                       topology=topology)
     sim_step_s = sim.simulate(best)
 
-    incumbent = load_incumbent(artifacts_dir, app, num_devices)
+    incumbent = load_incumbent(artifacts_dir, app, num_devices, topology)
     path, doc = save_strategy_artifact(
         artifacts_dir, best, app=app, num_devices=num_devices,
         sim_step_s=sim_step_s, seed=seed, budget=budget,
@@ -672,10 +691,16 @@ def search_tune(model, num_devices: int, telemetry_path: str,
         tolerance_pct=tolerance_pct)
     promoted = verdict in ("first", "promoted")
     if promoted:
-        promote(artifacts_dir, doc)
+        promote(artifacts_dir, doc, topology)
     return {
         "strategy_path": path,
         "calibration_path": cal_path,
+        # the slice shape the loop ran under (None = flat) — provenance
+        # for the driver's JSON line; the incumbent pointer name
+        # carries the same scope (incumbent_path)
+        "pod": (topology.to_json()
+                if topology is not None and topology.num_slices > 1
+                else None),
         "version": doc["version"],
         "parent_version": doc["provenance"]["parent_version"],
         "verdict": verdict,

@@ -74,6 +74,14 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "dlrm_elastic_reshard_total": (
         "counter", "checkpoints restored across a topology change "
                    "(elastic.reshard_restore — docs/elastic.md)"),
+    "dlrm_process_index": (
+        "gauge", "this process' index in the multi-host fleet "
+                 "(jax.process_index; 0 single-host — "
+                 "docs/distributed.md)"),
+    "dlrm_process_count": (
+        "gauge", "host processes in the fleet (jax.process_count; a "
+                 "scraper joining per-host /metrics endpoints checks "
+                 "it saw them all — docs/distributed.md)"),
     "dlrm_train_steps_total": (
         "counter", "training dispatches adopted (global steps)"),
     "dlrm_train_samples_per_s": (
@@ -669,6 +677,32 @@ SERVE_REPLICAS = REGISTRY.register(
     Gauge("dlrm_serve_replicas", fn=_serve_replicas))
 ELASTIC_RESHARDS = REGISTRY.register(
     Counter("dlrm_elastic_reshard_total"))
+
+
+def _process_index() -> Optional[float]:
+    # pull-only, read at scrape time: a process joining a fleet late
+    # (distributed.initialize after the exporter started) still
+    # reports its real identity.  jax import deferred so a registry
+    # render in a jax-less tool context degrades to an absent sample.
+    try:
+        import jax
+        return float(jax.process_index())
+    except Exception:
+        return None
+
+
+def _process_count() -> Optional[float]:
+    try:
+        import jax
+        return float(jax.process_count())
+    except Exception:
+        return None
+
+
+PROCESS_INDEX = REGISTRY.register(
+    Gauge("dlrm_process_index", fn=_process_index))
+PROCESS_COUNT = REGISTRY.register(
+    Gauge("dlrm_process_count", fn=_process_count))
 TRAIN_STEPS = REGISTRY.register(Counter("dlrm_train_steps_total"))
 TRAIN_SAMPLES_PER_S = REGISTRY.register(
     Gauge("dlrm_train_samples_per_s"))

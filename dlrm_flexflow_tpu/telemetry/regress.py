@@ -105,6 +105,17 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         ms = h.get("mesh")
         if ms:
             name = f"{name}:mesh={ms}"
+        # multi-host / pod entries anchor per physical topology too
+        # (bench.py keys "hosts"/"slices" the same way): an N-host or
+        # N-slice run's collectives ride different links, so it never
+        # gates a single-host baseline (entries predating the fields
+        # count as 1)
+        hosts = h.get("hosts")
+        if hosts is not None and int(hosts) != 1:
+            name = f"{name}:hosts={hosts}"
+        sl = h.get("slices")
+        if sl is not None and int(sl) != 1:
+            name = f"{name}:slices={sl}"
         # later entries overwrite: the NEWEST anchors the gate.  Only
         # THIS entry's own derived riders are replaced — a plain-name
         # prefix sweep would also delete the ":quantize=..." anchors a

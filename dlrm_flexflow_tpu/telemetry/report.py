@@ -246,6 +246,28 @@ def memory_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+def distributed_summary(events: List[dict]) -> List[str]:
+    """The ``== distributed ==`` section (distributed.initialize,
+    docs/distributed.md): which process of how many produced this
+    run's telemetry, over how many devices and DCN slices — the
+    per-host identity a pod run's JSONL must carry so N host sinks
+    can be told apart."""
+    inits = [e for e in events if e.get("type") == "distributed"]
+    if not inits:
+        return []
+    lines = ["== distributed =="]
+    for e in inits:
+        line = (f"process {e.get('process_index', '?')}/"
+                f"{e.get('process_count', '?')}")
+        if "global_devices" in e:
+            line += (f": {e['global_devices']} global device(s), "
+                     f"{e.get('local_devices', '?')} local")
+        if e.get("slices"):
+            line += f", {e['slices']} slice(s)"
+        lines.append(line)
+    return lines
+
+
 def search_summary(events: List[dict]) -> List[str]:
     its = [e for e in events
            if e.get("type") == "search" and e.get("phase") == "iteration"]
@@ -623,6 +645,7 @@ def analysis_summary(doc: dict, src: str,
 #: text and JSON forms can never disagree about which sections a run has
 SECTIONS = (
     ("throughput", throughput_summary),
+    ("distributed", distributed_summary),
     ("per_op", per_op_table),
     ("calibration", calibration_summary),
     ("compile", compile_timeline),
@@ -748,6 +771,13 @@ def report_data(events: List[dict],
              for k in ("verdict", "version", "incumbent_version",
                        "candidate_s", "incumbent_s")
              if k in promos[-1]})
+    inits = by.get("distributed", [])
+    if inits:
+        headline["distributed"] = {
+            k: inits[-1][k]
+            for k in ("process_index", "process_count",
+                      "global_devices", "local_devices", "slices")
+            if k in inits[-1]}
     serves = by.get("serve", [])
     sums = [e for e in serves if e.get("phase") == "summary"]
     if sums:

@@ -73,8 +73,9 @@ SCHEMA: Dict[str, dict] = {
     # calibration fit, or one candidate-vs-incumbent promotion verdict.
     "search": {
         "required": {"phase": str},
-        "optional": {"it": int, "op": str, "dims": list, "accepted": bool,
+        "optional": {"it": int, "op": str, "dims": list, "devices": list,
                      "current_s": float, "best_s": float, "start_s": float,
+                     "accepted": bool,
                      "iterations": int, "accepted_count": int,
                      "acceptance_rate": float, "backend": str,
                      "simulated_s": float, "measured_s": float,
@@ -176,6 +177,21 @@ SCHEMA: Dict[str, dict] = {
             "reshard": ("from_mesh", "to_mesh"),
             "scale": ("replicas_from", "replicas_to"),
             "regate": ("verdict",),
+        },
+    },
+    # one multi-host bootstrap (distributed.initialize,
+    # docs/distributed.md): which process of how many produced this
+    # run's telemetry, over how many global/local devices and DCN
+    # slices — the report CLI's "== distributed ==" section and the
+    # dlrm_process_index/dlrm_process_count gauges carry the same
+    # identity.
+    "distributed": {
+        "required": {"phase": str},
+        "optional": {"process_index": int, "process_count": int,
+                     "global_devices": int, "local_devices": int,
+                     "slices": int},
+        "phases": {
+            "init": ("process_index", "process_count"),
         },
     },
     # one injected fault firing (resilience/faultinject.py) — recovery

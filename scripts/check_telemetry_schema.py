@@ -51,7 +51,16 @@ field without the schema and the report CLI seeing it:
      host-side pipeline they mirror, and the regress anchor keys must
      keep the ``:overlap=`` suffix (the pipeline reorders collective
      reductions, so an overlapped run must never gate a serial
-     baseline).
+     baseline);
+  9. pod-scale contract — the multi-host knobs and layouts
+     (``host_local_batch``/``make_global_array``/``HostShardLoader``,
+     the ``PodTopology`` two-level cost model, the ``multihost``
+     checkpoint mode's ``shard-p*`` layout) must be documented in
+     docs/distributed.md, the per-process metric families
+     (``dlrm_process_index``/``dlrm_process_count``) declared, the
+     ``distributed`` bootstrap event present, and the regress anchor
+     keys must keep the ``:hosts=``/``:slices=`` topology suffixes so
+     a multi-host run never gates a single-host baseline.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -396,6 +405,55 @@ def check_overlap_contract(doc_path: str) -> list:
     return errs
 
 
+POD_DOC_NEEDLES = ("host_local_batch", "make_global_array",
+                   "HostShardLoader", "PodTopology", "pod_topology",
+                   "multihost", "shard-p", "dlrm_process_index",
+                   "dlrm_process_count", ":hosts=", ":slices=")
+POD_FAMILIES = ("dlrm_process_index", "dlrm_process_count")
+
+
+def check_pod_contract(doc_path: str) -> list:
+    """The pod-scale contract (docs/distributed.md): the multi-host
+    knobs and the two-level cost model documented together, the
+    per-process metric families declared, the ``distributed``
+    bootstrap event present, and multi-host/slice runs anchored
+    separately in the regress gate so a pod run can never gate a
+    single-host baseline."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+
+    errs = []
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented multi-host "
+                    f"subsystem)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in POD_DOC_NEEDLES:
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/distributed.md does not document "
+                            f"`{needle}`")
+    for name in POD_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"pod: metric family {name!r} not declared in "
+                        f"telemetry.metrics.FAMILIES")
+    phases = SCHEMA.get("distributed", {}).get("phases") or {}
+    if "init" not in phases:
+        errs.append("pod: the 'distributed' event type has no 'init' "
+                    "phase — the bootstrap identity event is gone")
+    anchors = _history_metrics([
+        {"metric": "m", "value": 1.0, "fenced": True},
+        {"metric": "m", "value": 1.0, "fenced": True, "hosts": 2},
+        {"metric": "m", "value": 1.0, "fenced": True, "slices": 2}])
+    for key in ("m", "m:hosts=2", "m:slices=2"):
+        if key not in anchors:
+            errs.append(f"pod: regress anchor key {key!r} missing — a "
+                        f"multi-host run could gate a single-host "
+                        f"baseline (telemetry/regress.py "
+                        f"_history_metrics)")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -409,7 +467,9 @@ def main() -> int:
             + check_elastic_contract(os.path.join(REPO, "docs",
                                                   "elastic.md"))
             + check_overlap_contract(os.path.join(REPO, "docs",
-                                                  "pipeline.md")))
+                                                  "pipeline.md"))
+            + check_pod_contract(os.path.join(REPO, "docs",
+                                              "distributed.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

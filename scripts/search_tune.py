@@ -8,7 +8,8 @@ the incumbent only when the regress gate passes:
 
     python scripts/search_tune.py --telemetry artifacts/telemetry_dlrm.jsonl \\
         [--devices 8] [--budget 300] [--seed 0] [--tolerance 5] \\
-        [--bench sim|real] [--artifacts artifacts] [--tiny]
+        [--bench sim|real] [--artifacts artifacts] [--tiny] \\
+        [--pod 2x4|auto]
 
 Every phase emits ``search``/``calibration`` telemetry into the tune
 sink (default ``artifacts/telemetry_tune.jsonl``, APPEND mode so the
@@ -136,12 +137,27 @@ def main(argv=None) -> int:
                    help="tune-run telemetry JSONL (default "
                         "<artifacts>/telemetry_tune.jsonl; 'off' "
                         "disables)")
+    p.add_argument("--pod", default="",
+                   help="pod slice shape '<slices>x<chips>' (e.g. "
+                        "'2x4'): run the whole loop under the "
+                        "two-level ICI/DCN cost model with slice-aware "
+                        "placement search; 'auto' reads the running "
+                        "fleet's topology (docs/distributed.md).  The "
+                        "incumbent scope key grows the slice shape.")
     args = p.parse_args(argv)
 
     import jax
 
     from dlrm_flexflow_tpu.sim.tune import search_tune
     from dlrm_flexflow_tpu.telemetry import event_log
+
+    topology = None
+    if args.pod.strip().lower() == "auto":
+        from dlrm_flexflow_tpu.distributed import pod_topology
+        topology = pod_topology()
+    elif args.pod.strip():
+        from dlrm_flexflow_tpu.sim.cost_model import PodTopology
+        topology = PodTopology.parse(args.pod)
 
     num_devices = args.devices or jax.device_count()
     _cfg, model = build_model(args)
@@ -164,7 +180,7 @@ def main(argv=None) -> int:
             model, num_devices, args.telemetry, args.artifacts,
             app="dlrm", budget=args.budget, seed=args.seed,
             alpha=args.alpha, bench_fn=bench_fn,
-            tolerance_pct=args.tolerance)
+            tolerance_pct=args.tolerance, topology=topology)
     print(json.dumps({k: (round(v, 6) if isinstance(v, float) else v)
                       for k, v in result.items()}))
     return 0
