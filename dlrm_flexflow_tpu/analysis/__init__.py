@@ -4,12 +4,16 @@
 
 Multi-pass AST analysis enforcing the invariants the framework's
 correctness rests on: lock discipline, trace purity, trace staleness,
-donation safety, cross-thread shared state, recompile hazards, and
-import layering.  The shared engine (module loader, scoped symbol
-index, interprocedural :class:`~engine.CallGraph` fixed point, stable
-waiver keys, committed ``ANALYSIS_WAIVERS.txt`` baseline) lives in
-:mod:`engine`; the pass catalog in :mod:`passes`;
-``scripts/check_analysis.py`` smokes the whole suite in tier-1.
+donation safety, cross-thread shared state, recompile hazards, import
+layering, and — over the multi-host layer — collective divergence,
+mesh-axis discipline, and the podshard barrier protocol.  The shared
+engine (module loader, scoped symbol index, interprocedural
+:class:`~engine.CallGraph` fixed point, :func:`~engine.get_value_taint`
+summaries, stable waiver keys, committed ``ANALYSIS_WAIVERS.txt``
+baseline) lives in :mod:`engine`; the pass catalog in :mod:`passes`
+(the SPMD surface shared by the multi-host passes in
+:mod:`passes._spmd`); ``scripts/check_analysis.py`` smokes the whole
+suite in tier-1.
 
 Stdlib-only on purpose: the analyzer runs before jax imports, in CI,
 and anywhere the source tree exists.
@@ -18,14 +22,14 @@ and anywhere the source tree exists.
 from .engine import (AnalysisPass, AnalysisResult, BaselineError,
                      CallGraph, Finding, FunctionIndex, Module, Waivers,
                      WaiverError, all_passes, default_waivers,
-                     get_callgraph, load_modules, repo_root,
-                     run_analysis, to_sarif, update_baseline,
+                     get_callgraph, get_value_taint, load_modules,
+                     repo_root, run_analysis, to_sarif, update_baseline,
                      write_json, write_sarif)
 
 __all__ = [
     "AnalysisPass", "AnalysisResult", "BaselineError", "CallGraph",
     "Finding", "FunctionIndex", "Module", "Waivers", "WaiverError",
-    "all_passes", "default_waivers", "get_callgraph", "load_modules",
-    "repo_root", "run_analysis", "to_sarif", "update_baseline",
-    "write_json", "write_sarif",
+    "all_passes", "default_waivers", "get_callgraph", "get_value_taint",
+    "load_modules", "repo_root", "run_analysis", "to_sarif",
+    "update_baseline", "write_json", "write_sarif",
 ]

@@ -513,12 +513,34 @@ def all_passes() -> Dict[str, type]:
 def get_callgraph(modules: List[Module],
                   index: FunctionIndex) -> CallGraph:
     """The run's one :class:`CallGraph`, built lazily and cached on the
-    index — seven passes share one edge walk, not seven."""
+    index — the passes share one edge walk, not one each."""
     cg = getattr(index, "_callgraph", None)
     if cg is None:
         cg = CallGraph(modules, index)
         index._callgraph = cg
     return cg
+
+
+def get_value_taint(modules: List[Module], index: FunctionIndex,
+                    key: str, seed) -> Dict[ast.AST, set]:
+    """THE shared value-taint relation: ``seed(fn_node, module)``
+    names the taint kinds a function's own body introduces (e.g.
+    "divergent" for a ``jax.process_index()`` call); the result maps
+    every function to the union of kinds over everything it can reach
+    — :meth:`CallGraph.propagate`'s bounded fixed point, so a helper
+    that launders ``process_index()`` through three wrappers still
+    taints its callers.  Cached on the index per ``key`` like
+    :func:`get_callgraph` (the collective-divergence and
+    barrier-protocol passes share the same summaries)."""
+    cache = getattr(index, "_value_taint_cache", None)
+    if cache is None:
+        cache = index._value_taint_cache = {}
+    if key not in cache:
+        cg = get_callgraph(modules, index)
+        local = {n: set(seed(n, index.owner[n][0]))
+                 for n in index.owner}
+        cache[key] = cg.propagate(local)
+    return {n: set(s) for n, s in cache[key].items()}
 
 
 # ---------------------------------------------------------------- waivers

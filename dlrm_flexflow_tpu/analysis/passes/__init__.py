@@ -20,18 +20,34 @@ real hazard this codebase has already hit in review:
 * ``donation-safety``   — arguments donated to a compiled callable
   referenced again after the call;
 * ``import-layering``   — module-level imports that climb the
-  subsystem DAG upward.
+  subsystem DAG upward;
+* ``collective-divergence`` — collectives (device, multihost barrier,
+  podshard fence) reachable only under process-divergent control
+  flow: the multi-host deadlock shape;
+* ``mesh-axis``         — shard_map bodies using axes their site
+  never declares, collectives outside any SPMD context, and direct
+  ``jax.shard_map`` spellings outside the parallel/mesh.py compat
+  wrapper;
+* ``barrier-protocol``  — podshard fence lifecycle: unswept fences,
+  retry loops around the single-attempt barrier, non-process-0
+  writes to cross-host singleton files.
 
 Adding a pass: subclass AnalysisPass in a new module here, set
 ``name``/``description``, implement ``run``, append to ``PASSES``.
 The engine hands every pass the shared parsed modules, the
-FunctionIndex, and (via ``engine.get_callgraph``) the interprocedural
-CallGraph fixed point — build on those instead of re-walking.
+FunctionIndex, and (via ``engine.get_callgraph`` /
+``engine.get_value_taint``) the interprocedural CallGraph fixed point
+and taint summaries; the SPMD surface (shard_map sites, the
+inside-a-body relation, fence creators) is shared via ``_spmd.py`` —
+build on those instead of re-walking.
 """
 
+from .barrier import BarrierProtocolPass
+from .divergence import CollectiveDivergencePass
 from .donation import DonationSafetyPass
 from .layering import ImportLayeringPass
 from .locks import LockDisciplinePass
+from .meshaxis import MeshAxisPass
 from .purity import TracePurityPass
 from .recompile import RecompileHazardPass
 from .sharedstate import SharedStatePass
@@ -45,9 +61,13 @@ PASSES = [
     RecompileHazardPass,
     DonationSafetyPass,
     ImportLayeringPass,
+    CollectiveDivergencePass,
+    MeshAxisPass,
+    BarrierProtocolPass,
 ]
 
 __all__ = ["PASSES", "LockDisciplinePass", "TracePurityPass",
            "TraceStalenessPass", "SharedStatePass",
            "RecompileHazardPass", "DonationSafetyPass",
-           "ImportLayeringPass"]
+           "ImportLayeringPass", "CollectiveDivergencePass",
+           "MeshAxisPass", "BarrierProtocolPass"]

@@ -1,0 +1,253 @@
+"""collective-divergence pass: collectives must not hide behind
+process-divergent control flow.
+
+A collective (``jax.lax.psum``/``all_gather``/..., a
+``multihost_utils`` barrier, or an entry into the podshard
+file-barrier protocol) is a RENDEZVOUS: every participating process
+must reach it, in the same order, or the ones that did hang forever —
+the classic multi-host deadlock (docs/distributed.md documents the
+single-attempt rule the checkpoint protocol derives from it).  The
+divergence that causes it is always the same shape: control flow
+keyed on a PROCESS-LOCAL value — ``jax.process_index()``, a
+``host_local_batch`` slice, a ``pidx`` threaded through helpers —
+guarding code that (transitively) performs a collective.
+
+The pass runs the engine's shared value-taint machinery
+(``engine.get_value_taint``, one bounded fixed point per summary):
+
+* a "divergent" taint seeded from ``jax.process_index()`` /
+  ``host_local_batch()`` calls (and parameters conventionally named
+  ``pidx``/``process_index``/``process_id``), propagated through the
+  call graph so a wrapper like ``_my_rank()`` taints its callers;
+* a "performs-collective" summary seeded from direct device
+  collectives, multihost barriers, and fence-minting functions
+  (``_spmd.get_fence_creators`` — structural, not name-based).
+
+Codes:
+
+* ``collective-in-divergent-branch`` — a collective call (or a call
+  into a collective-performing function) lexically under an
+  ``if``/``while``/``for`` whose condition (or iterable) is
+  process-divergent: only some processes reach the rendezvous.
+* ``collective-after-divergent-return`` — a divergent branch returns
+  or raises, and a collective follows later in the same function: the
+  early-exiting processes never arrive (``if pidx != 0: return``
+  before a barrier).
+
+Recognized patterns (silent by design, pinned by fixtures):
+
+* ``jax.process_count()`` is UNIFORM — every process computes the
+  same value, so ``if process_count() > 1:`` around the multihost
+  save path gates every process identically and is the sanctioned
+  spelling (resilience/manager.py).  Count-derived conditions carry a
+  separate "uniform" taint that never fires.
+* process-0 work AFTER the rendezvous (``self._barrier(...)`` then
+  ``if pidx == 0: <manifest commit>``) is the podshard commit idiom:
+  the guarded block performs no collective, so nothing fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..engine import (AnalysisPass, Finding, FunctionIndex, Module,
+                      get_value_taint, iter_calls)
+from ._spmd import (DEVICE_COLLECTIVES, MULTIHOST_BARRIERS,
+                    call_name, get_fence_creators, own_statements,
+                    process_local_names)
+
+#: calls whose RESULT differs across processes of one job.
+DIVERGENT_SOURCES = frozenset({"process_index", "host_local_batch"})
+#: calls whose result is identical on every process — gating on them
+#: is the sanctioned multihost spelling, never a divergence.
+UNIFORM_SOURCES = frozenset({"process_count", "device_count",
+                             "local_device_count"})
+TAINT_KEY = "process-dependent"
+COLLECTIVE_KEY = "performs-collective"
+
+
+def _source_kinds(call: ast.Call) -> Set[str]:
+    nm = call_name(call)
+    if nm in DIVERGENT_SOURCES:
+        return {"divergent"}
+    if nm in UNIFORM_SOURCES:
+        return {"uniform"}
+    return set()
+
+
+class CollectiveDivergencePass(AnalysisPass):
+    name = "collective-divergence"
+    description = ("collectives (device, multihost barrier, podshard "
+                   "fence) must not be reachable only under "
+                   "process-divergent control flow — the multi-host "
+                   "deadlock shape")
+
+    def run(self, modules: List[Module],
+            index: FunctionIndex) -> List[Finding]:
+        taint = get_value_taint(
+            modules, index, TAINT_KEY,
+            lambda n, _m: {k for c in iter_calls(n)
+                           for k in _source_kinds(c)})
+        fence_creators = get_fence_creators(modules, index)
+        collective = get_value_taint(
+            modules, index, COLLECTIVE_KEY,
+            lambda n, _m: {"collective"} if n in fence_creators or any(
+                True for c in iter_calls(n)
+                if call_name(c) in DEVICE_COLLECTIVES
+                or call_name(c) in MULTIHOST_BARRIERS) else set())
+
+        findings: List[Finding] = []
+        for node, (mod, qual, cls, scope) in index.owner.items():
+            findings.extend(self._check_function(
+                node, mod, qual, cls, scope, index, taint, collective))
+        findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return findings
+
+    # ------------------------------------------------------------ per-fn
+    def _check_function(self, node, mod: Module, qual: str,
+                        cls: Optional[str], scope, index: FunctionIndex,
+                        taint: Dict, collective: Dict) -> List[Finding]:
+        call_scope = scope + (qual.split(".")[-1],)
+        divergent_names = self._divergent_names(node, mod, index,
+                                                call_scope, cls, taint)
+
+        def expr_divergent(expr: ast.AST) -> bool:
+            """The condition/iterable reads a process-local value:
+            a divergent name, a direct divergent source call, or a
+            call into a divergent-tainted function."""
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and n.id in divergent_names:
+                    return True
+                if isinstance(n, ast.Call):
+                    if "divergent" in _source_kinds(n):
+                        return True
+                    target = index.resolve_call(n, mod, call_scope, cls)
+                    if target is not None \
+                            and "divergent" in taint.get(target, ()):
+                        return True
+            return False
+
+        def collectives_in(body) -> List:
+            """(call, display) for every collective the statements
+            perform — directly or through a resolved call into a
+            collective-performing function.  Nested defs excluded
+            (a callback bound under the branch runs later, like the
+            lock walk's rule)."""
+            out = []
+            for stmt in body:
+                for n in self._own_nodes(stmt):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    nm = call_name(n)
+                    if nm in DEVICE_COLLECTIVES \
+                            or nm in MULTIHOST_BARRIERS:
+                        out.append((n, f"{nm}()"))
+                        continue
+                    target = index.resolve_call(n, mod, call_scope, cls)
+                    if target is not None \
+                            and "collective" in collective.get(target,
+                                                               ()):
+                        out.append((n, f"{nm}() (performs a "
+                                       f"collective)"))
+            return out
+
+        findings: List[Finding] = []
+        flagged: Set = set()
+        flagged_lines: Set[int] = set()
+        returning_divergent: List[ast.stmt] = []
+        for stmt in self._own_nodes(node):
+            if isinstance(stmt, (ast.If, ast.While)):
+                guard_expr = stmt.test
+            elif isinstance(stmt, ast.For):
+                guard_expr = stmt.iter
+            else:
+                continue
+            if not expr_divergent(guard_expr):
+                continue
+            kind = ("loop" if isinstance(stmt, (ast.While, ast.For))
+                    else "branch")
+            arms = [stmt.body] + ([stmt.orelse] if stmt.orelse else [])
+            for arm in arms:
+                for call, what in collectives_in(arm):
+                    # nested divergent constructs (an if inside a
+                    # while) both reach the same call — one finding
+                    # per call site, not one per enclosing guard
+                    if (call.lineno, call.col_offset) in flagged:
+                        continue
+                    flagged.add((call.lineno, call.col_offset))
+                    flagged_lines.add(call.lineno)
+                    findings.append(self.finding(
+                        mod.relpath, call.lineno,
+                        "collective-in-divergent-branch",
+                        f"{what} under a process-divergent {kind} "
+                        f"(line {stmt.lineno}) in {qual} — only some "
+                        f"processes reach this rendezvous; the others "
+                        f"deadlock waiting for them "
+                        f"(docs/distributed.md)",
+                        detail=qual))
+            if isinstance(stmt, ast.If) and any(
+                    isinstance(s, (ast.Return, ast.Raise))
+                    for s in stmt.body):
+                # a raise is the same early exit as a return for the
+                # rendezvous: the raising processes never arrive
+                returning_divergent.append(stmt)
+        if returning_divergent:
+            first = min(returning_divergent, key=lambda s: s.lineno)
+            for stmt in self._own_nodes(node):
+                if getattr(stmt, "lineno", 0) <= first.lineno \
+                        or getattr(stmt, "lineno", 0) in flagged_lines:
+                    continue
+                if not isinstance(stmt, ast.Call):
+                    continue
+                # collectives AFTER the divergent early return: the
+                # processes that returned never arrive
+                nm = call_name(stmt)
+                is_coll = nm in DEVICE_COLLECTIVES \
+                    or nm in MULTIHOST_BARRIERS
+                if not is_coll:
+                    target = index.resolve_call(stmt, mod, call_scope,
+                                                cls)
+                    is_coll = target is not None and \
+                        "collective" in collective.get(target, ())
+                if is_coll:
+                    findings.append(self.finding(
+                        mod.relpath, stmt.lineno,
+                        "collective-after-divergent-return",
+                        f"{nm}() runs after the process-divergent "
+                        f"early exit at line {first.lineno} in "
+                        f"{qual} — the processes that left never "
+                        f"reach this rendezvous",
+                        detail=qual))
+        return findings
+
+    def _divergent_names(self, node, mod: Module, index: FunctionIndex,
+                         call_scope, cls, taint: Dict) -> Set[str]:
+        """Local names carrying a process-local value, seeded by the
+        shared ``_spmd.process_local_names`` rule (conventional
+        parameter names + elementwise-tainted assignments, so the
+        uniform ``nproc`` in ``pidx, nproc = process_index(),
+        process_count()`` never picks up the taint) — with this
+        pass's wider source predicate: a direct divergent source call
+        OR a call into a divergent-tainted function.  One forward
+        pass, no kill analysis; a rebind to something uniform keeps
+        the taint (conservative)."""
+
+        def value_divergent(expr: ast.AST, names: Set[str]) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call):
+                    if "divergent" in _source_kinds(n):
+                        return True
+                    target = index.resolve_call(n, mod, call_scope, cls)
+                    if target is not None \
+                            and "divergent" in taint.get(target, ()):
+                        return True
+                if isinstance(n, ast.Name) and n.id in names:
+                    return True
+            return False
+
+        return process_local_names(node, value_divergent)
+
+    # the shared own-body walk (_spmd.own_statements): nested defs are
+    # checked in their own right; whether they RUN here is unknowable
+    _own_nodes = staticmethod(own_statements)

@@ -820,7 +820,7 @@ class FFModel:
         # every row every step)
         lazy_mode = (not plain_sgd
                      and getattr(self.optimizer, "lazy_embeddings", False)
-                     and hasattr(self.optimizer, "lazy_row_update"))
+                     and hasattr(self.optimizer, "lazy_weight_delta"))
         lazy_slots = (tuple(self.optimizer.slot_names())
                       if lazy_mode else ())
         if sparse_ok and (plain_sgd or lazy_mode):
@@ -926,12 +926,10 @@ class FFModel:
                                               op.name).reshape(-1, d), sl)
                 for sn in lazy_slots}
             w_flat = w_rows.reshape(-1, d).astype(jnp.float32)
-            new_w, new_slot_rows = self.optimizer.lazy_row_update(
+            new_slot_rows = self.optimizer.lazy_slot_rows(
                 w_flat, g_row, slot_rows_cur, state.opt_state)
-            # first-occurrence-masked delta: duplicates add exact 0.0,
+            # first-occurrence-masked deltas: duplicates add exact 0.0,
             # so one add lands per touched row, via the packed view
-            dw = jnp.where(first, new_w.astype(jnp.float32) - w_flat, 0.0)
-            new_tb = _upd(space, dw).reshape(tb.shape)
             new_slot_tabs = {}
             for sn in lazy_slots:
                 ssp = _slot_space(state, sn, op.name)
@@ -941,6 +939,33 @@ class FFModel:
                 new_slot_tabs[sn] = _upd(
                     ssp if sp > 1 else ssp.reshape(-1, d),
                     dslot).reshape(ssp.shape)
+            # Update ORDER is a correctness contract: the slot tables
+            # are scattered FIRST and the weight delta is derived from
+            # the slot rows RE-GATHERED out of the updated tables — a
+            # materialized scatter result no backend can rematerialize
+            # per consumer.  Deriving both the stored slots and the
+            # weight step from the shared `mu*v + gt` expression let
+            # XLA:CPU inline that chain into each scatter's operand
+            # fusion separately and FMA-contract the copies
+            # differently, so the weight step consumed a velocity one
+            # ULP away from the velocity the table kept — and the
+            # cached (ladder lax.scan) and uncached (straight-line)
+            # programs made different contraction choices, breaking
+            # the bitwise cached==uncached hierarchy-exactness claim
+            # (jax.lax.optimization_barrier does not survive the CPU
+            # pipeline, so fencing cannot close this).  The delta
+            # itself is contraction-free by construction for the
+            # momentum/adam forms (optim.lazy_weight_delta: mul/div/
+            # sqrt only; nesterov's gt + mu*v keeps one fusible
+            # mul+add — the residual exposure is documented there).
+            slot_rows_fresh = {
+                sn: _cache_gather(op, new_slot_tabs[sn]
+                                  if sp > 1 else
+                                  new_slot_tabs[sn].reshape(-1, d), sl)
+                for sn in lazy_slots}
+            dw = jnp.where(first, self.optimizer.lazy_weight_delta(
+                w_flat, g_row, slot_rows_fresh, state.opt_state), 0.0)
+            new_tb = _upd(space, dw).reshape(tb.shape)
             return new_tb, new_slot_tabs
 
         def train_step(state: TrainState, inputs, labels, slot_override=None):

@@ -63,19 +63,43 @@ class SGDOptimizer(Optimizer):
         (the epoch row-cache caches them with the same slots)."""
         return ("v",) if self.momentum != 0.0 else ()
 
-    def lazy_row_update(self, w, g, slots, opt_state):
-        """Row-wise lazy step: ``w``/``g`` (..., d) touched rows (g
-        pre-summed over duplicates), ``slots`` maps slot name -> rows
-        of that optimizer table.  Returns (new_w, new_slots)."""
-        mu, wd = self.momentum, self.weight_decay
+    def lazy_row_gt(self, w, g):
+        """The weight-decayed gradient rows both lazy pieces share."""
+        return g.astype(jnp.float32) + self.weight_decay * \
+            w.astype(jnp.float32)
+
+    def lazy_slot_rows(self, w, g, slots, opt_state):
+        """Row-wise lazy slot step: ``w``/``g`` (..., d) touched rows
+        (g pre-summed over duplicates), ``slots`` maps slot name ->
+        current rows of that optimizer table.  Returns the NEW slot
+        rows ({} when momentum is off)."""
+        if self.momentum == 0.0:
+            return {}
+        return {"v": self.momentum * slots["v"] + self.lazy_row_gt(w, g)}
+
+    def lazy_weight_delta(self, w, g, slots, opt_state):
+        """The row-wise weight DELTA of one lazy step, computed from
+        the slot rows AS STORED: the caller scatters the
+        :meth:`lazy_slot_rows` result into the slot tables FIRST and
+        re-gathers ``slots`` from them, so the weight step and the
+        slot tables can never disagree about the velocity (the model's
+        lazy_update documents the backend-codegen hazard this order
+        exists to close).  The non-nesterov delta is a single multiply
+        of materialized values — no mul+add chain a backend FMA
+        contraction could re-round differently between programs.  The
+        NESTEROV delta necessarily keeps one fusible mul+add
+        (``gt + mu*v`` — no algebraic rewrite removes it), so the
+        bitwise cached==uncached claim tests/test_lazy_optim.py pins
+        covers the momentum/adam forms only; nesterov+lazy remains
+        correct to float tolerance but its cross-program bitwise
+        identity is backend-contraction-dependent."""
+        mu = self.momentum
         lr = opt_state.get("lr", self.lr)
-        gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
         if mu == 0.0:
-            return ((w.astype(jnp.float32) - lr * gt).astype(w.dtype), {})
-        v = mu * slots["v"] + gt
-        nxt = gt + mu * v if self.nesterov else v
-        return ((w.astype(jnp.float32) - lr * nxt).astype(w.dtype),
-                {"v": v})
+            return -(lr * self.lazy_row_gt(w, g))
+        if self.nesterov:
+            return -(lr * (self.lazy_row_gt(w, g) + mu * slots["v"]))
+        return -(lr * slots["v"])
 
     def init(self, params):
         # lr lives in the state so schedules can change it between steps
@@ -147,21 +171,31 @@ class AdamOptimizer(Optimizer):
     def slot_names(self):
         return ("m", "v")
 
-    def lazy_row_update(self, w, g, slots, opt_state):
-        """SparseAdam row step (g pre-summed over duplicate ids; bias
-        correction uses the GLOBAL step count, like torch SparseAdam)."""
-        b1, b2, wd, eps = (self.beta1, self.beta2,
-                           self.weight_decay, self.epsilon)
+    def lazy_row_gt(self, w, g):
+        """The weight-decayed gradient rows both lazy pieces share."""
+        return g.astype(jnp.float32) + self.weight_decay * \
+            w.astype(jnp.float32)
+
+    def lazy_slot_rows(self, w, g, slots, opt_state):
+        """SparseAdam row moments (g pre-summed over duplicate ids)."""
+        b1, b2 = self.beta1, self.beta2
+        gt = self.lazy_row_gt(w, g)
+        return {"m": b1 * slots["m"] + (1 - b1) * gt,
+                "v": b2 * slots["v"] + (1 - b2) * jnp.square(gt)}
+
+    def lazy_weight_delta(self, w, g, slots, opt_state):
+        """SparseAdam row weight delta from the moments AS STORED (the
+        caller re-gathers ``slots`` from the just-updated tables — see
+        SGDOptimizer.lazy_weight_delta); bias correction uses the
+        GLOBAL step count, like torch SparseAdam.  sqrt/div/mul only —
+        no mul+add chain for a backend FMA contraction to re-round."""
         lr = opt_state.get("lr", self.lr)
         t = opt_state["step"] + 1
         tf = t.astype(jnp.float32)
-        alpha_t = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
-        gt = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
-        m = b1 * slots["m"] + (1 - b1) * gt
-        v = b2 * slots["v"] + (1 - b2) * jnp.square(gt)
-        new_w = (w.astype(jnp.float32)
-                 - alpha_t * m / (jnp.sqrt(v) + eps)).astype(w.dtype)
-        return new_w, {"m": m, "v": v}
+        alpha_t = lr * jnp.sqrt(1.0 - self.beta2 ** tf) \
+            / (1.0 - self.beta1 ** tf)
+        return -(alpha_t * slots["m"]
+                 / (jnp.sqrt(slots["v"]) + self.epsilon))
 
     def init(self, params):
         # moments always f32 (bf16-stored params keep f32 optimizer

@@ -4,7 +4,7 @@ End-to-end scenarios for the static-analysis suite — the analysis
 analogue of ``check_serving.py``/``check_observability.py``
 (docs/analysis.md):
 
-  1. repo clean-or-waived — all 7 passes over the real tree with the
+  1. repo clean-or-waived — all 10 passes over the real tree with the
      committed ``ANALYSIS_WAIVERS.txt`` report zero unwaived findings
      and zero stale waivers (the CI gate);
   2. injected violation — an emit-under-lock snippet seeded into a
@@ -18,7 +18,16 @@ analogue of ``check_serving.py``/``check_observability.py``
      file is in scope and stays silent when only the clean file is
      (the CI annotate-the-diff path);
   6. baseline update — regeneration keeps justifications verbatim,
-     and REFUSES over an active unwaived finding.
+     and REFUSES over an active unwaived finding;
+  7. injected divergence — an index-gated multihost barrier (the pod
+     deadlock shape) fires collective-divergence, while the
+     process-0-commit-after-barrier idiom stays silent;
+  8. injected axis bugs — a misspelled axis inside a shard_map body
+     and a direct ``jax.experimental.shard_map`` import both fire
+     mesh-axis;
+  9. injected barrier-protocol bugs — an unswept fence, a retry loop
+     around the single-attempt barrier, and a non-process-0 manifest
+     write each fire, while the full podshard shape stays silent.
 
 Exit 0 when every scenario passes; prints one line per scenario and
 exits 1 otherwise.
@@ -198,6 +207,141 @@ def scenario_update_baseline() -> str:
     return ""
 
 
+#: the pod deadlock shape: a barrier only process 0 reaches — plus,
+#: in the same module, the sanctioned process-0-after-barrier commit
+#: that must NOT fire (docs/distributed.md)
+DIVERGENCE_SNIPPET = '''\
+import jax
+from jax.experimental import multihost_utils
+
+
+def broken_commit(path):
+    if jax.process_index() == 0:
+        multihost_utils.sync_global_devices("commit")
+
+
+def sanctioned_commit(path, pidx):
+    multihost_utils.sync_global_devices("written")
+    if pidx == 0:
+        with open(path + "/manifest.json", "w") as f:
+            f.write("{}")
+'''
+
+#: a misspelled axis inside a shard_map body + the direct
+#: experimental import the mesh.py wrapper exists to contain
+AXIS_SNIPPET = '''\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def lookup(tables, ids, mesh):
+    def body(t, i):
+        return jax.lax.psum(t, "modell")
+    return shard_map(body, mesh=mesh, in_specs=(P("model"), P("data")),
+                     out_specs=P("data"))(tables, ids)
+'''
+
+#: all three barrier-protocol hazards in one class, next to the good
+#: protocol shape that must stay silent
+BARRIER_SNIPPET = '''\
+import jax
+import json
+import os
+import shutil
+import time
+
+
+class BrokenMgr:
+    def __init__(self, d):
+        self.directory = d
+
+    def _barrier(self, tag, pidx, nproc):
+        bdir = os.path.join(self.directory, f".barrier-{tag}")
+        os.makedirs(bdir, exist_ok=True)
+        while len(os.listdir(bdir)) < nproc:
+            time.sleep(0.01)
+
+    def save(self, files, pidx, nproc):
+        for attempt in range(3):
+            self._barrier("tmp", pidx, nproc)
+        with open(os.path.join(self.directory, "manifest.json"),
+                  "w") as f:
+            json.dump(files, f)
+
+
+class GoodMgr:
+    def __init__(self, d):
+        self.directory = d
+
+    def _barrier(self, tag, pidx, nproc):
+        bdir = os.path.join(self.directory, f".barrier-{tag}")
+        os.makedirs(bdir, exist_ok=True)
+        while len(os.listdir(bdir)) < nproc:
+            time.sleep(0.01)
+
+    def save(self, files, pidx, nproc):
+        self._barrier("written", pidx, nproc)
+        if pidx == 0:
+            with open(os.path.join(self.directory, "manifest.json"),
+                      "w") as f:
+                json.dump(files, f)
+        self._barrier("commit", pidx, nproc)
+        if pidx == 0:
+            for name in os.listdir(self.directory):
+                if name.startswith(".barrier-"):
+                    shutil.rmtree(os.path.join(self.directory, name))
+'''
+
+
+def scenario_injected_divergence() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, DIVERGENCE_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["collective-divergence"])
+        hits = [f for f in res.findings
+                if f.code == "collective-in-divergent-branch"
+                and f.path == rel]
+        if len(res.findings) != 1 or not hits:
+            return ("wanted exactly the index-gated barrier finding, "
+                    f"got {[f.format() for f in res.findings]}")
+        if hits[0].detail != "broken_commit":
+            return (f"finding in {hits[0].detail!r} — the sanctioned "
+                    f"process-0-after-barrier idiom must stay silent")
+    return ""
+
+
+def scenario_injected_axis() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, AXIS_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["mesh-axis"])
+        codes = sorted(f.code for f in res.findings
+                       if f.path == rel)
+        if codes != ["direct-shard-map", "undeclared-axis"]:
+            return ("wanted the direct import + misspelled axis, got "
+                    f"{[f.format() for f in res.findings]}")
+    return ""
+
+
+def scenario_injected_barrier() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, BARRIER_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["barrier-protocol"])
+        broken = sorted(f.code for f in res.findings
+                        if f.path == rel and "BrokenMgr" in f.detail)
+        if broken != ["barrier-in-retry-loop", "fence-no-sweep",
+                      "nonzero-singleton-write"]:
+            return ("BrokenMgr should fire all three protocol codes, "
+                    f"got {broken}")
+        good = [f for f in res.findings if "GoodMgr" in f.detail]
+        if good:
+            return ("the podshard-shaped GoodMgr fired: "
+                    f"{[f.format() for f in good]}")
+    return ""
+
+
 SCENARIOS = [
     ("repo clean or waived", scenario_repo_clean),
     ("injected violation fires", scenario_injected_violation),
@@ -205,6 +349,9 @@ SCENARIOS = [
     ("json round-trip", scenario_json_roundtrip),
     ("changed-only scope", scenario_changed_only),
     ("baseline update", scenario_update_baseline),
+    ("injected divergence fires", scenario_injected_divergence),
+    ("injected axis bugs fire", scenario_injected_axis),
+    ("injected barrier bugs fire", scenario_injected_barrier),
 ]
 
 

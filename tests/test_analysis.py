@@ -28,13 +28,19 @@ from dlrm_flexflow_tpu.analysis import (BaselineError,  # noqa: E402
                                         run_analysis, to_sarif,
                                         update_baseline)
 from dlrm_flexflow_tpu.analysis.__main__ import main as cli_main  # noqa: E402
-from dlrm_flexflow_tpu.analysis.passes import (DonationSafetyPass,  # noqa: E402
+from dlrm_flexflow_tpu.analysis.engine import get_value_taint  # noqa: E402
+from dlrm_flexflow_tpu.analysis.passes import (BarrierProtocolPass,  # noqa: E402
+                                               CollectiveDivergencePass,
+                                               DonationSafetyPass,
                                                ImportLayeringPass,
                                                LockDisciplinePass,
+                                               MeshAxisPass,
                                                RecompileHazardPass,
                                                SharedStatePass,
                                                TracePurityPass,
                                                TraceStalenessPass)
+from dlrm_flexflow_tpu.analysis.passes._spmd import (  # noqa: E402
+    get_fence_creators, get_shard_map_sites, get_spmd_contexts)
 from dlrm_flexflow_tpu.telemetry.report import (analysis_delta,  # noqa: E402
                                                 analysis_summary,
                                                 find_analysis_artifact,
@@ -43,9 +49,10 @@ from dlrm_flexflow_tpu.telemetry.report import (analysis_delta,  # noqa: E402
                                                 load_analysis,
                                                 report_data)
 
-ALL_PASSES = ["donation-safety", "import-layering", "lock-discipline",
-              "recompile-hazard", "shared-state", "trace-purity",
-              "trace-staleness"]
+ALL_PASSES = ["barrier-protocol", "collective-divergence",
+              "donation-safety", "import-layering", "lock-discipline",
+              "mesh-axis", "recompile-hazard", "shared-state",
+              "trace-purity", "trace-staleness"]
 
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
 
@@ -985,6 +992,537 @@ class TestRecompileHazard:
         assert fs == []
 
 
+# ---------------------------------------------------- collective-divergence
+class TestCollectiveDivergence:
+    #: the classic multi-host deadlock shape (docs/distributed.md):
+    #: a barrier only process 0 reaches — every other process parks
+    #: at the NEXT rendezvous forever
+    DEADLOCK = {"pkg/d.py": (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "def sync_all():\n"
+        "    multihost_utils.sync_global_devices('commit')\n"
+        "def broken_commit(path):\n"
+        "    if jax.process_index() == 0:\n"
+        "        sync_all()\n"
+    )}
+
+    def test_process_divergent_collective_deadlock_fires(self, tmp_path):
+        fs = _run_pass(tmp_path, self.DEADLOCK, CollectiveDivergencePass)
+        assert _codes(fs) == ["collective-in-divergent-branch"]
+        assert fs[0].line == 7 and fs[0].path == "pkg/d.py"
+        assert "deadlock" in fs[0].message
+        assert fs[0].detail == "broken_commit"
+
+    def test_fires_taint_through_helper_and_early_return(self, tmp_path):
+        # process_index laundered through a wrapper still taints the
+        # branch (engine.get_value_taint fixed point), and an early
+        # return under it orphans the collective BELOW the branch
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "def my_rank():\n"
+            "    return jax.process_index()\n"
+            "def broken(x):\n"
+            "    r = my_rank()\n"
+            "    if r != 0:\n"
+            "        return x\n"
+            "    return jax.lax.psum(x, 'data')\n"
+        )}, CollectiveDivergencePass)
+        assert _codes(fs) == ["collective-after-divergent-return"]
+        assert fs[0].line == 8
+
+    def test_fires_divergent_raise_before_barrier(self, tmp_path):
+        # a raise is the same early exit as a return: the raising
+        # processes never reach the rendezvous below
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def save(x, pidx):\n"
+            "    if pidx != 0:\n"
+            "        raise RuntimeError('not the leader')\n"
+            "    multihost_utils.sync_global_devices('commit')\n"
+        )}, CollectiveDivergencePass)
+        assert _codes(fs) == ["collective-after-divergent-return"]
+        assert fs[0].line == 6
+
+    def test_fires_divergent_loop_and_host_local_batch(self, tmp_path):
+        # a loop whose trip count differs per process diverges the
+        # collective SEQUENCE; host_local_batch results are as
+        # process-local as the index itself
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "from dlrm_flexflow_tpu.distributed import host_local_batch\n"
+            "def loopy(x, pidx):\n"
+            "    for _ in range(pidx):\n"
+            "        x = jax.lax.psum(x, 'data')\n"
+            "    return x\n"
+            "def sliced(x, n):\n"
+            "    sl = host_local_batch(n)\n"
+            "    if sl.start == 0:\n"
+            "        return jax.lax.pmean(x, 'data')\n"
+            "    return x\n"
+        )}, CollectiveDivergencePass)
+        assert _codes(fs) == ["collective-in-divergent-branch"]
+        assert sorted(f.line for f in fs) == [5, 10]
+
+    def test_silent_process0_after_barrier_idiom(self, tmp_path):
+        # THE podshard commit idiom (resilience/manager.py): every
+        # process reaches the barrier, THEN process 0 alone commits
+        # the manifest — the guarded block performs no collective
+        fs = _run_pass(tmp_path, {"pkg/ok.py": (
+            "import json, os\n"
+            "from jax.experimental import multihost_utils\n"
+            "def commit(path, files, pidx):\n"
+            "    multihost_utils.sync_global_devices('written')\n"
+            "    if pidx == 0:\n"
+            "        with open(os.path.join(path, 'manifest.json'),\n"
+            "                  'w') as f:\n"
+            "            json.dump(files, f)\n"
+            "    multihost_utils.sync_global_devices('commit')\n"
+        )}, CollectiveDivergencePass)
+        assert fs == []
+
+    def test_silent_uniform_count_gate(self, tmp_path):
+        # process_count() is identical on every process — gating the
+        # multihost path on it is the sanctioned spelling, and a
+        # plain unguarded collective is obviously fine
+        fs = _run_pass(tmp_path, {"pkg/ok.py": (
+            "import jax\n"
+            "def maybe_sync(x):\n"
+            "    if jax.process_count() > 1:\n"
+            "        return jax.lax.psum(x, 'data')\n"
+            "    return x\n"
+            "def always(x, pidx):\n"
+            "    y = jax.lax.psum(x, 'data')\n"
+            "    if pidx == 0:\n"
+            "        print(y)\n"
+            "    return y\n"
+        )}, CollectiveDivergencePass)
+        assert fs == []
+
+    def test_fires_alias_chain_through_nested_block(self, tmp_path):
+        # the taint seeding runs to a fixed point over SOURCE-ordered
+        # statements: pidx assigned inside an if/else, aliased two
+        # hops later — the tree walk's out-of-order statement yield
+        # must not break the chain
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "from jax.experimental import multihost_utils\n"
+            "def broken(path, cond):\n"
+            "    if cond:\n"
+            "        pidx = jax.process_index()\n"
+            "    else:\n"
+            "        pidx = 0\n"
+            "    rank = pidx\n"
+            "    if rank == 0:\n"
+            "        multihost_utils.sync_global_devices('x')\n"
+        )}, CollectiveDivergencePass)
+        assert _codes(fs) == ["collective-in-divergent-branch"]
+        assert fs[0].line == 10
+
+    def test_single_finding_under_nested_divergent_guards(self,
+                                                          tmp_path):
+        # an if nested in a divergent while both reach the same call:
+        # ONE finding per call site, not one per enclosing guard
+        # (duplicate waiver keys would double-count by_pass/SARIF)
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import jax\n"
+            "def broken(x, pidx):\n"
+            "    if pidx != 0:\n"
+            "        while pidx > 0:\n"
+            "            x = jax.lax.psum(x, 'data')\n"
+            "    return x\n"
+        )}, CollectiveDivergencePass)
+        assert len(fs) == 1
+        assert fs[0].code == "collective-in-divergent-branch"
+
+    def test_silent_uniform_half_of_tuple_unpack(self, tmp_path):
+        # `pidx, nproc = process_index(), process_count()` taints
+        # elementwise: the uniform nproc riding the same statement
+        # must not make count-gated collectives fire
+        fs = _run_pass(tmp_path, {"pkg/ok.py": (
+            "import jax\n"
+            "def maybe_sync(x):\n"
+            "    pidx, nproc = jax.process_index(), jax.process_count()\n"
+            "    if nproc > 1:\n"
+            "        x = jax.lax.psum(x, 'data')\n"
+            "    if pidx != 0:\n"
+            "        return x\n"
+            "    return x\n"
+        )}, CollectiveDivergencePass)
+        assert fs == []
+
+    def test_value_taint_is_cached_on_index(self, tmp_path):
+        root = _tree(tmp_path, self.DEADLOCK)
+        modules = load_modules(roots=["pkg"], repo=root)
+        index = FunctionIndex(modules)
+        seed_calls = []
+
+        def seed(n, _m):
+            seed_calls.append(n)
+            return set()
+
+        get_value_taint(modules, index, "probe", seed)
+        first = len(seed_calls)
+        assert first > 0
+        get_value_taint(modules, index, "probe", seed)
+        assert len(seed_calls) == first  # second call hit the cache
+
+
+# ------------------------------------------------------------------ mesh-axis
+class TestMeshAxis:
+    def test_fires_undeclared_axis_in_body(self, tmp_path):
+        # the misspelled-axis bug: dies at lowering, on the full fleet
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def lookup(tables, ids, mesh, shard_map):\n"
+            "    def body(t, i):\n"
+            "        return jax.lax.psum(t, 'modell')\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P('model'), P('data')),\n"
+            "                     out_specs=P('data'))(tables, ids)\n"
+        )}, MeshAxisPass)
+        assert _codes(fs) == ["undeclared-axis"]
+        assert fs[0].line == 5 and "'modell'" in fs[0].message
+
+    def test_fires_collective_outside_spmd(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax\n"
+            "def stray(x):\n"
+            "    return jax.lax.all_gather(x, 'model', tiled=True)\n"
+        )}, MeshAxisPass)
+        assert _codes(fs) == ["collective-outside-spmd"]
+        assert fs[0].line == 3
+
+    def test_fires_direct_shard_map_spellings(self, tmp_path):
+        # the jax-0.4.37 compat hazard the mesh.py wrapper contains:
+        # both the experimental import and the jax.shard_map attribute
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "from jax.experimental.shard_map import shard_map\n"
+        ), "pkg/n.py": (
+            "import jax\n"
+            "def f(body, mesh, spec):\n"
+            "    return jax.shard_map(body, mesh=mesh, in_specs=spec,\n"
+            "                         out_specs=spec)\n"
+        )}, MeshAxisPass)
+        assert _codes(fs) == ["direct-shard-map"]
+        assert sorted(f.path for f in fs) == ["pkg/m.py", "pkg/n.py"]
+
+    def test_fully_qualified_use_reports_once(self, tmp_path):
+        # jax.experimental.shard_map.shard_map nests two matching
+        # Attribute nodes — one finding per expression, not two
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax.experimental.shard_map\n"
+            "def f(body, mesh, spec):\n"
+            "    return jax.experimental.shard_map.shard_map(\n"
+            "        body, mesh=mesh, in_specs=spec, out_specs=spec)\n"
+        )}, MeshAxisPass)
+        assert _codes(fs) == ["direct-shard-map"]
+        # the import line + exactly ONE use finding
+        assert sorted(f.line for f in fs) == [1, 3]
+
+    def test_silent_declared_axes_via_module_constants(self, tmp_path):
+        # DATA_AXIS/MODEL_AXIS resolve like the real tree spells them
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "MODEL_AXIS = 'model'\n"
+            "DATA_AXIS = 'data'\n"
+            "def lookup(tables, ids, mesh, shard_map):\n"
+            "    def body(t, i):\n"
+            "        j = jax.lax.axis_index(MODEL_AXIS)\n"
+            "        del j\n"
+            "        return jax.lax.all_gather(t, MODEL_AXIS,\n"
+            "                                  tiled=True)\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(MODEL_AXIS, None),\n"
+            "                               P(DATA_AXIS, None)),\n"
+            "                     out_specs=P(DATA_AXIS, None))(\n"
+            "        tables, ids)\n"
+        )}, MeshAxisPass)
+        assert fs == []
+
+    def test_silent_dynamic_specs_are_skipped(self, tmp_path):
+        # P(axis) through a variable could declare anything: the site
+        # is skipped, never convicted against a partial set
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def apply(params, x, mesh, axis, shard_map):\n"
+            "    def body(p, v):\n"
+            "        return jax.lax.ppermute(v, 'stage',\n"
+            "                                perm=[(0, 1)])\n"
+            "    return shard_map(body, mesh=mesh,\n"
+            "                     in_specs=(P(axis), P()),\n"
+            "                     out_specs=P(axis))(params, x)\n"
+        )}, MeshAxisPass)
+        assert fs == []
+
+    def test_silent_replicated_specs_dynamic_mesh(self, tmp_path):
+        # all-replicated P() specs with a dynamic mesh resolve to an
+        # EMPTY closed set — but the mesh could declare anything, so
+        # the site is open (skipped), never convicted against []
+        fs = _run_pass(tmp_path, {"pkg/m.py": (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def reduce_all(x, mesh, shard_map):\n"
+            "    def body(v):\n"
+            "        return jax.lax.psum(v, 'data')\n"
+            "    return shard_map(body, mesh=mesh, in_specs=(P(),),\n"
+            "                     out_specs=P())(x)\n"
+        )}, MeshAxisPass)
+        assert fs == []
+
+    def test_wrapper_module_itself_is_exempt(self, tmp_path):
+        # parallel/mesh.py IS the sanctioned jax.shard_map toucher
+        fs = _run_pass(tmp_path, {
+            "dlrm_flexflow_tpu/parallel/mesh.py": (
+                "import jax\n"
+                "def shard_map(f, mesh, in_specs, out_specs):\n"
+                "    if hasattr(jax, 'shard_map'):\n"
+                "        return jax.shard_map(f, mesh=mesh,\n"
+                "                             in_specs=in_specs,\n"
+                "                             out_specs=out_specs)\n"
+                "    from jax.experimental.shard_map import shard_map \\\n"
+                "        as _sm\n"
+                "    return _sm(f, mesh=mesh, in_specs=in_specs,\n"
+                "               out_specs=out_specs)\n"
+            )}, MeshAxisPass)
+        assert fs == []
+
+    def test_real_tree_sites_resolve(self, repo_modules):
+        # the machinery sees the real multi-host layer: the overlap /
+        # table_exchange bodies resolve (two same-named `def body`s
+        # per function — nearest-preceding-def rule) with data+model
+        # declared, and the podshard fence creator is found
+        index = FunctionIndex(repo_modules)
+        sites = get_shard_map_sites(repo_modules, index)
+        by_file = {}
+        for s in sites:
+            by_file.setdefault(s.module.relpath, []).append(s)
+        for rel in ("dlrm_flexflow_tpu/parallel/overlap.py",
+                    "dlrm_flexflow_tpu/parallel/table_exchange.py"):
+            assert len(by_file[rel]) == 2
+            for s in by_file[rel]:
+                assert s.body is not None
+                assert s.declared_axes == {"data", "model"}
+                assert s.axes_known
+        contexts = get_spmd_contexts(repo_modules, index)
+        assert contexts  # bodies and their helpers are in-context
+        creators = get_fence_creators(repo_modules, index)
+        quals = {index.owner[fn][1] for fn in creators}
+        assert "CheckpointManager._barrier" in quals
+
+
+# ------------------------------------------------------------ barrier-protocol
+class TestBarrierProtocol:
+    def test_fires_fence_without_sweep(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import os, time\n"
+            "class Mgr:\n"
+            "    def __init__(self, d):\n"
+            "        self.directory = d\n"
+            "    def barrier(self, tag, pidx, nproc):\n"
+            "        bdir = os.path.join(self.directory,\n"
+            "                            f'.barrier-{tag}')\n"
+            "        os.makedirs(bdir, exist_ok=True)\n"
+            "        while len(os.listdir(bdir)) < nproc:\n"
+            "            time.sleep(0.01)\n"
+        )}, BarrierProtocolPass)
+        assert _codes(fs) == ["fence-no-sweep"]
+        assert fs[0].line == 8 and "Mgr" in fs[0].message
+
+    def test_fires_retry_loop_around_barrier(self, tmp_path):
+        # the documented single-attempt rule (resilience/manager.py):
+        # a retried attempt parks at a fresh fence while peers wait
+        # at the old one
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import os, shutil, time\n"
+            "class Mgr:\n"
+            "    def __init__(self, d):\n"
+            "        self.directory = d\n"
+            "    def _barrier(self, tag, pidx, nproc):\n"
+            "        bdir = os.path.join(self.directory,\n"
+            "                            f'.barrier-{tag}')\n"
+            "        os.makedirs(bdir, exist_ok=True)\n"
+            "        while len(os.listdir(bdir)) < nproc:\n"
+            "            time.sleep(0.01)\n"
+            "    def sweep(self):\n"
+            "        for name in os.listdir(self.directory):\n"
+            "            if name.startswith('.barrier-'):\n"
+            "                shutil.rmtree(os.path.join(\n"
+            "                    self.directory, name))\n"
+            "    def save(self, state, pidx, nproc):\n"
+            "        for attempt in range(3):\n"
+            "            try:\n"
+            "                self._barrier('tmp', pidx, nproc)\n"
+            "            except OSError:\n"
+            "                continue\n"
+            "            break\n"
+        )}, BarrierProtocolPass)
+        assert _codes(fs) == ["barrier-in-retry-loop"]
+        assert fs[0].detail == "Mgr.save"
+
+    def test_fires_nonzero_singleton_write(self, tmp_path):
+        # every process writing the one manifest races the commit
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import jax, json, os\n"
+            "def commit(path, files):\n"
+            "    pidx = jax.process_index()\n"
+            "    with open(os.path.join(path, 'manifest.json'),\n"
+            "              'w') as f:\n"
+            "        json.dump({'p': pidx, 'files': files}, f)\n"
+        )}, BarrierProtocolPass)
+        assert _codes(fs) == ["nonzero-singleton-write"]
+        assert "manifest.json" in fs[0].message
+
+    GOOD_PROTOCOL = {"pkg/ok.py": (
+        "import jax, json, os, shutil, time\n"
+        "MANIFEST = 'manifest.json'\n"
+        "class GoodMgr:\n"
+        "    def __init__(self, d):\n"
+        "        self.directory = d\n"
+        "    def _barrier(self, tag, pidx, nproc):\n"
+        "        bdir = os.path.join(self.directory,\n"
+        "                            f'.barrier-{tag}')\n"
+        "        os.makedirs(bdir, exist_ok=True)\n"
+        "        while len(os.listdir(bdir)) < nproc:\n"
+        "            time.sleep(0.01)\n"
+        "    def save(self, files, pidx, nproc):\n"
+        "        self._barrier('written', pidx, nproc)\n"
+        "        if pidx == 0:\n"
+        "            with open(os.path.join(self.directory,\n"
+        "                                   MANIFEST), 'w') as f:\n"
+        "                json.dump(files, f)\n"
+        "        self._barrier('commit', pidx, nproc)\n"
+        "        if pidx == 0:\n"
+        "            for name in os.listdir(self.directory):\n"
+        "                if name.startswith('.barrier-'):\n"
+        "                    shutil.rmtree(os.path.join(\n"
+        "                        self.directory, name))\n"
+    )}
+
+    def test_silent_full_podshard_shape(self, tmp_path):
+        # the PR-14 protocol shape end to end: fences swept by the
+        # minting class, straight-line barriers, manifest (via the
+        # MANIFEST constant) under the pidx==0 guard — nothing fires
+        fs = _run_pass(tmp_path, self.GOOD_PROTOCOL,
+                       BarrierProtocolPass)
+        assert fs == []
+
+    def test_silent_cadence_loop_in_other_module(self, tmp_path):
+        # a training loop saving per cadence is NOT a barrier retry:
+        # loops outside the minting class/module stay silent
+        files = dict(self.GOOD_PROTOCOL)
+        files["pkg/train.py"] = (
+            "from .ok import GoodMgr\n"
+            "def fit(batches, mgr, pidx, nproc):\n"
+            "    for b in batches:\n"
+            "        mgr.save(b, pidx, nproc)\n"
+        )
+        fs = _run_pass(tmp_path, files, BarrierProtocolPass)
+        assert fs == []
+
+    def test_silent_early_return_process0_guard(self, tmp_path):
+        # the OTHER standard spelling of the process-0 guard: every
+        # non-0 process leaves the function before the write
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import json, os\n"
+            "def commit(path, files, pidx):\n"
+            "    if pidx != 0:\n"
+            "        return\n"
+            "    with open(os.path.join(path, 'manifest.json'),\n"
+            "              'w') as f:\n"
+            "        json.dump(files, f)\n"
+        )}, BarrierProtocolPass)
+        assert fs == []
+
+    def test_silent_per_host_shard_writes(self, tmp_path):
+        # the replica-dedup rule: every host writes ITS OWN shard
+        # file — per-host names are not singletons
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import jax, json, os\n"
+            "def write_shards(path, parts):\n"
+            "    pidx = jax.process_index()\n"
+            "    with open(os.path.join(\n"
+            "            path, f'shard-p{pidx:03d}.json'), 'w') as f:\n"
+            "        json.dump(parts, f)\n"
+        )}, BarrierProtocolPass)
+        assert fs == []
+
+
+# ---------------------------------------- new passes x CLI/SARIF/baseline
+class TestSpmdPassesIntegration:
+    #: one firing fixture per new pass, in separate files so scope
+    #: filtering can split them
+    MIXED = {
+        "pkg/div.py": TestCollectiveDivergence.DEADLOCK["pkg/d.py"],
+        "pkg/axis.py": (
+            "from jax.experimental.shard_map import shard_map\n"),
+        "pkg/fence.py": (
+            "import os, time\n"
+            "class M:\n"
+            "    def barrier(self, d, nproc):\n"
+            "        os.makedirs(os.path.join(d, '.barrier-x'))\n"
+            "        while len(os.listdir(d)) < nproc:\n"
+            "            time.sleep(0.01)\n"),
+    }
+    NEW_PASSES = ["barrier-protocol", "collective-divergence",
+                  "mesh-axis"]
+
+    def _run(self, tmp_path, **kw):
+        root = _tree(tmp_path, self.MIXED)
+        return run_analysis(repo=root, roots=["pkg"],
+                            pass_names=self.NEW_PASSES, **kw)
+
+    def test_sarif_carries_new_pass_rules(self, tmp_path):
+        doc = to_sarif(self._run(tmp_path))
+        rules = {r["id"] for r in
+                 doc["runs"][0]["tool"]["driver"]["rules"]}
+        assert ("collective-divergence/"
+                "collective-in-divergent-branch") in rules
+        assert "mesh-axis/direct-shard-map" in rules
+        assert "barrier-protocol/fence-no-sweep" in rules
+        fps = [r["partialFingerprints"]["ffcheckWaiverKey/v1"]
+               for r in doc["runs"][0]["results"]]
+        assert all(fp.count(":") >= 3 for fp in fps)
+
+    def test_changed_only_scopes_new_passes(self, tmp_path):
+        res = self._run(tmp_path, only_paths=["pkg/div.py"])
+        assert {f.pass_name for f in res.findings} == \
+            {"collective-divergence"}
+        res = self._run(tmp_path, only_paths=["pkg/axis.py",
+                                              "pkg/fence.py"])
+        assert {f.pass_name for f in res.findings} == \
+            {"mesh-axis", "barrier-protocol"}
+
+    def test_update_baseline_with_new_pass_waivers(self, tmp_path):
+        res = self._run(tmp_path)
+        keys = sorted({f.waiver_key for f in res.findings})
+        assert len(keys) == 3  # one per new pass
+        wfile = tmp_path / "W.txt"
+        wfile.write_text("".join(f"{k} | fixture\n" for k in keys))
+        waivers = Waivers.load(str(wfile))
+        res = self._run(tmp_path, waivers=waivers)
+        assert res.ok
+        kept = update_baseline(res, waivers, str(wfile))
+        assert kept == keys
+        # an unwaived new-pass finding refuses regeneration
+        res = self._run(tmp_path)
+        with pytest.raises(BaselineError):
+            update_baseline(res, None, str(wfile))
+
+    def test_by_pass_and_report_delta_cover_new_passes(self, tmp_path):
+        from dlrm_flexflow_tpu.telemetry.report import analysis_delta
+        doc = self._run(tmp_path).to_dict()
+        assert set(self.NEW_PASSES) <= set(doc["by_pass"])
+        prev = json.loads(json.dumps(doc))
+        prev["by_pass"]["collective-divergence"]["findings"] += 2
+        d = analysis_delta(doc, prev)
+        assert d["per_pass"]["collective-divergence"]["findings"] == -2
+
+
 # --------------------------------------------------------- baseline + sarif
 class TestBaselineAndSarif:
     def test_update_baseline_preserves_and_prunes(self, tmp_path):
@@ -1255,7 +1793,7 @@ class TestCLI:
              os.path.join(REPO, "scripts", "check_analysis.py")],
             capture_output=True, text=True, env=ENV)
         assert r.returncode == 0, r.stdout + r.stderr
-        assert "OK (6 analysis paths)" in r.stdout
+        assert "OK (9 analysis paths)" in r.stdout
 
 
 # ------------------------------------------------- telemetry report section
