@@ -138,9 +138,13 @@ def _telemetry_ctx(app):
                          "artifacts")
         os.makedirs(d, exist_ok=True)
         p = os.path.join(d, f"telemetry_{app}.jsonl")
-    from dlrm_flexflow_tpu.telemetry import event_log
+    # fleet_event_log: single-process this IS event_log(path, mode="w");
+    # under process_count() > 1 each process writes its own
+    # telemetry_<app>_pNNN.jsonl stamped with pidx/slice, and
+    # `telemetry report <artifacts dir>` (or --fleet) merges them
+    from dlrm_flexflow_tpu.telemetry import fleet_event_log
 
-    return event_log(path=p, mode="w")
+    return fleet_event_log(path=p, mode="w")
 
 
 def _telemetry_tail(model, state, inputs, thpt, probe_us,
@@ -205,6 +209,28 @@ def _checkpoint_tail(model, state, app):
             state, model=model)
     except Exception as e:
         print(f"# bench checkpoint failed: {e!r}", file=sys.stderr)
+
+
+def _exposed_comm_extra():
+    """Measured exposed-comm share of the run as extra provenance —
+    like ``strategy_version``: remaps nothing numeric and is NOT part
+    of the anchor key.  Read from the run's ``phase_time`` summary
+    events (the fit loops emit them; the scanned bench windows have no
+    host loop to attribute, so the field is simply absent there)."""
+    try:
+        from dlrm_flexflow_tpu.telemetry import active_log
+
+        log = active_log()
+        if log is None:
+            return {}
+        sums = [e for e in log.events("phase_time")
+                if e.get("phase") != "step" and "exposed_comm_pct" in e]
+        if not sums:
+            return {}
+        return {"exposed_comm_pct":
+                round(float(sums[-1]["exposed_comm_pct"]), 2)}
+    except Exception:
+        return {}
 
 
 def _probe_us():
@@ -553,6 +579,7 @@ def main():
                  "probe_us": round(probe_us, 1), **prov,
                  **({"strategy_version": strategy_version}
                     if strategy_version is not None else {}),
+                 **_exposed_comm_extra(),
                  **_mfu_extras(model, batch, epochs * num_batches, prov)})
 
 
@@ -766,6 +793,7 @@ def bench_app(app: str):
     key = {"app": app, "batch": batch, "num_batches": nb, "epochs": epochs}
     extra = {"dtype": dtype, "prefetch": prefetch,
              "probe_us": round(probe_us, 1), **prov,
+             **_exposed_comm_extra(),
              **_mfu_extras(model, batch, epochs * nb, prov)}
     if app in CONV_APPS:
         # activation STORAGE dtype changes numerics (loss pinned only to

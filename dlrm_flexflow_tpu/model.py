@@ -53,7 +53,9 @@ from .parallel.mesh import (DATA_AXIS, MODEL_AXIS, constrain, make_mesh,
                             param_pspec, pspec_for_config, sharding)
 from .parallel.parallel_config import Strategy
 from .telemetry import active_log, sample_memory
+from .telemetry import fleet as _fleet
 from .telemetry import metrics as _tmetrics
+from .telemetry import rowfreq as _rowfreq
 from .telemetry.trace import start_span
 from .tensor import Tensor, as_dtype
 
@@ -2508,8 +2510,17 @@ class FFModel:
         # thread-local stack) so an exception mid-fit can abandon spans
         # but can never corrupt another run's parenting.  Spans no-op
         # when telemetry is off.
+        if scan_data is not None:
+            # row-frequency telemetry (telemetry/rowfreq.py): the
+            # scanned/fused paths stage the whole epoch up front and
+            # never loop on host, so sample the staged id tensors once
+            # here — OUTSIDE the timed window, off the traced graph
+            _rowfreq.observe_dataset(scan_data[0])
         fit_span = start_span("train.fit", attrs={"epochs": int(epochs)})
         t0 = time.perf_counter()
+        pstep = 0                 # per-batch host step counter: the
+        #                           global-step key fleet merge aligns on
+        last_iter_t = t0
         samples = 0
         epochs_run = int(epochs)  # early stop shortens the per-epoch loop
         last_loss = None          # final epoch's folded loss (step event)
@@ -2562,8 +2573,10 @@ class FFModel:
                             inputs, labels = next(batches)
                         except StopIteration:
                             break
-                        stall_s += time.perf_counter() - ts
+                        bstall = time.perf_counter() - ts
+                        stall_s += bstall
                         it += 1
+                        _rowfreq.observe_batch(inputs)
                         for cb in cbs:
                             cb.on_batch_begin(it)
                         dspan = start_span("train.dispatch",
@@ -2573,8 +2586,25 @@ class FFModel:
                         td = time.perf_counter()
                         state, mets = self.train_step(state, inputs,
                                                       labels)
-                        dispatch_s += time.perf_counter() - td
+                        dwall = time.perf_counter() - td
+                        dispatch_s += dwall
                         dspan.end()
+                        pstep += 1
+                        log = active_log()
+                        if log is not None:
+                            # per-step phase attribution: walls sum to
+                            # the loop wall (no per-step sync — this
+                            # loop never blocks; the final fence's wall
+                            # lands on the summary event below)
+                            now = time.perf_counter()
+                            log.emit("phase_time", step=pstep,
+                                     phase="step",
+                                     step_wall_ms=(now - last_iter_t)
+                                     * 1e3,
+                                     data_wait_ms=bstall * 1e3,
+                                     dispatch_ms=dwall * 1e3,
+                                     samples=int(labels.shape[0]))
+                            last_iter_t = now
                         samples += int(labels.shape[0])
                         acc.update({k: v for k, v in mets.items()
                                     if k != "loss"})
@@ -2596,7 +2626,9 @@ class FFModel:
         finally:
             if own_prefetch is not None:
                 own_prefetch.close()
+        tf = time.perf_counter()
         device_fence(state.step)
+        fence_s = time.perf_counter() - tf
         elapsed = time.perf_counter() - t0
         thpt = samples / max(elapsed, 1e-9)
         fit_span.set_attr("samples", int(samples))
@@ -2629,6 +2661,28 @@ class FFModel:
                      loss=(float(np.asarray(last_loss))
                            if last_loss is not None else None),
                      **pipeline_fields)
+            if per_batch:
+                # whole-run phase attribution: the per-batch loop runs
+                # ahead of the device, so the final fence's wall is the
+                # device work the host did NOT hide — the measured
+                # exposed (grad-sync) wait next to the cost model's
+                # prediction.  The scanned/fused paths have no host
+                # loop to overlap, so a fence wall there would just be
+                # the device compute — no summary for them.
+                exposed = 100.0 * fence_s / max(elapsed, 1e-9)
+                pred = _fleet.predicted_sync_ms(
+                    getattr(state, "params", None))
+                log.emit("phase_time", step=pstep, phase="fit",
+                         steps=pstep, step_wall_ms=elapsed * 1e3,
+                         data_wait_ms=stall_s * 1e3,
+                         dispatch_ms=dispatch_s * 1e3,
+                         sync_wait_ms=fence_s * 1e3,
+                         exposed_comm_pct=exposed,
+                         predicted_sync_ms=(None if pred is None
+                                            else pred * max(pstep, 1)),
+                         samples=int(samples))
+                _tmetrics.EXPOSED_COMM_PCT.set(exposed)
+            _rowfreq.emit_all(log)
             sample_memory(phase="fit", log=log)
         if verbose and show_throughput:
             print(f"ELAPSED TIME = {elapsed:.4f}s, THROUGHPUT = {thpt:.2f} samples/s")

@@ -57,6 +57,8 @@ from ..data.prefetch import PrefetchLoader
 from ..metrics import MetricsAccumulator
 from ..telemetry import active_log, sample_memory
 from ..telemetry import metrics as _tmetrics
+from ..telemetry import rowfreq
+from ..telemetry.fleet import dump_flight_record, predicted_sync_ms
 from ..telemetry.trace import pop_span, push_span, start_span
 from . import faultinject
 from .manager import CheckpointManager
@@ -75,10 +77,11 @@ class _Pending:
 
     __slots__ = ("pre_state", "new_state", "mets", "step", "lr", "span",
                  "inputs", "labels", "host_snap", "loader_sd",
-                 "n_samples")
+                 "n_samples", "data_wait_s", "dispatch_wall_s")
 
     def __init__(self, pre_state, new_state, mets, step, lr, span,
-                 inputs, labels, host_snap, loader_sd, n_samples):
+                 inputs, labels, host_snap, loader_sd, n_samples,
+                 data_wait_s=0.0, dispatch_wall_s=0.0):
         self.pre_state = pre_state
         self.new_state = new_state
         self.mets = mets
@@ -90,6 +93,8 @@ class _Pending:
         self.host_snap = host_snap
         self.loader_sd = loader_sd
         self.n_samples = n_samples
+        self.data_wait_s = data_wait_s
+        self.dispatch_wall_s = dispatch_wall_s
 
 
 def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
@@ -159,6 +164,11 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                 state, extra, _path = reshard_restore(manager, model)
             else:
                 state, extra, _path = manager.restore_latest(model=model)
+        except BaseException as e:
+            # a failed resume (CheckpointError, reshard blow-up) dies
+            # with its last events on record too
+            dump_flight_record(e)
+            raise
         finally:
             pop_span(fit_span)
         if extra.get("loader") is not None \
@@ -191,7 +201,10 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     pending: list = [None]      # the one unverified dispatch, or None
     stall_s = [0.0]             # host wall waiting on the dataloader
     dispatch_s = [0.0]          # host wall issuing train_step dispatches
+    sync_s = [0.0]              # host wall blocked on folded losses —
+    #                             the measured exposed-comm column
     t0 = time.perf_counter()
+    last_adopt = [t0]           # adopt-to-adopt wall = one step's wall
 
     cur_ep = [fit_span]  # the ambient parent for cadence saves
 
@@ -206,9 +219,14 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         finally:
             pop_span(cur_ep[0])
 
-    def adopt(p: _Pending, loss_f: float, ep: int):
+    def adopt(p: _Pending, loss_f: float, ep: int, wait_s: float = 0.0):
         """Commit one verified dispatch: loss trace, metrics fold,
-        throughput counters, cadence checkpoint."""
+        throughput counters, phase attribution, cadence checkpoint.
+        ``wait_s`` is the host wall settle() spent blocked on this
+        dispatch's folded loss — at lag 1 the device window overlapped
+        host work, so blocking beyond it is EXPOSED wait (grad-sync on
+        comm-bound steps): the measured column of the step-phase
+        report."""
         step_no = p.step + 1
         _tmetrics.TRAIN_STEPS.inc()
         samples[0] += p.n_samples
@@ -216,6 +234,16 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         loss_steps.append(step_no)
         acc.update({k: v for k, v in p.mets.items() if k != "loss"})
         model._fit_state = p.new_state
+        log = active_log()
+        if log is not None:
+            now = time.perf_counter()
+            log.emit("phase_time", step=step_no, phase="step",
+                     step_wall_ms=(now - last_adopt[0]) * 1e3,
+                     data_wait_ms=p.data_wait_s * 1e3,
+                     dispatch_ms=p.dispatch_wall_s * 1e3,
+                     sync_wait_ms=wait_s * 1e3,
+                     samples=p.n_samples)
+            last_adopt[0] = now
         if every_n_steps and step_no % every_n_steps == 0:
             # a save at the epoch's final batch marks the NEXT epoch
             # (the loader cursor has wrapped to 0 already)
@@ -244,14 +272,19 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             new_state, mets = model.train_step(retry_state, binputs,
                                                blabels, donate=False)
             dispatch_s[0] += time.perf_counter() - td
+            tw = time.perf_counter()
             loss_f = float(_local_value(mets["loss"]))
+            wait = time.perf_counter() - tw
+            sync_s[0] += wait
             if sentinel.observe(loss_f, new_state, step=p.step, lr=lr):
                 rspan.end()
                 state = new_state
                 global_step = p.step + 1
                 adopt(_Pending(retry_state, new_state, mets, p.step, lr,
                                rspan, p.inputs, p.labels, host_snap,
-                               p.loader_sd, p.n_samples), loss_f, ep)
+                               p.loader_sd, p.n_samples,
+                               p.data_wait_s, p.dispatch_wall_s),
+                      loss_f, ep, wait_s=wait)
                 return
             rspan.set_attr("policy", sentinel.policy)
             rspan.end(status="rejected")
@@ -272,11 +305,14 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         p, pending[0] = pending[0], None
         if p is None:
             return True
+        tw = time.perf_counter()
         loss_f = float(_local_value(p.mets["loss"]))
+        wait = time.perf_counter() - tw
+        sync_s[0] += wait
         if sentinel is None or sentinel.observe(loss_f, p.new_state,
                                                 step=p.step, lr=p.lr):
             p.span.end()
-            adopt(p, loss_f, ep)
+            adopt(p, loss_f, ep, wait_s=wait)
             return True
         # REJECTED one step late: p.pre_state is still live (the
         # non-donating step left its buffers alive); host-side hetero
@@ -315,8 +351,10 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                     inputs, labels = next(batches)
                 except StopIteration:
                     break
-                stall_s[0] += time.perf_counter() - ts
+                bstall = time.perf_counter() - ts
+                stall_s[0] += bstall
                 it += 1
+                rowfreq.observe_batch(inputs)  # ~0 when telemetry off
                 # cursor at FETCH time = resume position after this
                 # batch (prefetching loaders report consumed-exact
                 # state; the plain loader's cursor is already here).
@@ -349,11 +387,12 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                     td = time.perf_counter()
                     new_state, mets = model.train_step(
                         state, binputs, blabels, donate=donate)
-                    dispatch_s[0] += time.perf_counter() - td
+                    dwall = time.perf_counter() - td
+                    dispatch_s[0] += dwall
                     lr = float(getattr(model.optimizer, "lr", 0.0))
                     cur = _Pending(state, new_state, mets, global_step,
                                    lr, dspan, inputs, labels, host_snap,
-                                   loader_sd, n_samples)
+                                   loader_sd, n_samples, bstall, dwall)
                     # speculatively advance so the PREVIOUS dispatch's
                     # loss check overlaps this one's device window
                     state = new_state
@@ -399,6 +438,14 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             if early_stop:
                 print(f"Accuracy reached, early stop, epoch: {ep - 1}")
                 break
+    except BaseException as e:
+        # flight recorder (telemetry/fleet.py): TrainingDiverged, a
+        # cadence-save CheckpointError, injected Preemption/Reshape
+        # faults, and any unhandled exception all dump the EventLog
+        # ring + open spans before the raise continues — best-effort,
+        # the ORIGINAL exception always propagates unchanged
+        dump_flight_record(e)
+        raise
     finally:
         if own_prefetch is not None:
             own_prefetch.close()
@@ -423,6 +470,23 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                  loss=last_loss,
                  data_stall_ms=round(stall_s[0] * 1e3, 3),
                  dispatch_ms=round(dispatch_s[0] * 1e3, 3))
+        # whole-stretch phase attribution: measured exposed comm (the
+        # host wall blocked on folded losses at lag 1) next to the
+        # two-level cost model's predicted grad-sync wall for the same
+        # number of steps — the PERF.md predicted-vs-measured row
+        exposed = 100.0 * sync_s[0] / max(elapsed, 1e-9)
+        pred = predicted_sync_ms(getattr(state, "params", None))
+        log.emit("phase_time", step=global_step, phase="resilient_fit",
+                 steps=len(loss_steps), step_wall_ms=elapsed * 1e3,
+                 data_wait_ms=stall_s[0] * 1e3,
+                 dispatch_ms=dispatch_s[0] * 1e3,
+                 sync_wait_ms=sync_s[0] * 1e3,
+                 exposed_comm_pct=exposed,
+                 predicted_sync_ms=(None if pred is None
+                                    else pred * max(len(loss_steps), 1)),
+                 samples=int(samples[0]))
+        _tmetrics.EXPOSED_COMM_PCT.set(exposed)
+        rowfreq.emit_all(log)
         sample_memory(phase="resilient_fit", log=log)
     if verbose and show_throughput:
         print(f"ELAPSED TIME = {elapsed:.4f}s, "

@@ -22,6 +22,19 @@ typed, schema-checked events from every layer of the framework:
                   (submit → queue-wait → forward → reply) and training
                   chains (fit → epoch → dispatch → checkpoint)
                   (telemetry/trace.py)
+  * ``phase_time`` — per-phase step walls (data wait / dispatch /
+                  grad-sync wait) and the measured exposed-comm share
+                  next to the cost model's prediction (the fit loops)
+  * ``row_freq`` — per-table embedding row-access frequency summaries
+                  (telemetry/rowfreq.py — LFU admission input)
+
+Multi-host runs write one ``telemetry_pNNN.jsonl`` sink per process,
+stamped with ``pidx``/``slice`` (``fleet_event_log``); ``report`` on
+the directory (or ``--fleet DIR``) merges them and renders straggler
+skew, per-slice throughput, and the exposed-comm fraction.  A dying
+``resilient_fit`` dumps its EventLog ring + open spans to
+``artifacts/flightrecorder_<ts>.json`` (``dump_flight_record``;
+``report --flight PATH`` renders it).
 
 Activate with ``set_event_log(EventLog(path=...))`` or the scoped
 ``event_log(...)`` context manager; producers no-op when telemetry is
@@ -37,15 +50,23 @@ opt-in via ``FFConfig.metrics_port`` / ``--metrics-port``.
 
 from .events import (EventLog, active_log, emit, event_log,
                      sample_memory, set_event_log, suppressed)
+from .fleet import (dump_flight_record, find_flight_records,
+                    fleet_data, fleet_event_log, fleet_stamp,
+                    load_fleet_events, load_flight_record,
+                    process_sink_path)
 from .jax_hooks import compile_stats, install_compile_hooks
+from .rowfreq import RowFreqCounter
 from .schema import SCHEMA, SCHEMA_VERSION, validate_event
-from .trace import (NULL_SPAN, Span, current_span, record_span, span,
-                    start_span)
+from .trace import (NULL_SPAN, Span, current_span, open_span_records,
+                    record_span, span, start_span)
 
 __all__ = [
     "EventLog", "active_log", "emit", "event_log",
     "sample_memory", "set_event_log", "suppressed", "compile_stats",
     "install_compile_hooks", "SCHEMA", "SCHEMA_VERSION", "validate_event",
-    "NULL_SPAN", "Span", "current_span", "record_span", "span",
-    "start_span",
+    "NULL_SPAN", "Span", "current_span", "open_span_records",
+    "record_span", "span", "start_span",
+    "dump_flight_record", "find_flight_records", "fleet_data",
+    "fleet_event_log", "fleet_stamp", "load_fleet_events",
+    "load_flight_record", "process_sink_path", "RowFreqCounter",
 ]

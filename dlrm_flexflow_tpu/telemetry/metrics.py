@@ -104,6 +104,17 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "dlrm_strategy_version": (
         "gauge", "version number of the incumbent SOAP strategy "
                  "artifact"),
+    "dlrm_step_skew_ms": (
+        "gauge", "fleet straggler skew: slowest minus median host "
+                 "step wall of the newest aligned step across merged "
+                 "per-process telemetry (telemetry/fleet.py — "
+                 "docs/telemetry.md)"),
+    "dlrm_exposed_comm_pct": (
+        "gauge", "measured exposed-communication share of the step "
+                 "wall: host time blocked on device completion "
+                 "(grad-sync wait) as a percent of the most recent "
+                 "fit window's wall — the measured column next to "
+                 "the cost model's DCN-exposed prediction (PERF.md)"),
 }
 
 
@@ -718,3 +729,9 @@ SIM_CALIBRATION_ERROR = REGISTRY.register(
 STRATEGY_AGE = REGISTRY.register(
     Gauge("dlrm_strategy_age_s", fn=_strategy_age))
 STRATEGY_VERSION = REGISTRY.register(Gauge("dlrm_strategy_version"))
+# fleet observability (telemetry/fleet.py): set-gauges whose last
+# value is retained across runs — a fleet_data() merge or a fit
+# window's summary phase_time folds its final reading in on retire,
+# so a scrape between runs still sees the newest known value.
+STEP_SKEW_MS = REGISTRY.register(Gauge("dlrm_step_skew_ms"))
+EXPOSED_COMM_PCT = REGISTRY.register(Gauge("dlrm_exposed_comm_pct"))

@@ -37,7 +37,17 @@ from .schema import validate_event
 def load_events(path: str, strict: bool = False) -> List[dict]:
     """Parse a telemetry JSONL.  Malformed/invalid lines are skipped
     (``strict=True`` raises instead) so a report still renders from a
-    partially-written file of a crashed run."""
+    partially-written file of a crashed run.
+
+    A DIRECTORY is accepted anywhere a single file is: it merges every
+    per-process ``*.jsonl`` sink inside (telemetry/fleet.py — the
+    ``telemetry_pNNN.jsonl`` files a pod run writes), time-ordered and
+    attributed by ``pidx``.  Single-file behavior is bit-identical to
+    before."""
+    if os.path.isdir(path):
+        from .fleet import load_fleet_events
+
+        return load_fleet_events(path, strict=strict)
     out: List[dict] = []
     with open(path) as f:
         for i, line in enumerate(f):
@@ -265,6 +275,59 @@ def distributed_summary(events: List[dict]) -> List[str]:
         if e.get("slices"):
             line += f", {e['slices']} slice(s)"
         lines.append(line)
+    return lines
+
+
+def _phase_mean(evs: List[dict], key: str) -> Optional[float]:
+    vals = [float(e[key]) for e in evs if key in e]
+    return sum(vals) / len(vals) if vals else None
+
+
+def phase_summary(events: List[dict]) -> List[str]:
+    """The ``== step phases ==`` section (``phase_time`` events,
+    docs/telemetry.md): mean per-phase walls over the attributed steps,
+    then each fit summary's exposed-comm share and its cost-model
+    predicted vs measured grad-sync wall — summaries render WORST
+    prediction error first, same convention as the per-op table."""
+    pts = [e for e in events if e.get("type") == "phase_time"]
+    if not pts:
+        return []
+    lines = ["== step phases =="]
+    per = [e for e in pts if e.get("phase") == "step"]
+    if per:
+        wall = _phase_mean(per, "step_wall_ms") or 0.0
+        parts = []
+        for key, label in (("data_wait_ms", "data wait"),
+                           ("dispatch_ms", "dispatch"),
+                           ("forward_ms", "forward"),
+                           ("backward_ms", "backward"),
+                           ("sync_wait_ms", "sync wait")):
+            v = _phase_mean(per, key)
+            if v is not None:
+                parts.append(f"{label} {v:.2f}")
+        line = (f"{len(per)} attributed step(s): "
+                f"wall mean {wall:.2f} ms")
+        if parts:
+            line += " (" + ", ".join(parts) + " ms)"
+        lines.append(line)
+    rows = []
+    for e in pts:
+        if e.get("phase") == "step":
+            continue
+        line = (f"{e.get('phase', 'fit')}: {e.get('steps', 1)} step(s) "
+                f"to step {e['step']}, wall {e['step_wall_ms']:.1f} ms")
+        if "exposed_comm_pct" in e:
+            line += f", exposed comm {e['exposed_comm_pct']:.1f}%"
+        pred = e.get("predicted_sync_ms")
+        meas = e.get("sync_wait_ms")
+        err = None
+        if pred is not None and meas is not None and float(meas) > 0:
+            err = 100.0 * abs(float(pred) - float(meas)) / float(meas)
+            line += (f", grad-sync predicted {float(pred):.2f} ms vs "
+                     f"measured {float(meas):.2f} ms (err {err:.0f}%)")
+        rows.append((-1.0 if err is None else err, line))
+    rows.sort(key=lambda r: -r[0])  # worst prediction error first
+    lines.extend(line for _, line in rows)
     return lines
 
 
@@ -643,13 +706,28 @@ def analysis_summary(doc: dict, src: str,
 
 #: section name -> text renderer; report_data mirrors these keys so the
 #: text and JSON forms can never disagree about which sections a run has
+def _fleet_section(events: List[dict]) -> List[str]:
+    from .fleet import fleet_section
+
+    return fleet_section(events)
+
+
+def _row_freq_section(events: List[dict]) -> List[str]:
+    from .rowfreq import row_freq_summary
+
+    return row_freq_summary(events)
+
+
 SECTIONS = (
     ("throughput", throughput_summary),
+    ("fleet", _fleet_section),
     ("distributed", distributed_summary),
+    ("phases", phase_summary),
     ("per_op", per_op_table),
     ("calibration", calibration_summary),
     ("compile", compile_timeline),
     ("memory", memory_summary),
+    ("row_freq", _row_freq_section),
     ("search", search_summary),
     ("tuning", tuning_summary),
     ("resilience", resilience_summary),
@@ -771,6 +849,35 @@ def report_data(events: List[dict],
              for k in ("verdict", "version", "incumbent_version",
                        "candidate_s", "incumbent_s")
              if k in promos[-1]})
+    pts = by.get("phase_time", [])
+    if pts:
+        h = headline["phases"]
+        h["attributed_steps"] = sum(1 for e in pts
+                                    if e.get("phase") == "step")
+        sums = [e for e in pts if e.get("phase") != "step"]
+        exposed = [e for e in sums if "exposed_comm_pct" in e]
+        if exposed:
+            h["exposed_comm_pct"] = exposed[-1]["exposed_comm_pct"]
+        preds = [e for e in sums
+                 if "predicted_sync_ms" in e and "sync_wait_ms" in e]
+        if preds:
+            e = preds[-1]
+            h["predicted_sync_ms"] = e["predicted_sync_ms"]
+            h["measured_sync_ms"] = e["sync_wait_ms"]
+    if len({e["pidx"] for e in events if "pidx" in e}) >= 2:
+        from .fleet import fleet_data
+
+        headline["fleet"] = fleet_data(events)
+    rfs = by.get("row_freq", [])
+    if rfs:
+        latest: Dict[str, dict] = {}
+        for e in rfs:
+            latest[e["table"]] = e
+        headline["row_freq"]["tables"] = {
+            t: {k: e[k] for k in ("rows_seen", "unique_ids", "top_ids",
+                                  "top_counts", "bucket_counts")
+                if k in e}
+            for t, e in latest.items()}
     inits = by.get("distributed", [])
     if inits:
         headline["distributed"] = {
@@ -820,13 +927,24 @@ def main(argv=None) -> int:
         description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="cmd")
     rep = sub.add_parser("report", help="summarize a telemetry JSONL")
-    rep.add_argument("path")
+    rep.add_argument("path", nargs="?", default=None,
+                     help="one telemetry JSONL, or a directory of "
+                          "per-process telemetry_pNNN.jsonl sinks "
+                          "(merged and attributed by pidx)")
     rep.add_argument("--strict", action="store_true",
                      help="fail on malformed/invalid lines instead of "
                           "skipping them")
     rep.add_argument("--format", choices=("text", "json"), default="text",
                      help="text sections (default) or one JSON object "
                           "with the same sections")
+    rep.add_argument("--fleet", metavar="DIR", default=None,
+                     help="merge a directory of per-process sinks and "
+                          "render the fleet view (same as passing the "
+                          "directory as PATH)")
+    rep.add_argument("--flight", metavar="PATH", default=None,
+                     help="render one flight-recorder artifact "
+                          "(artifacts/flightrecorder_<ts>.json): the "
+                          "last seconds before the run died")
     exp = sub.add_parser("export-trace",
                          help="render spans + step/compile/op_time "
                               "events as Chrome-trace JSON for "
@@ -840,13 +958,24 @@ def main(argv=None) -> int:
                         "see `regress --help`)")
     args = p.parse_args(argv)
     if args.cmd == "report":
-        events = load_events(args.path, strict=args.strict)
+        if args.flight is not None:
+            from .fleet import load_flight_record, render_flight
+
+            print("\n".join(render_flight(
+                load_flight_record(args.flight))))
+            return 0
+        src = args.fleet if args.fleet is not None else args.path
+        if src is None:
+            rep.error("a telemetry PATH, --fleet DIR, or "
+                      "--flight PATH is required")
+        events = load_events(src, strict=args.strict)
         # the == analysis == section rides along when an ffcheck sink
         # (artifacts/analysis_*.json) sits next to the run or the CWD;
         # the second-newest sink (when present) feeds the delta line
         analysis = None
-        sinks = find_analysis_artifacts(os.path.dirname(args.path)
-                                        or ".")
+        sinks = find_analysis_artifacts(
+            src if os.path.isdir(src)
+            else (os.path.dirname(src) or "."))
         if sinks:
             doc = load_analysis(sinks[0])
             if doc is not None:

@@ -1,0 +1,235 @@
+"""Row-frequency telemetry: which embedding rows are hot
+(docs/telemetry.md, the input ROADMAP item 4's LFU admission policy
+needs).
+
+A :class:`RowFreqCounter` counts id accesses per embedding table on
+the HOST, off the traced graph: the fit loops hand it the integer id
+batches they are about to dispatch (:func:`observe_batch`), it counts
+every ``sample_every``-th batch only, and the whole thing is gated on
+``active_log()`` — telemetry off, or between sampled batches, the hot
+path pays one global read and one modulo.
+
+The summary a counter emits (one ``row_freq`` event per table) is a
+power-of-two histogram — ``bucket_counts[b]`` = number of distinct
+ids accessed between ``2^b`` and ``2^(b+1)-1`` times — plus the top-k
+hottest ids ranked first.  Power-law id streams (the DLRM reality)
+concentrate mass in few rows, so a bounded table with
+prune-the-coldest eviction tracks the head exactly: eviction only
+ever drops ids from the long cold tail.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .events import EventLog, active_log
+
+
+class RowFreqCounter:
+    """Bounded id-frequency counter for one embedding table."""
+
+    def __init__(self, table: str, capacity: int = 65536):
+        self.table = str(table)
+        self.capacity = int(capacity)
+        self.counts: Dict[int, int] = {}
+        self.rows_seen = 0
+        self.sampled_batches = 0
+        self.evicted = 0
+
+    def observe(self, ids) -> None:
+        """Count one batch of ids (any shape — flattened).  Cost is one
+        ``np.unique`` over the batch plus a dict merge of its distinct
+        ids — microseconds at DLRM batch sizes."""
+        arr = np.asarray(ids).reshape(-1)
+        if arr.size == 0:
+            return
+        uniq, cnt = np.unique(arr, return_counts=True)
+        self.rows_seen += int(arr.size)
+        self.sampled_batches += 1
+        counts = self.counts
+        for i, n in zip(uniq.tolist(), cnt.tolist()):
+            counts[i] = counts.get(i, 0) + n
+        if len(counts) > 2 * self.capacity:
+            self._prune()
+
+    def _prune(self) -> None:
+        # keep the hottest ``capacity`` ids: on a power-law stream the
+        # dropped tail is ids seen a handful of times, so the head
+        # ranking (what LFU admission reads) survives eviction intact
+        keep = heapq.nlargest(self.capacity, self.counts.items(),
+                              key=lambda kv: (kv[1], -kv[0]))
+        self.evicted += len(self.counts) - len(keep)
+        self.counts = dict(keep)
+
+    def top(self, k: int = 16) -> List[tuple]:
+        """The k hottest (id, count) pairs, hottest first (count desc,
+        then id asc for a deterministic order)."""
+        return heapq.nsmallest(k, self.counts.items(),
+                               key=lambda kv: (-kv[1], kv[0]))
+
+    def bucket_counts(self) -> List[int]:
+        """``out[b]`` = distinct ids with count in [2^b, 2^(b+1))."""
+        if not self.counts:
+            return []
+        out: List[int] = []
+        for c in self.counts.values():
+            b = max(int(c), 1).bit_length() - 1
+            if b >= len(out):
+                out.extend([0] * (b + 1 - len(out)))
+            out[b] += 1
+        return out
+
+    def emit(self, log: Optional[EventLog] = None,
+             top_k: int = 16) -> Optional[dict]:
+        """Emit this table's ``row_freq`` summary event (no-op when
+        telemetry is off or nothing was observed)."""
+        log = log if log is not None else active_log()
+        if log is None or not self.rows_seen:
+            return None
+        pairs = self.top(top_k)
+        return log.emit(
+            "row_freq", table=self.table, rows_seen=self.rows_seen,
+            unique_ids=len(self.counts),
+            top_ids=[int(i) for i, _ in pairs],
+            top_counts=[int(c) for _, c in pairs],
+            bucket_counts=self.bucket_counts(),
+            sampled_batches=self.sampled_batches,
+            sample_every=_sample_every(),
+            capacity=self.capacity,
+            evicted=(self.evicted or None))
+
+
+# ------------------------------------------------------- process registry
+# The fit loops observe through one process-wide registry keyed by
+# table name, so a resumed fit keeps accumulating into the same
+# counters.  The lock only guards registry mutation (counter creation /
+# reset) — observe() itself runs on the single training thread.
+_counters: Dict[str, RowFreqCounter] = {}
+_lock = threading.Lock()
+_batch_no = 0
+
+
+def _sample_every() -> int:
+    try:
+        return max(1, int(os.environ.get("FF_ROWFREQ_EVERY", "8")))
+    except ValueError:
+        return 8
+
+
+def counter(table: str, capacity: int = 65536) -> RowFreqCounter:
+    c = _counters.get(table)
+    if c is None:
+        with _lock:
+            c = _counters.setdefault(table,
+                                     RowFreqCounter(table, capacity))
+    return c
+
+
+def reset() -> None:
+    """Drop every counter and the batch cadence (tests)."""
+    global _batch_no
+    with _lock:
+        _counters.clear()
+        _batch_no = 0
+
+
+def _tables(name: str, arr) -> List[tuple]:
+    """Split one integer input tensor into per-table id streams: a
+    DLRM sparse input is [batch, tables, bag], so axis 1 indexes the
+    embedding table and each slice gets its own counter
+    (``name[t]``); rank <= 2 inputs are one table."""
+    a = np.asarray(arr)
+    if a.ndim >= 3:
+        return [(f"{name}[{t}]", a[:, t]) for t in range(a.shape[1])]
+    return [(name, a)]
+
+
+def observe_batch(inputs: Dict[str, Any]) -> None:
+    """The fit loops' hook: count the integer-id tensors of one input
+    batch, every ``FF_ROWFREQ_EVERY``-th sampled batch only (default
+    8), and only while telemetry is on — the hot path pays ~0."""
+    if active_log() is None:
+        return
+    global _batch_no
+    _batch_no += 1
+    every = _sample_every()
+    if every > 1 and _batch_no % every:
+        return
+    for name, arr in inputs.items():
+        dt = getattr(arr, "dtype", None)
+        if dt is None or not np.issubdtype(dt, np.integer):
+            continue  # dense features are not ids
+        try:
+            host = np.asarray(arr)  # device arrays: one small D2H copy
+        except Exception:
+            continue  # non-addressable global array — skip, stay cheap
+        for tname, ids in _tables(name, host):
+            counter(tname).observe(ids)
+
+
+def observe_dataset(inputs: Dict[str, Any]) -> None:
+    """Scan-path hook: the fused/scanned fit stages the whole epoch as
+    [num_batches, batch, ...] arrays up front and never loops on the
+    host, so sample the staged dataset's batch slices once instead."""
+    if active_log() is None:
+        return
+    every = _sample_every()
+    for name, arr in inputs.items():
+        dt = getattr(arr, "dtype", None)
+        if dt is None or not np.issubdtype(dt, np.integer):
+            continue
+        try:
+            host = np.asarray(arr)
+        except Exception:
+            continue
+        if host.ndim < 2:
+            continue
+        for b in range(0, host.shape[0], every):
+            for tname, ids in _tables(name, host[b]):
+                counter(tname).observe(ids)
+
+
+def emit_all(log: Optional[EventLog] = None) -> int:
+    """Emit one ``row_freq`` event per observed table (fit end / bench
+    tail call this).  Returns the number of events emitted."""
+    emitted = 0
+    for c in list(_counters.values()):
+        if c.emit(log) is not None:
+            emitted += 1
+    return emitted
+
+
+def row_freq_summary(events: List[dict]) -> List[str]:
+    """The ``== row frequency ==`` report section: per table (newest
+    event wins), total and distinct ids, the hottest rows first, and
+    the power-of-two count histogram."""
+    rfs = [e for e in events if e.get("type") == "row_freq"]
+    if not rfs:
+        return []
+    latest: Dict[str, dict] = {}
+    for e in rfs:
+        latest[e["table"]] = e
+    lines = ["== row frequency =="]
+    for table in sorted(latest):
+        e = latest[table]
+        lines.append(f"{table}: {e['rows_seen']} ids seen, "
+                     f"{e['unique_ids']} distinct"
+                     + (f", {e['evicted']} cold ids evicted"
+                        if e.get("evicted") else ""))
+        ids = e.get("top_ids") or []
+        cts = e.get("top_counts") or []
+        if ids:
+            hot = "  ".join(f"{i}({c})" for i, c in
+                            list(zip(ids, cts))[:8])
+            lines.append(f"  hottest rows: {hot}")
+        buckets = e.get("bucket_counts") or []
+        if buckets:
+            hist = "  ".join(f"2^{b}:{n}" for b, n in
+                             enumerate(buckets) if n)
+            lines.append(f"  count histogram: {hist}")
+    return lines

@@ -3,7 +3,9 @@ producers (model.py, bench.py, sim/search.py, profiling.OpTimer, the
 jax.monitoring compile hooks) and the report CLI.
 
 Every emitted event is a flat JSON object with two common fields
-(``type``, ``ts``) plus per-type fields listed here.  ``EventLog.emit``
+(``type``, ``ts``), an optional fleet identity stamp (``pidx``,
+``slice`` — multi-host runs only, see telemetry/fleet.py), plus
+per-type fields listed here.  ``EventLog.emit``
 validates against this table at emission time and
 ``scripts/check_telemetry_schema.py`` lints it in tier-1 tests, so a
 producer cannot add or rename a field without the schema (and therefore
@@ -32,6 +34,14 @@ _ACCEPT = {
 }
 
 COMMON_REQUIRED = {"type": str, "ts": float}
+
+#: fleet identity stamp, accepted on EVERY event type: which host
+#: process (``pidx`` = jax.process_index) of which DCN slice produced
+#: the event.  ``EventLog(stamp=...)`` injects these on emission under
+#: ``process_count() > 1`` (telemetry/fleet.py) so ``report --fleet``
+#: can merge per-process sinks and attribute stragglers; single-process
+#: runs never carry them, keeping single-file output bit-identical.
+COMMON_OPTIONAL = {"pidx": int, "slice": int}
 
 SCHEMA: Dict[str, dict] = {
     # one timed stretch of training: an epoch, a fused multi-epoch
@@ -202,6 +212,42 @@ SCHEMA: Dict[str, dict] = {
         "required": {"kind": str, "point": str},
         "optional": {"step": int, "remaining": int},
     },
+    # per-phase wall attribution of one training step (or a whole fit
+    # stretch when ``phase`` is a loop name) — the measured column next
+    # to the cost model's DCN-exposed prediction (PERF.md).  Producers:
+    # the per-batch fit loop and resilient_fit's lag-1 pipeline.
+    # ``step`` is the global step the walls belong to (fleet merge
+    # aligns on it); ``sync_wait_ms`` is the host wall blocked on
+    # device completion beyond the overlapped window (grad-sync /
+    # collective wait on comm-bound steps); ``exposed_comm_pct`` =
+    # 100*sync_wait/step_wall; ``predicted_sync_ms`` is the two-level
+    # cost model's hierarchical grad all-reduce price for comparison.
+    # ``forward_ms``/``backward_ms`` are only host-separable where the
+    # step runs unfused — the jitted path reports dispatch+sync and
+    # leaves per-op walls to ``op_time`` events.
+    "phase_time": {
+        "required": {"step": int, "step_wall_ms": float},
+        "optional": {"data_wait_ms": float, "dispatch_ms": float,
+                     "forward_ms": float, "backward_ms": float,
+                     "sync_wait_ms": float, "exposed_comm_pct": float,
+                     "predicted_sync_ms": float, "samples": int,
+                     "steps": int, "phase": str},
+    },
+    # per-table embedding row-access frequency summary
+    # (telemetry/rowfreq.py): host-side, off the traced graph, sampled
+    # every Nth batch so the hot path pays ~0.  ``bucket_counts[b]`` is
+    # the number of distinct ids whose access count falls in
+    # [2^b, 2^(b+1)) — the power-of-two histogram ROADMAP item 4's LFU
+    # admission policy reads; ``top_ids``/``top_counts`` rank the
+    # hottest rows first.  ``evicted`` counts cold ids pruned when the
+    # counter exceeded twice its ``capacity``.
+    "row_freq": {
+        "required": {"table": str, "rows_seen": int, "unique_ids": int},
+        "optional": {"top_ids": list, "top_counts": list,
+                     "bucket_counts": list, "sampled_batches": int,
+                     "sample_every": int, "capacity": int,
+                     "evicted": int},
+    },
     # one closed span (telemetry/trace.py) — a Dapper-style timed,
     # attributed region of a request or training run, emitted at span
     # END.  ``start_s`` is the wall-clock start (time.time());
@@ -261,6 +307,13 @@ def validate_event(ev: dict) -> List[str]:
                         f"want {decl.__name__}")
     for name, val in ev.items():
         if name in COMMON_REQUIRED:
+            continue
+        if name in COMMON_OPTIONAL:
+            if not _type_ok(val, COMMON_OPTIONAL[name]):
+                errs.append(
+                    f"common field {name!r} has type "
+                    f"{type(val).__name__}, "
+                    f"want {COMMON_OPTIONAL[name].__name__}")
             continue
         if name not in known:
             errs.append(f"{etype}: unknown field {name!r} "

@@ -60,7 +60,15 @@ field without the schema and the report CLI seeing it:
      (``dlrm_process_index``/``dlrm_process_count``) declared, the
      ``distributed`` bootstrap event present, and the regress anchor
      keys must keep the ``:hosts=``/``:slices=`` topology suffixes so
-     a multi-host run never gates a single-host baseline.
+     a multi-host run never gates a single-host baseline;
+ 10. fleet-observability contract — the ``phase_time``/``row_freq``
+     event types must carry their attribution fields, the optional
+     ``pidx``/``slice`` stamp must be accepted on every event type,
+     the straggler/exposed-comm gauges (``dlrm_step_skew_ms``,
+     ``dlrm_exposed_comm_pct``) must be declared, skew must gate
+     UPWARD in the regress CLI (lower is better), and the per-process
+     sink naming + ``--fleet``/``--flight`` report modes must be
+     documented in docs/telemetry.md.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -454,6 +462,76 @@ def check_pod_contract(doc_path: str) -> list:
     return errs
 
 
+FLEET_DOC_NEEDLES = ("telemetry_pNNN", "flightrecorder_", "--fleet",
+                     "--flight", "dlrm_step_skew_ms",
+                     "dlrm_exposed_comm_pct", "pidx", "slice",
+                     "row_freq", "phase_time")
+PHASE_TIME_REQUIRED = ("step", "step_wall_ms")
+PHASE_TIME_FIELDS = ("data_wait_ms", "dispatch_ms", "sync_wait_ms",
+                     "exposed_comm_pct", "predicted_sync_ms")
+ROW_FREQ_REQUIRED = ("table", "rows_seen", "unique_ids")
+FLEET_FAMILIES = ("dlrm_step_skew_ms", "dlrm_exposed_comm_pct")
+
+
+def check_fleet_contract(doc_path: str) -> list:
+    """The fleet-observability contract (docs/telemetry.md): step-phase
+    attribution and row-frequency events declared with their fields,
+    the common ``pidx``/``slice`` stamp accepted everywhere, the skew
+    and exposed-comm gauges registered, skew gating downward-is-better
+    in regress, and the merge/flight CLI surface documented."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+    from dlrm_flexflow_tpu.telemetry.schema import COMMON_OPTIONAL
+
+    errs = []
+    pt = SCHEMA.get("phase_time")
+    if pt is None:
+        errs.append("fleet: event type 'phase_time' missing from the "
+                    "schema — step-phase attribution is gone")
+    else:
+        for f in PHASE_TIME_REQUIRED:
+            if f not in pt["required"]:
+                errs.append(f"fleet: phase_time required field {f!r} "
+                            f"missing")
+        for f in PHASE_TIME_FIELDS:
+            if f not in pt["optional"]:
+                errs.append(f"fleet: phase_time attribution field "
+                            f"{f!r} missing")
+    rf = SCHEMA.get("row_freq")
+    if rf is None:
+        errs.append("fleet: event type 'row_freq' missing from the "
+                    "schema — LFU-admission input is gone")
+    else:
+        for f in ROW_FREQ_REQUIRED:
+            if f not in rf["required"]:
+                errs.append(f"fleet: row_freq required field {f!r} "
+                            f"missing")
+    for f in ("pidx", "slice"):
+        if f not in COMMON_OPTIONAL:
+            errs.append(f"fleet: common stamp field {f!r} missing from "
+                        f"schema.COMMON_OPTIONAL — merged per-process "
+                        f"events would be rejected")
+    for name in FLEET_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"fleet: metric family {name!r} not declared "
+                        f"in telemetry.metrics.FAMILIES")
+    if not lower_is_better("dlrm_step_skew_ms"):
+        errs.append("fleet: regress treats dlrm_step_skew_ms as "
+                    "higher-is-better — a straggler regression would "
+                    "read as an improvement")
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented fleet "
+                    f"surface)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in FLEET_DOC_NEEDLES:
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/telemetry.md does not document "
+                            f"`{needle}`")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -469,7 +547,8 @@ def main() -> int:
             + check_overlap_contract(os.path.join(REPO, "docs",
                                                   "pipeline.md"))
             + check_pod_contract(os.path.join(REPO, "docs",
-                                              "distributed.md")))
+                                              "distributed.md"))
+            + check_fleet_contract(doc))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

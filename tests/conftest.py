@@ -20,6 +20,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _flight_dir_tmp(tmp_path, monkeypatch):
+    """Resilience tests die on purpose under active telemetry; route
+    their flight-recorder dumps (telemetry/fleet.py, default
+    ``artifacts/``) into the test's tmp dir so runs never dirty the
+    tree.  Tests that pin a specific dir just setenv over this."""
+    monkeypatch.setenv("FF_FLIGHT_DIR", str(tmp_path / "flight"))
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

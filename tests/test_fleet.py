@@ -1,0 +1,323 @@
+"""Fleet observability tests (telemetry/fleet.py, telemetry/rowfreq.py
+— docs/telemetry.md): per-process sinks, the merged straggler /
+exposed-comm report, the crash flight recorder, and row-frequency
+counts.  The golden numbers here are doctored by hand so the skew and
+exposure math stays recomputable by a reviewer."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu.analysis.engine import FunctionIndex, load_modules
+from dlrm_flexflow_tpu.analysis.passes import SharedStatePass
+from dlrm_flexflow_tpu.data.loader import ArrayDataLoader
+from dlrm_flexflow_tpu.resilience import (NaNSentinel, TrainingDiverged,
+                                          faultinject)
+from dlrm_flexflow_tpu.telemetry import (EventLog, event_log,
+                                         set_event_log)
+from dlrm_flexflow_tpu.telemetry import rowfreq
+from dlrm_flexflow_tpu.telemetry.fleet import (dump_flight_record,
+                                               find_flight_records,
+                                               fleet_data,
+                                               fleet_event_log,
+                                               load_fleet_events,
+                                               load_flight_record,
+                                               process_sink_path,
+                                               render_fleet,
+                                               render_flight)
+from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultinject.clear()
+    rowfreq.reset()
+    yield
+    faultinject.clear()
+    rowfreq.reset()
+
+
+def make_model(lr=0.05):
+    m = ff.FFModel(ff.FFConfig(batch_size=8))
+    x = m.create_tensor((8, 4), name="x")
+    m.dense(x, 8, activation="relu")
+    m.dense(m.layers[-1].outputs[0], 1)
+    m.compile(optimizer=ff.SGDOptimizer(lr=lr),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return m
+
+
+def make_loader(n=64):
+    rng = np.random.default_rng(0)
+    return ArrayDataLoader(
+        {"x": rng.standard_normal((n, 4)).astype(np.float32)},
+        rng.standard_normal((n, 1)).astype(np.float32), 8)
+
+
+def write_fleet(d, walls, syncs, slices, steps=3):
+    """Doctor one per-process sink per host through the real
+    ``fleet_event_log`` (explicit pidx/slice/nproc overrides)."""
+    for pidx, wall in walls.items():
+        with fleet_event_log(path=os.path.join(str(d), "run.jsonl"),
+                             mode="w", pidx=pidx,
+                             slice_id=slices[pidx],
+                             nproc=len(walls)) as log:
+            for s in range(1, steps + 1):
+                log.emit("phase_time", step=s, phase="step",
+                         step_wall_ms=wall, sync_wait_ms=syncs[pidx],
+                         samples=8)
+            log.emit("step", wall_s=steps * wall / 1e3,
+                     samples=8 * steps, samples_per_s=1000.0,
+                     fenced=True, phase="fit")
+
+
+class TestSmokeMatrix:
+    def test_check_fleet_passes(self):
+        """The full smoke matrix (merge golden numbers, flight dump on
+        a real injected-fault death, power-law row ranking, dir-vs-file
+        report equivalence) — the acceptance pins live there."""
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_fleet.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+        assert "OK (4 scenarios)" in out.stdout
+
+
+class TestFleetMerge:
+    def test_sink_naming(self):
+        assert process_sink_path("t.jsonl", pidx=2, nproc=3) \
+            == "t_p002.jsonl"
+        assert process_sink_path("t.jsonl", pidx=0, nproc=1) \
+            == "t.jsonl"  # single-process: bit-identical path
+
+    def test_golden_skew_and_straggler(self, tmp_path):
+        # hosts at 100/130/100 ms: median 100, slowest 130 -> skew 30,
+        # p001 owns every aligned step's skew
+        write_fleet(tmp_path, walls={0: 100.0, 1: 130.0, 2: 100.0},
+                    syncs={0: 10.0, 1: 40.0, 2: 10.0},
+                    slices={0: 0, 1: 0, 2: 1})
+        data = fleet_data(load_fleet_events(str(tmp_path), strict=True))
+        assert data["hosts"] == [0, 1, 2]
+        assert data["aligned_steps"] == 3
+        assert all(r["skew_ms"] == pytest.approx(30.0)
+                   for r in data["steps"])
+        assert all(r["worst_pidx"] == 1 for r in data["steps"])
+        assert data["straggler"]["pidx"] == 1
+        assert data["straggler"]["total_skew_ms"] == pytest.approx(90.0)
+        # exposed comm: sum(sync)/sum(wall) = 60/330 per step
+        assert data["exposed_comm_pct"] == pytest.approx(
+            100.0 * 60.0 / 330.0)
+        assert data["per_slice"][0]["samples_per_s"] == \
+            pytest.approx(2000.0)
+        assert data["per_slice"][1]["hosts"] == 1
+        text = "\n".join(render_fleet(data))
+        assert "straggler: p001" in text
+        assert "slice 0: 2,000 samples/s over 2 host(s)" in text
+
+    def test_single_host_renders_nothing(self, tmp_path):
+        with event_log(path=str(tmp_path / "t.jsonl")) as log:
+            log.emit("phase_time", step=1, phase="step",
+                     step_wall_ms=5.0, samples=8)
+        data = fleet_data(load_fleet_events(str(tmp_path)))
+        assert data["aligned_steps"] == 0  # one host has no skew
+        assert render_fleet(data) == []
+
+    def test_unstamped_events_inherit_filename_pidx(self, tmp_path):
+        # a pre-stamping sink named _pNNN still attributes
+        for pidx in (0, 1):
+            with event_log(path=str(
+                    tmp_path / f"run_p{pidx:03d}.jsonl")) as log:
+                log.emit("phase_time", step=1, phase="step",
+                         step_wall_ms=10.0 * (pidx + 1), samples=8)
+        data = fleet_data(load_fleet_events(str(tmp_path)))
+        assert data["hosts"] == [0, 1]
+        assert data["steps"][0]["worst_pidx"] == 1
+
+    def test_report_accepts_directory(self, tmp_path):
+        write_fleet(tmp_path, walls={0: 100.0, 1: 130.0},
+                    syncs={0: 10.0, 1: 10.0}, slices={0: 0, 1: 1})
+        out = subprocess.run(
+            [sys.executable, "-m", "dlrm_flexflow_tpu.telemetry",
+             "report", str(tmp_path), "--format", "json"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["fleet"]["straggler"]["pidx"] == 1
+        # distributed_summary no longer collapses to process 0's view:
+        # both hosts' throughput is present via the per-slice sums
+        assert set(doc["fleet"]["per_slice"]) == {"0", "1"}
+
+
+class TestFlightRecorder:
+    def test_dump_on_injected_fault(self, tmp_path, monkeypatch):
+        """A real resilient fit killed by nan_grads: the original
+        exception propagates AND one parseable artifact records the
+        death, its last ring event at the fatal step."""
+        monkeypatch.setenv("FF_FLIGHT_DIR", str(tmp_path))
+        faultinject.install("nan_grads@step=1,nan_grads@step=2,"
+                            "nan_grads@step=3")
+        m = make_model()
+        with pytest.raises(TrainingDiverged):
+            with event_log():
+                m.fit(m.init(seed=0), make_loader(), epochs=2,
+                      verbose=False,
+                      sentinel=NaNSentinel(policy="skip",
+                                           max_rollbacks=2))
+        recs = find_flight_records(str(tmp_path))
+        assert len(recs) == 1
+        doc = load_flight_record(recs[0])
+        assert doc["kind"] == "flightrecorder"
+        assert doc["exception"]["type"] == "TrainingDiverged"
+        last = doc["events"][-1]
+        fatal = max(e["step"] for e in doc["events"]
+                    if e["type"] == "fault"
+                    and e["kind"] == "nan_grads")
+        assert last["type"] == "anomaly" and last["step"] == fatal
+        assert "died: TrainingDiverged" in "\n".join(render_flight(doc))
+
+    def test_partial_tmp_never_parsed(self, tmp_path):
+        tmp = tmp_path / "flightrecorder_1.json.tmp"
+        tmp.write_text('{"kind": "flightrec')  # torn write
+        assert find_flight_records(str(tmp_path)) == []
+        with pytest.raises(ValueError, match="partial"):
+            load_flight_record(str(tmp))
+
+    def test_noop_without_telemetry(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FF_FLIGHT_DIR", str(tmp_path))
+        assert dump_flight_record(RuntimeError("x"), log=None) is None
+        assert find_flight_records(str(tmp_path)) == []
+
+    def test_dump_never_raises(self, tmp_path, monkeypatch):
+        # an unwritable dir degrades to None, never a second exception
+        monkeypatch.setenv("FF_FLIGHT_DIR",
+                           os.path.join(str(tmp_path), "f.jsonl", "x"))
+        (tmp_path / "f.jsonl").write_text("")  # a FILE, not a dir
+        log = EventLog()
+        log.emit("step", wall_s=1.0, samples=8)
+        assert dump_flight_record(RuntimeError("x"), log=log) is None
+
+
+class TestRowFreq:
+    def test_power_law_ranks_hot_rows_first(self):
+        counts = {i: 2048 // (i + 1) for i in range(256)}
+        ids = np.repeat(np.fromiter(counts, dtype=np.int64),
+                        np.fromiter(counts.values(), dtype=np.int64))
+        np.random.default_rng(3).shuffle(ids)
+        c = rowfreq.RowFreqCounter("emb", capacity=32)
+        for chunk in np.array_split(ids, 20):
+            c.observe(chunk)
+        assert [i for i, _ in c.top(6)] == [0, 1, 2, 3, 4, 5]
+        for i, n in c.top(6):  # eviction never touched the head
+            assert n == counts[i]
+        assert c.evicted > 0
+
+    def test_bucket_histogram(self):
+        c = rowfreq.RowFreqCounter("t")
+        c.observe([7] * 9 + [1] * 3 + [2])  # counts 9, 3, 1
+        assert c.bucket_counts() == [1, 1, 0, 1]  # 2^0:1 2^1:3 2^3:9
+
+    def test_observe_batch_splits_bag_tables(self):
+        log = EventLog()
+        prev = set_event_log(log)
+        try:
+            os.environ["FF_ROWFREQ_EVERY"] = "1"
+            rowfreq.observe_batch({
+                "sparse": np.zeros((8, 3, 2), np.int64),
+                "dense": np.zeros((8, 13), np.float32)})
+            assert rowfreq.emit_all(log) == 3  # one per table slice
+            tables = {e["table"] for e in log.events("row_freq")}
+            assert tables == {"sparse[0]", "sparse[1]", "sparse[2]"}
+        finally:
+            set_event_log(prev)
+            os.environ.pop("FF_ROWFREQ_EVERY", None)
+
+
+class TestRegressGate:
+    def test_step_skew_gates_lower_is_better(self):
+        assert lower_is_better("dlrm_step_skew_ms") is True
+        assert lower_is_better("dlrm_step_skew_ms:hosts=2") is True
+
+    def test_bench_exposed_comm_is_extra_provenance(self):
+        sys.path.insert(0, REPO)
+        try:
+            from bench import _exposed_comm_extra
+        finally:
+            sys.path.remove(REPO)
+        log = EventLog()
+        prev = set_event_log(log)
+        try:
+            assert _exposed_comm_extra() == {}  # no summary yet
+            log.emit("phase_time", step=4, phase="fit",
+                     step_wall_ms=100.0, sync_wait_ms=25.0,
+                     exposed_comm_pct=25.0, steps=4)
+            assert _exposed_comm_extra() == {"exposed_comm_pct": 25.0}
+        finally:
+            set_event_log(prev)
+        assert _exposed_comm_extra() == {}  # telemetry off
+
+
+# ---------------------------------------------------------------- ffcheck
+def _run_pass(tmp_path, files, pass_cls):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        d = path.parent
+        while d != tmp_path:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+            d = d.parent
+        path.write_text(src)
+    roots = sorted({rel.split("/")[0] for rel in files})
+    modules = load_modules(roots=roots, repo=str(tmp_path))
+    return pass_cls().run(modules, FunctionIndex(modules))
+
+
+class TestRecorderSharedState:
+    """The flight recorder reads span/ring state from an exception
+    handler while worker threads still mutate it — the shared-state
+    pass must see the difference between that done lock-free by
+    construction (snapshot reads, lock-guarded mutation) and a naive
+    registry racing its dump method."""
+
+    def test_fires_naive_recorder_registry(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/r.py": (
+            "import threading\n"
+            "class Recorder:\n"
+            "    def __init__(self):\n"
+            "        self.open = {}\n"
+            "        self._t = threading.Thread(target=self._work)\n"
+            "    def _work(self):\n"
+            "        self.open['s'] = 1\n"
+            "    def dump(self):\n"
+            "        return dict(self.open)\n")}, SharedStatePass)
+        assert sorted({f.code for f in fs}) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "Recorder.open"
+
+    def test_clean_on_locked_registry_snapshot_dump(self, tmp_path):
+        # the real recorder shape: mutation under one lock on both
+        # sides, the crash-path dump reading a snapshot under it too
+        fs = _run_pass(tmp_path, {"pkg/r.py": (
+            "import threading\n"
+            "class Recorder:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.open = {}\n"
+            "        self._t = threading.Thread(target=self._work)\n"
+            "    def _work(self):\n"
+            "        with self._lock:\n"
+            "            self.open['s'] = 1\n"
+            "    def dump(self):\n"
+            "        with self._lock:\n"
+            "            return dict(self.open)\n")}, SharedStatePass)
+        assert fs == []
