@@ -26,7 +26,15 @@ halves of the stack:
   ``ReplicaRouter.scale_to/rebuild`` (zero accepted requests dropped
   across a resize) and re-gates the topology-scoped incumbent SOAP
   strategy through ``sim/tune.py``'s promotion machinery, so a
-  reshaped fleet never keeps serving a stale topology's strategy.
+  reshaped fleet never keeps serving a stale topology's strategy;
+  ``heal()`` runs the router's health probe and rebuilds ejected
+  replicas through the same scale path (docs/serving.md).
+* :func:`recover_and_resume` (``recovery.py``) — survivor recovery
+  after HOST LOSS (docs/resilience.md): once the watchdog layer
+  (``resilience/watchdog.py``) declares a peer dead, survivors
+  re-bootstrap ``jax.distributed`` at the reduced process count and
+  resume from the last committed podshard checkpoint via
+  :func:`reshard_restore`.
 
 Telemetry: ``elastic`` events (phases ``reshard``/``scale``/``regate``)
 plus the ``dlrm_elastic_reshard_total`` counter and the live
@@ -34,9 +42,10 @@ plus the ``dlrm_elastic_reshard_total`` counter and the live
 """
 
 from .controller import ElasticController, regate_strategy
+from .recovery import recover_and_resume
 from .reshard import gather_state, host_gather, reshard_restore, reshard_state
 
 __all__ = [
     "ElasticController", "regate_strategy", "gather_state", "host_gather",
-    "reshard_restore", "reshard_state",
+    "recover_and_resume", "reshard_restore", "reshard_state",
 ]

@@ -172,6 +172,34 @@ class ElasticController:
                                          tolerance_pct=tolerance_pct)
         return result
 
+    def heal(self, target_replicas: Optional[int] = None,
+             max_engine_failures: Optional[int] = None,
+             engines: Optional[Sequence] = None) -> Dict[str, Any]:
+        """One self-healing pass (docs/serving.md): run the router's
+        health probe (``ReplicaRouter.check_health`` — dead-dispatcher
+        and, with ``max_engine_failures``, circuit-breaker ejection),
+        then, when ejections dropped the live count below
+        ``target_replicas``, rebuild capacity through :meth:`scale_to`
+        (regate included — replacement replicas never serve an
+        unexamined strategy).  Returns ``{"ejected": [labels],
+        "rebuilt": scale dict or None}``.  Without ``target_replicas``
+        it only ejects — survivors carry the load.  When EVERY replica
+        died, rebuilding needs ``engines=`` (there is no live engine
+        left to clone)."""
+        ejected = self.router.check_health(
+            max_engine_failures=max_engine_failures)
+        rebuilt: Optional[Dict[str, Any]] = None
+        if (ejected and target_replicas is not None
+                and len(self.router) < int(target_replicas)):
+            if len(self.router) == 0 and not engines:
+                raise ValueError(
+                    "heal() ejected every replica and has no engines= "
+                    "to rebuild from — pass fresh engines (e.g. "
+                    "recompiled under the surviving topology)")
+            rebuilt = self.scale_to(int(target_replicas),
+                                    engines=engines)
+        return {"ejected": ejected, "rebuilt": rebuilt}
+
     def close(self, **kwargs) -> Dict[str, Any]:
         return self.router.close(**kwargs)
 

@@ -1,0 +1,85 @@
+"""Survivor recovery after host loss (docs/resilience.md).
+
+The detection half lives in ``resilience/watchdog.py`` (heartbeat ages,
+the deadlined podshard barrier, the step stall watchdog); this module is
+what survivors DO once a peer is declared dead: re-bootstrap
+``jax.distributed`` at the reduced process count and resume from the
+last committed podshard checkpoint via
+:func:`~.reshard.reshard_restore` — podshard checkpoints restore on ANY
+fleet shape by design, so losing a host costs the steps since the last
+save, never the run.
+
+The driver shape (scripts/check_recovery.py proves it end-to-end):
+
+    wd = HostWatchdog(hb_dir, pidx, nproc, deadline_s=...).start()
+    try:
+        model.fit(...)                       # dies loudly on host loss
+    except (FleetBarrierTimeout, SystemExit):
+        pass
+    if wd.dead_peers():
+        model, state, extra, path = recover_and_resume(
+            ckpt_dir, build_model,
+            coordinator_address=..., num_processes=len(survivors),
+            process_id=new_rank)
+        model.fit(...)                       # continue at reduced shape
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..telemetry import emit
+from .reshard import reshard_restore
+
+
+def recover_and_resume(manager_or_dir, build_model,
+                       *, coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None,
+                       inference_only: bool = False
+                       ) -> Tuple[Any, Any, Dict[str, Any], str]:
+    """Re-bootstrap the surviving fleet and resume from the last
+    committed checkpoint.  Returns ``(model, state, extra, path)``.
+
+    * ``manager_or_dir`` — a ``CheckpointManager`` or its directory
+      (anything :func:`~.reshard.reshard_restore` accepts).
+    * ``build_model`` — a zero-arg callable returning a model compiled
+      under the SURVIVOR topology (or an already-compiled model).  A
+      callable, because the model must be (re)built AFTER the runtime
+      re-initializes — its mesh snapshots the device set.
+    * ``num_processes`` / ``coordinator_address`` / ``process_id`` —
+      the REDUCED fleet shape; when ``num_processes`` > 1 the JAX
+      distributed runtime is torn down (best-effort — a fleet that died
+      mid-collective may not shut down cleanly) and re-initialized at
+      it.  Survivors must agree on the new contiguous ranks — e.g.
+      sorted surviving old ranks, index = new rank.  Single-process
+      recovery (one survivor, or a driver adopting the work) skips the
+      runtime bootstrap entirely.
+
+    Emits one ``recovery`` ``phase="resume"`` event naming the new
+    process count and the checkpoint it resumed from.  The restore
+    itself is the elastic reshard path — ``elastic`` telemetry and the
+    reshard counter fire as usual when the topology actually changed.
+    """
+    t0 = time.perf_counter()
+    if num_processes is not None and int(num_processes) > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass  # never initialized, or died mid-collective
+        from .. import distributed as _dist
+        _dist.initialize(coordinator_address=coordinator_address,
+                         num_processes=int(num_processes),
+                         process_id=process_id)
+    model = build_model() if callable(build_model) else build_model
+    state, extra, path = reshard_restore(manager_or_dir, model,
+                                         inference_only=inference_only)
+    from ..checkpoint import _local_value
+    emit("recovery", phase="resume",
+         process_count=int(jax.process_count()), path=path,
+         step=int(_local_value(state.step)),
+         duration_s=time.perf_counter() - t0)
+    return model, state, extra, path

@@ -16,10 +16,20 @@ training.
   (:class:`TrainingDiverged` past it).
 * :mod:`.faultinject` — deterministic fault injection
   (``nan_grads@step=K``, ``io_error@save=N``, ``preempt@step=K``,
-  ``preempt@save``, ``preempt+reshape@step=K:mesh=DxM``) so every
-  recovery path is provable end-to-end; :class:`Preemption` is the
-  injected kill, :class:`Reshape` the kill after which the fleet
-  returns with a different topology (docs/elastic.md).
+  ``preempt@save``, ``preempt+reshape@step=K:mesh=DxM``,
+  ``host_crash@step=K``, ``host_hang@step=K``, ``host_hang@barrier``)
+  so every recovery path is provable end-to-end; :class:`Preemption`
+  is the injected kill, :class:`Reshape` the kill after which the
+  fleet returns with a different topology (docs/elastic.md),
+  :class:`HostLost` a hung host waking after the fleet declared it
+  dead.
+* :mod:`.watchdog` — host-loss detection (docs/resilience.md):
+  :func:`heartbeat_ages` / :class:`HostWatchdog` age the fleet's
+  shared-filesystem ``heartbeat-pNNN`` files and flag dead peers by
+  name; :class:`StallWatchdog` turns a silent training stall into a
+  flight dump + loud abort; :class:`FleetBarrierTimeout` is the
+  deadlined podshard barrier's named death (survivors recover through
+  ``elastic.recover_and_resume``).
 
 Wired through ``FFModel.fit(checkpoint_manager=..., resume=True,
 checkpoint_every_n_steps=..., sentinel=NaNSentinel(...))``; all
@@ -28,11 +38,15 @@ telemetry events visible in ``python -m dlrm_flexflow_tpu.telemetry
 report``.
 """
 
-from .faultinject import Preemption, Reshape
+from .faultinject import HostLost, Preemption, Reshape
 from .manager import CheckpointManager, latest_checkpoint, verify_checkpoint
 from .sentinel import NaNSentinel, TrainingDiverged
+from .watchdog import (FleetBarrierTimeout, HostWatchdog, StallWatchdog,
+                       heartbeat_ages)
 
 __all__ = [
     "CheckpointManager", "latest_checkpoint", "verify_checkpoint",
     "NaNSentinel", "TrainingDiverged", "Preemption", "Reshape",
+    "HostLost", "FleetBarrierTimeout", "HostWatchdog", "StallWatchdog",
+    "heartbeat_ages",
 ]

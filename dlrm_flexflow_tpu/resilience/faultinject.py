@@ -29,6 +29,17 @@ the ``FF_FAULTS`` environment variable — and consumed at fixed sites:
                         new mesh, and resumes elastically.  ``:mesh=``
                         may be omitted when the resuming driver picks
                         its own shape.
+    host_crash@step=K   kill THIS process dead at the top of global
+                        step K — ``os._exit`` with :data:`CRASH_EXIT`,
+                        no unwinding, no atexit: the host-loss case
+                        survivors must detect by heartbeat age and
+                        recover from (docs/resilience.md)
+    host_hang@step=K    block at the top of global step K (for
+                        ``FF_HANG_S`` seconds, default effectively
+    host_hang@barrier   forever), then raise :class:`HostLost` — a
+                        wedged host the fleet's watchdogs must catch:
+                        the stall watchdog at a step, the barrier
+                        deadline (``FleetBarrierTimeout``) mid-save
 
 Entries are separated by ``,`` or ``;``.  Every firing decrements the
 fault's remaining count (specs without ``=N`` fire once) and emits a
@@ -68,8 +79,23 @@ class Reshape(Preemption):
         self.mesh_shape = mesh_shape
 
 
-_KINDS = ("nan_grads", "io_error", "preempt", "preempt+reshape")
-_POINTS = ("step", "save", "restore")
+class HostLost(Preemption):
+    """A host waking from a hang the fleet already declared dead.
+
+    ``host_hang`` faults block, then raise this: the fleet's watchdogs
+    fired long ago, survivors may already be resuming at a reduced
+    process count — a late riser must NOT rejoin and keep training.
+    Preemption-family (BaseException) so no recovery path swallows it.
+    """
+
+
+#: process exit code of a ``host_crash`` firing (``os._exit``; distinct
+#: so drivers can assert the victim died by injection, not by accident)
+CRASH_EXIT = 17
+
+_KINDS = ("nan_grads", "io_error", "preempt", "preempt+reshape",
+          "host_crash", "host_hang")
+_POINTS = ("step", "save", "restore", "barrier")
 
 
 def parse_mesh_shape(spec: str) -> Dict[str, int]:
@@ -146,6 +172,22 @@ def parse(spec: str) -> List[_Fault]:
                 f"{entry!r}: preempt+reshape fires at a step boundary "
                 f"(kind@step=K[:mesh=DxM]) — a reshape lands between "
                 f"runs, not inside a save")
+        if point == "barrier" and kind != "host_hang":
+            raise ValueError(
+                f"{entry!r}: only host_hang faults fire at a barrier "
+                f"(host_hang@barrier — the peer that never arrives)")
+        if kind == "host_crash" and point != "step":
+            raise ValueError(
+                f"{entry!r}: host_crash fires at a step boundary "
+                f"(host_crash@step=K) — an os._exit kill, detected by "
+                f"heartbeat age, not observable at a site it never "
+                f"reaches")
+        if kind == "host_hang" and point not in ("step", "barrier"):
+            raise ValueError(
+                f"{entry!r}: host_hang fires at a step boundary "
+                f"(host_hang@step=K) or a commit barrier "
+                f"(host_hang@barrier) — the only sites the watchdog "
+                f"layer guards")
         if point == "step":
             if value is None:
                 raise ValueError(
@@ -275,3 +317,41 @@ def maybe_io_error(point: str, step: Optional[int] = None) -> None:
     if f is not None:
         _fire(f, step=step)
         raise OSError(f"injected I/O error at {point}")
+
+
+def maybe_host_fault(point: str, step: Optional[int] = None) -> None:
+    """Fire ``host_crash`` / ``host_hang`` faults at ``point`` — the
+    host-loss injections the watchdog layer is tested against:
+
+    * ``host_crash``: print a marker, then ``os._exit(CRASH_EXIT)``.
+      No exception, no unwinding, no atexit — a crashed host does not
+      run cleanup, and survivors must detect it purely by heartbeat
+      age / barrier absence.
+    * ``host_hang``: block for ``FF_HANG_S`` seconds (default 3600 —
+      effectively forever next to any watchdog deadline), then raise
+      :class:`HostLost`.  The sleep IS the fault; the raise only stops
+      a late-woken host from rejoining a fleet that declared it dead.
+    """
+    import sys
+    import time
+    f = _match("host_crash", point, step)
+    if f is not None:
+        _fire(f, step=step)
+        print(f"# faultinject: host_crash at {point}"
+              + (f" step {step}" if step is not None else "")
+              + f" — exiting {CRASH_EXIT}", file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(CRASH_EXIT)
+    f = _match("host_hang", point, step)
+    if f is not None:
+        _fire(f, step=step)
+        hang_s = float(os.environ.get("FF_HANG_S", "3600"))
+        print(f"# faultinject: host_hang at {point}"
+              + (f" step {step}" if step is not None else "")
+              + f" — blocking {hang_s:g}s", file=sys.stderr)
+        sys.stderr.flush()
+        time.sleep(hang_s)
+        raise HostLost(
+            f"injected host hang at {point}"
+            + (f" step {step}" if step is not None else "")
+            + " woke up — the fleet has long declared this host dead")

@@ -47,6 +47,7 @@ uninterrupted run.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -205,6 +206,25 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
     #                             the measured exposed-comm column
     t0 = time.perf_counter()
     last_adopt = [t0]           # adopt-to-adopt wall = one step's wall
+    step_wall = [0.0]           # the most recent adopt-to-adopt wall
+
+    # step-level stall watchdog (resilience/watchdog.py): off unless
+    # FFConfig.stall_abort_multiple / FF_STALL_MULTIPLE is set.  The
+    # two cells above double as its progress/wall feed — a wedged
+    # collective or a host_hang fault stops last_adopt from advancing,
+    # and the watchdog turns that into a flight dump + loud abort
+    # (exit STALL_EXIT) instead of a silent forever-hang.
+    stall_mult = float(getattr(model.config, "stall_abort_multiple", 0)
+                       or os.environ.get("FF_STALL_MULTIPLE", 0) or 0)
+    stall_wd = None
+    if stall_mult > 0:
+        from .watchdog import StallWatchdog
+        stall_wd = StallWatchdog(
+            last_adopt, step_wall, multiple=stall_mult,
+            floor_s=float(getattr(model.config, "stall_abort_floor_s", 0)
+                          or os.environ.get("FF_STALL_FLOOR_S", 0)
+                          or 5.0))
+        stall_wd.start()
 
     cur_ep = [fit_span]  # the ambient parent for cadence saves
 
@@ -234,16 +254,19 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         loss_steps.append(step_no)
         acc.update({k: v for k, v in p.mets.items() if k != "loss"})
         model._fit_state = p.new_state
+        # progress stamp for the stall watchdog: adopted dispatch =
+        # fenced step progress, whether or not telemetry is on
+        now = time.perf_counter()
+        step_wall[0] = now - last_adopt[0]
         log = active_log()
         if log is not None:
-            now = time.perf_counter()
             log.emit("phase_time", step=step_no, phase="step",
-                     step_wall_ms=(now - last_adopt[0]) * 1e3,
+                     step_wall_ms=step_wall[0] * 1e3,
                      data_wait_ms=p.data_wait_s * 1e3,
                      dispatch_ms=p.dispatch_wall_s * 1e3,
                      sync_wait_ms=wait_s * 1e3,
                      samples=p.n_samples)
-            last_adopt[0] = now
+        last_adopt[0] = now
         if every_n_steps and step_no % every_n_steps == 0:
             # a save at the epoch's final batch marks the NEXT epoch
             # (the loader cursor has wrapped to 0 already)
@@ -264,6 +287,7 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
             rspan = start_span("train.dispatch", parent=cur_ep[0],
                                attrs={"step": p.step, "retry": True})
             faultinject.maybe_preempt("step", step=p.step)
+            faultinject.maybe_host_fault("step", step=p.step)
             binputs, blabels = faultinject.poison_batch(
                 p.inputs, p.labels, step=p.step)
             host_snap = {op.name: op.host_table.array
@@ -380,6 +404,7 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
                                        attrs={"step": global_step})
                     fault_snap = faultinject.save_counts()
                     faultinject.maybe_preempt("step", step=global_step)
+                    faultinject.maybe_host_fault("step", step=global_step)
                     binputs, blabels = faultinject.poison_batch(
                         inputs, labels, step=global_step)
                     host_snap = {op.name: op.host_table.array
@@ -447,6 +472,8 @@ def resilient_fit(model, state, dataloader, epochs: int, verbose: bool,
         dump_flight_record(e)
         raise
     finally:
+        if stall_wd is not None:
+            stall_wd.stop()
         if own_prefetch is not None:
             own_prefetch.close()
 

@@ -40,8 +40,10 @@ import numpy as np
 from ..checkpoint import CheckpointError, restore_checkpoint, save_checkpoint
 from ..telemetry import emit
 from ..telemetry import metrics as _tmetrics
+from ..telemetry.fleet import dump_flight_record
 from ..telemetry.trace import start_span
 from . import faultinject
+from .watchdog import FleetBarrierTimeout
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 MANIFEST = "manifest.json"
@@ -168,13 +170,19 @@ class CheckpointManager:
 
     def __init__(self, directory: str, keep_n: int = 3, retries: int = 2,
                  backoff_s: float = 0.05, use_orbax: Optional[bool] = None,
-                 fsync: bool = True, multihost: Optional[bool] = None):
+                 fsync: bool = True, multihost: Optional[bool] = None,
+                 barrier_timeout_s: float = 300.0):
         self.directory = str(directory)
         self.keep_n = max(1, int(keep_n))
         self.retries = max(0, int(retries))
         self.backoff_s = float(backoff_s)
         self.use_orbax = use_orbax
         self.fsync = fsync
+        # deadline on every podshard commit barrier: past it the save
+        # raises FleetBarrierTimeout (BaseException — see _barrier)
+        # naming the absent processes, instead of parking this process
+        # forever behind a dead peer
+        self.barrier_timeout_s = float(barrier_timeout_s)
         # multi-host pod mode (docs/distributed.md): every process
         # writes its own shard files into one shared directory, process
         # 0 commits the single cross-host manifest.  None = auto-detect
@@ -196,8 +204,11 @@ class CheckpointManager:
         """Atomically write one checkpoint; returns the committed path or
         None when every attempt failed.  NEVER raises on I/O failure —
         a failed save logs a ``checkpoint`` telemetry event and the
-        training run continues (only :class:`faultinject.Preemption`,
-        i.e. a simulated/real kill, propagates)."""
+        training run continues.  Only the BaseException family escapes:
+        :class:`faultinject.Preemption` (a simulated/real kill) and
+        :class:`FleetBarrierTimeout` (a multihost commit barrier whose
+        peers never arrived — a dead fleet must abort loudly, not log
+        "save failed" and park at the next collective)."""
         if step is None:
             from ..checkpoint import _local_value
             step = int(_local_value(state.step))
@@ -315,7 +326,7 @@ class CheckpointManager:
         return final
 
     def _barrier(self, tag: str, pidx: int, nproc: int,
-                 timeout_s: float = 300.0) -> None:
+                 timeout_s: Optional[float] = None) -> None:
         """Shared-filesystem barrier: each process drops a marker file
         under ``.barrier-<tag>/`` and waits until all ``nproc`` are
         present.  Every process creates its marker BEFORE polling, so
@@ -325,7 +336,21 @@ class CheckpointManager:
         because the checkpoint directory is already assumed shared
         (the orbax assumption) and device collectives may not exist
         between training steps on every backend (this container's CPU
-        jaxlib has none — docs/distributed.md)."""
+        jaxlib has none — docs/distributed.md).
+
+        Deadlined: past ``timeout_s`` (default ``barrier_timeout_s``)
+        the wait raises :class:`FleetBarrierTimeout` NAMING the absent
+        processes, after emitting a ``recovery`` event and dumping a
+        flight record — a peer that will never arrive must end this
+        save loudly, not park the survivor forever.  BaseException by
+        the Preemption precedent: ``save()``'s never-abort ``except
+        Exception`` must not turn a dead fleet into "save failed,
+        continuing".  Single-attempt semantics hold — the timeout
+        aborts, it NEVER retries (a retry would re-fence survivors at
+        a barrier the dead can't fill; docs/distributed.md)."""
+        faultinject.maybe_host_fault("barrier")  # the peer that hangs
+        if timeout_s is None:
+            timeout_s = self.barrier_timeout_s
         bdir = os.path.join(self.directory, f".barrier-{tag}")
         os.makedirs(bdir, exist_ok=True)
         with open(os.path.join(bdir, f"p{pidx}"), "w"):
@@ -333,16 +358,23 @@ class CheckpointManager:
         deadline = time.monotonic() + timeout_s
         while True:
             try:
-                seen = len(os.listdir(bdir))
+                present = set(os.listdir(bdir))
             except FileNotFoundError:
                 return  # swept by a process that counted everyone
-            if seen >= nproc:
+            if len(present) >= nproc:
                 return
             if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"multihost checkpoint barrier {tag!r}: only "
-                    f"{seen}/{nproc} processes arrived within "
-                    f"{timeout_s:.0f}s — a peer died mid-save")
+                missing = sorted(
+                    {f"p{i}" for i in range(nproc)} - present,
+                    key=lambda s: int(s[1:]))
+                err = FleetBarrierTimeout(tag, missing, timeout_s,
+                                          arrived=len(present),
+                                          expected=nproc)
+                emit("recovery", phase="barrier_timeout", tag=tag,
+                     missing=list(missing), arrived=len(present),
+                     expected=nproc, deadline_s=float(timeout_s))
+                dump_flight_record(err)  # best-effort (None w/o a log)
+                raise err
             time.sleep(0.01)
 
     def _write_and_commit_multihost(self, state, model, extra,

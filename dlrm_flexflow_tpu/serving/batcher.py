@@ -196,6 +196,13 @@ class DynamicBatcher:
         # single consumer when the queue is full)
         self._carry: Optional[_Request] = None
         self._cancelling = False  # close(drain=False) in progress
+        # health state (docs/serving.md): the exception that killed the
+        # dispatcher thread (None while healthy) and the count of
+        # consecutive engine.predict failures — the router's ejection
+        # probe reads both (dispatcher_dead / the circuit breaker).
+        # Shared dispatcher-thread/public state: _intake_lock guards it
+        self._dispatch_exc: Optional[BaseException] = None
+        self._engine_failures = 0
         # live-metrics visibility (telemetry/metrics.py): queue depth +
         # served/shed counters scrape-able while this batcher lives;
         # close() retires it (final counters fold so totals stay
@@ -405,52 +412,141 @@ class DynamicBatcher:
             f"request waited past its {req.deadline_us:.0f} us deadline"))
 
     def _loop(self) -> None:
-        while True:
-            batch = self._collect()
-            if batch is None:
-                return
-            now = time.perf_counter()
-            queue_wait_us = (now - min(r.t_submit for r in batch)) * 1e6
-            joined = {
-                name: np.concatenate([r.inputs[name] for r in batch],
-                                     axis=0)
-                for name in self.engine._in_specs}
-            # the micro-batch's dispatch span roots its own trace and
-            # becomes the dispatcher thread's CURRENT span, so the
-            # engine's pad/forward child spans nest under it; each
-            # request additionally gets a per-request serve.forward
-            # child (record_span below) sharing this one engine wall,
-            # completing every request's submit -> reply chain
-            dsp = start_span("serve.dispatch",
-                             attrs={"requests": len(batch),
-                                    "rows": sum(r.rows for r in batch)})
-            push_span(dsp)
-            fwd_start_s = time.time()
-            t_fwd = time.perf_counter()
-            try:
-                out = self.engine.predict(joined,
-                                          queue_wait_us=queue_wait_us)
-            except Exception as e:  # deliver the failure, keep serving
-                pop_span(dsp)
-                dsp.end(status="error")
-                for r in batch:
-                    r.span.end(status="error")
-                    r.future._set_exception(e)
-                continue
+        # the dispatcher must never die SILENTLY: an unexpected raise
+        # (anything but the engine failures _dispatch already absorbs)
+        # would strand every queued + in-flight future with no writer —
+        # clients block forever.  Fail them all loudly instead, flag
+        # the death for the router's health probe, and re-raise.
+        batch: Optional[List["_Request"]] = None
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                self._dispatch(batch)
+                batch = None
+        except BaseException as e:
+            self._dispatcher_died(e, batch or [])
+            raise
+
+    def _dispatch(self, batch: List["_Request"]) -> None:
+        now = time.perf_counter()
+        queue_wait_us = (now - min(r.t_submit for r in batch)) * 1e6
+        joined = {
+            name: np.concatenate([r.inputs[name] for r in batch],
+                                 axis=0)
+            for name in self.engine._in_specs}
+        # the micro-batch's dispatch span roots its own trace and
+        # becomes the dispatcher thread's CURRENT span, so the
+        # engine's pad/forward child spans nest under it; each
+        # request additionally gets a per-request serve.forward
+        # child (record_span below) sharing this one engine wall,
+        # completing every request's submit -> reply chain
+        dsp = start_span("serve.dispatch",
+                         attrs={"requests": len(batch),
+                                "rows": sum(r.rows for r in batch)})
+        push_span(dsp)
+        fwd_start_s = time.time()
+        t_fwd = time.perf_counter()
+        try:
+            out = self.engine.predict(joined,
+                                      queue_wait_us=queue_wait_us)
+        except Exception as e:  # deliver the failure, keep serving
             pop_span(dsp)
-            fwd_us = (time.perf_counter() - t_fwd) * 1e6
-            self.stats.record_dispatch()
-            done = time.perf_counter()
-            lo = 0
+            dsp.end(status="error")
             for r in batch:
-                r.future._set(jax.tree.map(
-                    lambda a, lo=lo, hi=lo + r.rows: a[lo:hi], out))
-                self.stats.record((done - r.t_submit) * 1e6)
-                record_span("serve.forward", fwd_start_s, fwd_us,
-                            parent=r.span, attrs={"rows": r.rows})
-                r.span.end()
-                lo += r.rows
-            dsp.end()
+                r.span.end(status="error")
+                r.future._set_exception(e)
+            with self._intake_lock:  # the router's circuit breaker
+                self._engine_failures += 1
+            return
+        with self._intake_lock:
+            self._engine_failures = 0  # a success re-arms the breaker
+        pop_span(dsp)
+        fwd_us = (time.perf_counter() - t_fwd) * 1e6
+        self.stats.record_dispatch()
+        done = time.perf_counter()
+        lo = 0
+        for r in batch:
+            r.future._set(jax.tree.map(
+                lambda a, lo=lo, hi=lo + r.rows: a[lo:hi], out))
+            self.stats.record((done - r.t_submit) * 1e6)
+            record_span("serve.forward", fwd_start_s, fwd_us,
+                        parent=r.span, attrs={"rows": r.rows})
+            r.span.end()
+            lo += r.rows
+        dsp.end()
+
+    # --------------------------------------------------------------- health
+    def dispatcher_dead(self) -> bool:
+        """Whether the dispatcher thread died UNEXPECTEDLY: it recorded
+        a fatal exception, or it was started, is no longer alive, and
+        the batcher was never closed.  The ReplicaRouter's health probe
+        keys ejection on this (docs/serving.md)."""
+        with self._intake_lock:
+            if self._dispatch_exc is not None:
+                return True
+            dead_thread = (self._thread is not None
+                           and not self._thread.is_alive())
+            return dead_thread and not self._closed
+
+    def consecutive_engine_failures(self) -> int:
+        """Failed ``engine.predict`` dispatches since the last success —
+        the router's circuit-breaker input (a healthy engine resets it
+        to 0 on every delivered batch)."""
+        with self._intake_lock:
+            return self._engine_failures
+
+    def fail_pending(self, exc: BaseException,
+                     extra=()) -> List["ServeFuture"]:
+        """Fail EVERY pending request with ``exc``: the carry, the whole
+        queue, plus any ``extra`` in-flight requests the caller holds —
+        and close intake, so no later submit can enqueue behind a dead
+        dispatcher.  Futures are first-write-wins, so already-delivered
+        results are untouched (their stats are not re-counted either).
+        Returns the futures actually failed.  The dispatcher's death
+        path and the router's ejection both route through here: a dead
+        replica must fail its clients loudly, never hang them."""
+        with self._intake_lock:
+            self._closed = True
+            self._cancelling = True
+            if self._dispatch_exc is None:
+                self._dispatch_exc = exc
+            pending = [self._carry] if self._carry is not None else []
+            self._carry = None
+        # _closed was flipped under the lock, so no submit can enqueue
+        # after this drain starts — the queue can only shrink here
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _STOP:
+                pending.append(req)
+        pending.extend(r for r in extra if r is not None)
+        failed: List["ServeFuture"] = []
+        for req in pending:
+            if req.future.done():
+                continue
+            self.stats.record_reject()
+            emit("serve", phase="reject", reason="replica_dead")
+            req.qspan.end(status="error")
+            req.span.set_attr("reason", "replica_dead")
+            req.span.end(status="error")
+            req.future._set_exception(exc)
+            failed.append(req.future)
+        return failed
+
+    def _dispatcher_died(self, exc: BaseException, inflight) -> None:
+        """The dispatcher thread's own crash epilogue (see _loop)."""
+        import sys
+        failed = self.fail_pending(exc, extra=inflight)
+        emit("recovery", phase="dispatcher_died", error=repr(exc),
+             failed=len(failed))
+        print(f"# serve batcher: dispatcher thread died ({exc!r}) — "
+              f"failed {len(failed)} pending request(s) loudly",
+              file=sys.stderr)
+        sys.stderr.flush()
 
     # ------------------------------------------------------------- shutdown
     def close(self, drain: bool = True,

@@ -31,6 +31,17 @@ reshape).  Retired replicas fold their counters into the metrics
 retained base (and into this router's pooled close summary), so the
 served/shed counters stay monotone across any resize.
 
+The router is also **self-healing** (docs/serving.md): a replica whose
+dispatcher thread died, or whose engine failed
+``max_engine_failures`` consecutive dispatches (the circuit breaker),
+is EJECTED from dispatch by :meth:`check_health` — its pending futures
+fail with a named :class:`ReplicaDead` instead of hanging clients,
+the ejection counts in ``dlrm_serve_replica_ejected_total``, and one
+``recovery`` ``phase="eject"`` event names the replica and reason.
+Survivors keep serving;
+:meth:`~..elastic.controller.ElasticController.heal` optionally
+rebuilds capacity through :meth:`scale_to`.
+
 Per-replica live metrics (`dlrm_serve_replica_qps{replica=}`,
 `dlrm_serve_replica_queue_depth{replica=}`), the live replica count
 (`dlrm_serve_replicas`), and the monotone router-level
@@ -50,6 +61,13 @@ import numpy as np
 from ..telemetry import emit
 from ..telemetry import metrics as _metrics
 from .batcher import DynamicBatcher, Rejected, ServeFuture, _CloseOnce
+
+
+class ReplicaDead(RuntimeError):
+    """A serving replica was ejected from dispatch (dead dispatcher
+    thread or tripped engine circuit breaker); every future it still
+    owed completes with this — NAMED, immediate — instead of leaving
+    clients blocked on results that can never arrive."""
 
 
 class _Replica:
@@ -234,7 +252,14 @@ class ReplicaRouter:
         stats are folded, so the count lands in the retained base) —
         /metrics and the event stream stay in agreement during
         shutdown."""
-        _metrics.record_shed_late(self.batchers[0].stats)
+        with self._lock:
+            # ejections can empty the live set — fall back to a folded
+            # replica's stats so the reject still reaches /metrics
+            stats = (self._replicas[0].batcher.stats if self._replicas
+                     else self._folded_stats[0] if self._folded_stats
+                     else None)
+        if stats is not None:
+            _metrics.record_shed_late(stats)
         emit("serve", phase="reject", reason="shutdown")
         return Rejected("router is shut down")
 
@@ -257,6 +282,65 @@ class ReplicaRouter:
     def shed_count(self) -> int:
         """Router-level sheds so far (requests no replica could take)."""
         return _metrics.router_shed_count(self._shed_cell)
+
+    # ---------------------------------------------------------------- health
+    def check_health(self, max_engine_failures: Optional[int] = None
+                     ) -> List[str]:
+        """Probe every live replica and eject the dead ones; returns
+        the ejected labels (usually empty).  Two probes
+        (docs/serving.md):
+
+        * **dispatcher liveness** — the batcher's dispatcher thread
+          died unexpectedly (``DynamicBatcher.dispatcher_dead``); its
+          own death path already failed its pending futures, ejection
+          removes it from dispatch and folds its counters;
+        * **circuit breaker** — ``max_engine_failures`` (when given)
+          or more CONSECUTIVE failed engine dispatches: the engine
+          still answers but only with errors, so routing more traffic
+          at it just converts requests into exceptions.
+
+        Each ejection fails the replica's remaining futures with
+        :class:`ReplicaDead`, bumps
+        ``dlrm_serve_replica_ejected_total``, and emits one
+        ``recovery`` ``phase="eject"`` event.  Cheap enough to call on
+        a timer or before every scrape; never blocks on a dead
+        dispatcher."""
+        dead: List[Tuple[_Replica, str]] = []
+        for rep in self._snapshot():
+            if rep.batcher.dispatcher_dead():
+                dead.append((rep, "dispatcher_dead"))
+            elif (max_engine_failures is not None
+                  and rep.batcher.consecutive_engine_failures()
+                  >= int(max_engine_failures)):
+                dead.append((rep, "engine_failures"))
+        return [rep.label for rep, reason in dead
+                if self._eject(rep, reason)]
+
+    def _eject(self, rep: _Replica, reason: str) -> bool:
+        """Remove one dead replica from dispatch and fail what it owed.
+        Returns False when a concurrent eject/resize/close already took
+        it (the list swap under the lock is the election)."""
+        with self._lock:
+            if self._closed or rep not in self._replicas:
+                return False
+            self._replicas = [r for r in self._replicas if r is not rep]
+        err = ReplicaDead(
+            f"replica {rep.label} ejected from dispatch: {reason} — "
+            f"its pending requests fail here; surviving replicas keep "
+            f"serving (docs/serving.md)")
+        # fail first (queued + carry complete NOW, loudly), then close
+        # without drain: on a live-but-broken dispatcher (the breaker
+        # case) that lands the stop sentinel and joins the thread; on a
+        # dead one it just folds the counters.
+        failed = rep.batcher.fail_pending(err)
+        summary = rep.batcher.close(drain=False, emit_summary=False)
+        with self._lock:
+            self._folded.append(summary)
+            self._folded_stats.append(rep.batcher.stats)
+        _metrics.REPLICA_EJECTED.inc()
+        emit("recovery", phase="eject", replica=rep.label,
+             reason=reason, failed=len(failed))
+        return True
 
     # ------------------------------------------------------------- elasticity
     def _retire(self, retiring: List[_Replica]) -> int:
@@ -312,6 +396,13 @@ class ReplicaRouter:
             before = len(self._replicas)
             pool = (list(engines) if engines
                     else [r.batcher.engine for r in self._replicas])
+        if n > before and not pool:
+            # every replica was ejected dead: there is no live engine
+            # to clone — the caller must supply rebuilt ones
+            raise ValueError(
+                "scale_to cannot grow an empty replica set without "
+                "engines= — every replica was ejected; pass fresh "
+                "engines (docs/serving.md)")
         drained = 0
         if n > before:
             # build OUTSIDE the lock (batcher ctors start threads and

@@ -115,6 +115,16 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
                  "(grad-sync wait) as a percent of the most recent "
                  "fit window's wall — the measured column next to "
                  "the cost model's DCN-exposed prediction (PERF.md)"),
+    "dlrm_host_heartbeat_age_s": (
+        "gauge", "age in seconds of the stalest peer heartbeat file "
+                 "the host watchdog saw on its latest sweep — crosses "
+                 "the watchdog deadline when a peer host died or hung "
+                 "(resilience/watchdog.py — docs/resilience.md)"),
+    "dlrm_serve_replica_ejected_total": (
+        "counter", "serving replicas ejected from dispatch by the "
+                   "ReplicaRouter health probe (dead dispatcher "
+                   "thread or tripped consecutive-engine-failure "
+                   "circuit breaker — docs/serving.md)"),
 }
 
 
@@ -735,3 +745,10 @@ STRATEGY_VERSION = REGISTRY.register(Gauge("dlrm_strategy_version"))
 # so a scrape between runs still sees the newest known value.
 STEP_SKEW_MS = REGISTRY.register(Gauge("dlrm_step_skew_ms"))
 EXPOSED_COMM_PCT = REGISTRY.register(Gauge("dlrm_exposed_comm_pct"))
+# failure-domain hardening (resilience/watchdog.py, serving/router.py):
+# the host watchdog sets the heartbeat-age gauge on every sweep; the
+# router bumps the ejection counter as it removes a dead replica.
+HOST_HEARTBEAT_AGE = REGISTRY.register(
+    Gauge("dlrm_host_heartbeat_age_s"))
+REPLICA_EJECTED = REGISTRY.register(
+    Counter("dlrm_serve_replica_ejected_total"))

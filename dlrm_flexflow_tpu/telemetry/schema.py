@@ -212,6 +212,32 @@ SCHEMA: Dict[str, dict] = {
         "required": {"kind": str, "point": str},
         "optional": {"step": int, "remaining": int},
     },
+    # one failure-domain action (resilience/watchdog.py,
+    # elastic/recovery.py, serving/router.py — docs/resilience.md).
+    # ``phase`` selects the sub-shape: a peer whose heartbeat aged past
+    # the deadline ("dead_peer"), a podshard commit barrier that timed
+    # out naming its absentees ("barrier_timeout"), the step-level
+    # stall watchdog firing ("stall"), a survivor resuming at reduced
+    # fleet shape ("resume" — recover_and_resume), a replica ejected
+    # from dispatch ("eject"), or a serving dispatcher thread that died
+    # with its pending futures failed loudly ("dispatcher_died").
+    "recovery": {
+        "required": {"phase": str},
+        "optional": {"peer": str, "age_s": float, "deadline_s": float,
+                     "tag": str, "missing": list, "arrived": int,
+                     "expected": int, "stall_s": float, "limit_s": float,
+                     "step": int, "process_count": int, "path": str,
+                     "replica": str, "reason": str, "error": str,
+                     "failed": int, "duration_s": float},
+        "phases": {
+            "dead_peer": ("peer", "age_s", "deadline_s"),
+            "barrier_timeout": ("tag", "missing"),
+            "stall": ("stall_s", "limit_s"),
+            "resume": ("process_count", "path"),
+            "eject": ("replica", "reason"),
+            "dispatcher_died": ("error", "failed"),
+        },
+    },
     # per-phase wall attribution of one training step (or a whole fit
     # stretch when ``phase`` is a loop name) — the measured column next
     # to the cost model's DCN-exposed prediction (PERF.md).  Producers:

@@ -1,0 +1,713 @@
+"""Failure-domain recovery smoke matrix (tier-1: tests/test_recovery.py
+runs the fast half; the 2-OS-process scenarios ride the slow marker).
+
+End-to-end checks of host-loss detection + survivor recovery for pod
+training and replica health ejection for serving (docs/resilience.md,
+docs/serving.md):
+
+  1. heartbeat_staleness — ``.tmp`` debris of a process killed mid-beat
+     never reads as a live beat, an aged beat is flagged dead BY NAME
+     within the deadline (one ``recovery`` ``phase="dead_peer"`` event,
+     no re-flagging), and the stalest age lands on the
+     ``dlrm_host_heartbeat_age_s`` gauge;
+  2. barrier_timeout — a podshard commit fence with an absent peer
+     raises ``FleetBarrierTimeout`` naming exactly the missing process
+     within deadline + grace (never a silent park), emits the
+     ``phase="barrier_timeout"`` event, dumps a flight record, and the
+     error is BaseException-family so ``save()``'s never-abort handler
+     cannot swallow it;
+  3. stall_abort — an injected ``host_hang@step=K`` under the armed
+     stall watchdog (``FF_STALL_MULTIPLE``) ends the run with exit code
+     70 and a flight record instead of hanging for ``FF_HANG_S``;
+  4. dispatcher_death — a batcher whose dispatcher thread is killed by
+     a non-Exception error fails every queued + in-flight future with
+     that error (zero hung clients), flags ``dispatcher_dead()``, and
+     closes intake;
+  5. replica_ejection — a router serving open-loop load with a
+     replica whose engine fails every dispatch ejects it through the
+     circuit breaker (``check_health(max_engine_failures=...)``): zero
+     pending futures, the ejection counted in ``/metrics``, survivors
+     still serving;
+  6. local_recover — ``recover_and_resume`` on a committed checkpoint
+     directory restores the saved step and emits the
+     ``phase="resume"`` event, and training continues from it;
+  7. host_crash_resume (slow, 2 OS processes joined by
+     jax.distributed) — ``host_crash@step=K`` kills one host with
+     ``os._exit(17)``; the survivor's ``HostWatchdog`` names the dead
+     peer within the heartbeat deadline; ``recover_and_resume``
+     continues from the last podshard checkpoint at reduced shape with
+     a loss trajectory tracking the never-killed same-seed baseline
+     (rtol 1e-3);
+  8. hang_at_barrier (slow, 2 OS processes) — ``host_hang@barrier``
+     parks one host at a commit fence; the survivor's deadlined
+     barrier raises ``FleetBarrierTimeout`` naming it (instead of
+     hanging for ``FF_HANG_S``) and leaves a flight-record artifact.
+
+Exit 0 when every requested scenario passes; prints one line per
+scenario and exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import dlrm_flexflow_tpu as ff  # noqa: E402
+from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm  # noqa: E402
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader  # noqa: E402
+from dlrm_flexflow_tpu.resilience import (CheckpointManager,  # noqa: E402
+                                          FleetBarrierTimeout)
+from dlrm_flexflow_tpu.resilience.watchdog import (STALL_EXIT,  # noqa: E402
+                                                   HostWatchdog, beat,
+                                                   heartbeat_ages)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+from dlrm_flexflow_tpu.telemetry.fleet import find_flight_records  # noqa: E402
+
+BATCH, SAMPLES = 8, 32  # 4 batches per epoch on the tiny DLRM
+
+
+def make_model():
+    cfg = DLRMConfig(sparse_feature_size=8, embedding_size=[64, 48],
+                     embedding_bag_size=2, mlp_bot=[4, 8, 8],
+                     mlp_top=[8 * 2 + 8, 8, 1])
+    m = build_dlrm(cfg, ff.FFConfig(batch_size=BATCH))
+    m.compile(optimizer=ff.AdamOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=(), mesh=False)
+    return cfg, m
+
+
+def make_loader(cfg):
+    return SyntheticDLRMLoader(SAMPLES, cfg.mlp_bot[0],
+                               cfg.embedding_size,
+                               cfg.embedding_bag_size, BATCH, seed=3)
+
+
+# ------------------------------------------------------- serving stubs
+#
+# The serving scenarios exercise the batcher/router health machinery,
+# not the model forward — stub engines keep them compile-free and fast.
+class _StubEngine:
+    """The minimal surface DynamicBatcher consumes: ``model.config``
+    knobs, ``buckets``, ``_in_specs``, ``predict``."""
+
+    class _Cfg:
+        serve_max_batch = 0
+        serve_max_wait_us = 300.0
+        serve_queue_depth = 256
+        serve_timeout_us = 0.0
+
+    class _Model:
+        pass
+
+    def __init__(self):
+        self.model = self._Model()
+        self.model.config = self._Cfg()
+        self.buckets = [8]
+        self._in_specs = {"x": ((4,), np.float32)}
+
+    def predict(self, joined, queue_wait_us=0.0):
+        return np.zeros((len(joined["x"]), 1), np.float32)
+
+
+class _BrokenEngine(_StubEngine):
+    """Fails every dispatch with an ordinary Exception — the circuit
+    breaker's food (the dispatcher itself survives)."""
+
+    def predict(self, joined, queue_wait_us=0.0):
+        raise RuntimeError("wedged device: every dispatch fails")
+
+
+class _Kill(BaseException):
+    """A non-Exception error: kills the dispatcher thread itself."""
+
+
+class _KillerEngine(_StubEngine):
+    def predict(self, joined, queue_wait_us=0.0):
+        raise _Kill("dispatcher thread killed mid-dispatch")
+
+
+def _req(n=1):
+    return {"x": np.zeros((n, 4), np.float32)}
+
+
+# ---------------------------------------------------------- scenarios
+
+def scenario_heartbeat_staleness() -> str:
+    td = tempfile.mkdtemp(prefix="rec_hb_")
+    beat(td, 0)
+    beat(td, 1)
+    aged = time.time() - 120.0
+    os.utime(os.path.join(td, "heartbeat-p001"), (aged, aged))
+    # p2 was killed mid-beat: only the un-renamed .tmp exists
+    with open(os.path.join(td, "heartbeat-p002.tmp-9999"), "w"):
+        pass
+    ages = heartbeat_ages(td, 3)
+    assert ages["p000"] is not None and ages["p000"] < 60.0, ages
+    assert ages["p001"] is not None and ages["p001"] > 100.0, ages
+    assert ages["p002"] is None, \
+        f".tmp debris read as a live beat: {ages}"
+
+    wd = HostWatchdog(td, 0, 3, interval_s=0.1, deadline_s=5.0)
+    with event_log() as log:
+        newly = wd.sweep()
+    # p001's beat is 120s old -> dead; p002 never beat, so it ages from
+    # the watchdog's own start (~0s here) -> still alive
+    assert newly == ["p001"], newly
+    assert wd.dead_peers() == ["p001"]
+    assert wd.max_peer_age() > 100.0
+    assert wd.sweep() == [], "a dead peer must not re-flag every sweep"
+    ev = log.last("recovery")
+    assert ev is not None and ev["phase"] == "dead_peer" \
+        and ev["peer"] == "p001" and ev["age_s"] > 100.0, ev
+    from dlrm_flexflow_tpu.telemetry.metrics import REGISTRY
+    body = REGISTRY.render()
+    assert "dlrm_host_heartbeat_age_s" in body
+    return (f"p001 dead at age {ev['age_s']:.0f}s, .tmp never live, "
+            f"gauge exposed")
+
+
+def scenario_barrier_timeout() -> str:
+    td = tempfile.mkdtemp(prefix="rec_bar_")
+    flights = tempfile.mkdtemp(prefix="rec_bar_fl_")
+    mgr = CheckpointManager(td, multihost=True, barrier_timeout_s=0.5)
+    os.environ["FF_FLIGHT_DIR"] = flights
+    try:
+        with event_log() as log:
+            t0 = time.monotonic()
+            try:
+                mgr._barrier("7-1", pidx=0, nproc=2)
+                return "barrier with an absent peer never timed out"
+            except FleetBarrierTimeout as e:
+                waited = time.monotonic() - t0
+                err = e
+        assert not isinstance(err, Exception), \
+            "FleetBarrierTimeout must be BaseException-family (the " \
+            "Preemption precedent) or save() would swallow a dead fleet"
+        assert err.missing == ("p1",), err.missing
+        assert err.arrived == 1 and err.expected == 2
+        assert "p1" in str(err) and "recover_and_resume" in str(err)
+        assert waited < 5.0, \
+            f"blocked {waited:.1f}s past a 0.5s deadline"
+        ev = log.last("recovery")
+        assert ev is not None and ev["phase"] == "barrier_timeout" \
+            and ev["missing"] == ["p1"] and ev["tag"] == "7-1", ev
+        recs = find_flight_records(flights)
+        assert recs, "no flight record dumped before the abort"
+    finally:
+        os.environ.pop("FF_FLIGHT_DIR", None)
+    return (f"p1 named after {waited:.2f}s, flight record "
+            f"{os.path.basename(recs[0])}")
+
+
+#: spawned body for the stall scenario: an injected step hang under the
+#: armed watchdog must end the process with STALL_EXIT, not sleep out
+#: FF_HANG_S
+STALL_SRC = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+repo, flight_dir = sys.argv[1], sys.argv[2]
+sys.path.insert(0, repo)
+os.environ["FF_FLIGHT_DIR"] = flight_dir
+os.environ["FF_FAULTS"] = "host_hang@step=2"
+os.environ["FF_HANG_S"] = "120"
+os.environ["FF_STALL_MULTIPLE"] = "3"
+os.environ["FF_STALL_FLOOR_S"] = "1.0"
+from scripts.check_recovery import make_model, make_loader
+from dlrm_flexflow_tpu.telemetry import event_log
+cfg, m = make_model()
+with event_log():
+    m.fit(m.init(seed=0), make_loader(cfg), epochs=1, verbose=False)
+print("fit returned — the hang never fired or the watchdog slept")
+sys.exit(3)
+"""
+
+
+def scenario_stall_abort() -> str:
+    import subprocess
+
+    flights = tempfile.mkdtemp(prefix="rec_stall_fl_")
+    script = os.path.join(tempfile.mkdtemp(prefix="rec_stall_"),
+                          "stall.py")
+    with open(script, "w") as f:
+        f.write(STALL_SRC)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, script, REPO, flights],
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    wall = time.monotonic() - t0
+    assert r.returncode == STALL_EXIT, (
+        f"exit {r.returncode}, want {STALL_EXIT}:\n"
+        f"{r.stdout[-800:]}\n{r.stderr[-800:]}")
+    assert "stalled" in r.stderr, r.stderr[-800:]
+    assert wall < 120.0, \
+        f"watchdog took {wall:.0f}s — it slept out the injected hang"
+    recs = find_flight_records(flights)
+    assert recs, "stall abort left no flight record"
+    return (f"exit {STALL_EXIT} after {wall:.0f}s (hang was 120s), "
+            f"flight record present")
+
+
+def scenario_dispatcher_death() -> str:
+    from dlrm_flexflow_tpu.serving import DynamicBatcher, Rejected
+
+    b = DynamicBatcher(_KillerEngine(), autostart=False)
+    futs = [b.submit(_req(2)), b.submit(_req(1))]
+    with event_log() as log:
+        b.start()
+        deadline = time.monotonic() + 10.0
+        while not b.dispatcher_dead() and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert b.dispatcher_dead(), "death never flagged"
+    failures = []
+    for f in futs:
+        try:
+            f.result(timeout=5.0)
+        except _Kill as e:
+            failures.append(e)
+    assert len(failures) == len(futs), \
+        f"{len(futs) - len(failures)} future(s) not failed loudly"
+    try:
+        b.submit(_req(1))
+        return "a submit after dispatcher death was accepted"
+    except Rejected:
+        pass
+    ev = log.last("recovery")
+    assert ev is not None and ev["phase"] == "dispatcher_died" \
+        and ev["failed"] == len(futs) and "_Kill" in ev["error"], ev
+    return (f"{len(futs)} futures failed with the killing error, "
+            f"intake closed")
+
+
+def scenario_replica_ejection() -> str:
+    from dlrm_flexflow_tpu.serving import ReplicaRouter
+
+    # the broken replica FIRST: least-loaded ties resolve to index 0,
+    # so it actually receives traffic under paced open-loop load
+    router = ReplicaRouter([_BrokenEngine(), _StubEngine()],
+                           name="hr", max_wait_us=200.0)
+    futs = []
+    ejected = []
+    with event_log() as log:
+        for i in range(40):
+            futs.append(router.submit(_req(1)))
+            time.sleep(0.004)
+            if i % 5 == 4:
+                ejected += router.check_health(max_engine_failures=2)
+        ejected += router.check_health(max_engine_failures=2)
+        assert ejected == ["hr0"], ejected
+        assert len(router) == 1
+        ok = err = 0
+        for f in futs:
+            try:
+                f.result(timeout=5.0)
+                ok += 1
+            except BaseException:  # noqa: BLE001 — failed loudly is fine
+                err += 1
+        assert ok + err == len(futs), "a future was left hanging"
+        assert ok > 0, "the surviving replica served nothing"
+        # survivors still serve after the ejection
+        np.asarray(router.submit(_req(1)).result(timeout=5.0))
+        ev = log.last("recovery")
+        assert ev is not None and ev["phase"] == "eject" \
+            and ev["replica"] == "hr0" \
+            and ev["reason"] == "engine_failures", ev
+        from dlrm_flexflow_tpu.telemetry.metrics import REGISTRY
+        body = REGISTRY.render()
+        line = [ln for ln in body.splitlines()
+                if ln.startswith("dlrm_serve_replica_ejected_total")]
+        assert line and float(line[0].split()[-1]) >= 1.0, line
+        summary = router.close()
+    return (f"hr0 ejected, {ok} served / {err} failed loudly of "
+            f"{len(futs)}, 0 hung; shed={summary.get('shed', 0)}")
+
+
+def scenario_local_recover() -> str:
+    from dlrm_flexflow_tpu.elastic import recover_and_resume
+
+    cfg, m = make_model()
+    d = tempfile.mkdtemp(prefix="rec_local_")
+    m.fit(m.init(seed=0), make_loader(cfg), epochs=1, verbose=False,
+          checkpoint_manager=CheckpointManager(d),
+          checkpoint_every_n_steps=2)
+    with event_log() as log:
+        model, state, extra, path = recover_and_resume(d, m)
+    step = int(np.asarray(state.step))
+    assert step == SAMPLES // BATCH, f"restored step {step}"
+    ev = log.last("recovery")
+    assert ev is not None and ev["phase"] == "resume" \
+        and ev["process_count"] == 1 and ev["step"] == step \
+        and ev["path"] == path, ev
+    # the recovered state trains
+    loader = make_loader(cfg)
+    inputs, labels = next(iter(loader))
+    state, mets = model.train_step(state, inputs, labels)
+    assert np.isfinite(float(mets["loss"]))
+    return f"resumed at step {step} from {os.path.basename(path)}"
+
+
+# ----------------------------------------- slow: 2-OS-process scenarios
+#
+# The check_pod.py precedent: two real processes joined by
+# jax.distributed, per-process compute on LOCAL meshes (this
+# container's CPU jaxlib runs no cross-process XLA programs), the
+# checkpoint re-placed on the global mesh so the podshard protocol
+# crosses processes for real.
+CRASH_WORKER_SRC = """
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, data_path, ckpt_dir, hb_dir, out_path = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+
+import numpy as np
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu import distributed as dist
+from dlrm_flexflow_tpu.resilience import CheckpointManager, faultinject
+from dlrm_flexflow_tpu.resilience.watchdog import HostWatchdog
+from scripts.check_pod import to_global_state, two_proc_model
+
+info = dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=2, process_id=pid)
+assert info["process_count"] == 2, info
+faultinject.install_from_env()   # victim: FF_FAULTS=host_crash@step=2
+
+data = np.load(data_path)
+m = two_proc_model(mesh=ff.make_mesh({"data": 2, "model": 2},
+                                     devices=jax.local_devices()))
+state = m.init(seed=0)
+mgr = CheckpointManager(ckpt_dir, multihost=True)
+wd = HostWatchdog(hb_dir, pid, 2, interval_s=0.2,
+                  deadline_s=2.0).start()
+
+dense, sparse, labels = data["dense"], data["sparse"], data["labels"]
+losses = []
+for t in range(2):
+    state, mets = m.train_step(
+        state, {"dense": dense[t], "sparse": sparse[t]}, labels[t])
+    losses.append(float(mets["loss"]))
+path = mgr.save(to_global_state(state), model=m,
+                extra={"batches_done": 2})
+assert path is not None
+
+t_cont = time.monotonic()
+for t in range(2, 4):
+    # the victim's host_crash@step=2 fires HERE: os._exit(17), no
+    # unwinding, no goodbye — this process is simply gone
+    faultinject.maybe_host_fault("step", step=t)
+    state, mets = m.train_step(
+        state, {"dense": dense[t], "sparse": sparse[t]}, labels[t])
+    losses.append(float(mets["loss"]))
+
+dead = wd.wait_for_death(30.0)
+detect_s = time.monotonic() - t_cont
+wd.stop()
+json.dump({"pid": pid, "losses": losses, "path": path, "dead": dead,
+           "detect_s": detect_s}, open(out_path, "w"))
+sys.stdout.flush()
+os._exit(0)   # skip jax.distributed teardown: the peer is dead
+"""
+
+
+def _spawn_two(script, argv_builder, env_builder, timeout=560):
+    """check_pod's launch pattern: free port, two Popens, drain both."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [subprocess.Popen(
+        [sys.executable, script] + argv_builder(i, port),
+        env=env_builder(i), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    logs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            logs.append(out)
+    except subprocess.TimeoutExpired:
+        logs.append("<timeout>")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    logs += ["<killed>"] * (len(procs) - len(logs))
+    return procs, logs
+
+
+def scenario_host_crash_resume() -> str:
+    import json
+
+    from dlrm_flexflow_tpu.elastic import recover_and_resume
+    from dlrm_flexflow_tpu.resilience.faultinject import CRASH_EXIT
+    from scripts.check_pod import two_proc_model
+
+    rng = np.random.default_rng(0)
+    B, TBATCH = 32, 4
+    dense = rng.standard_normal((TBATCH, B, 4)).astype(np.float32)
+    sparse = rng.integers(0, 64, size=(TBATCH, B, 4, 2)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(TBATCH, B, 1)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = os.path.join(td, "data.npz")
+        np.savez(data_path, dense=dense, sparse=sparse, labels=labels)
+        ckpt_dir = os.path.join(td, "ckpt")
+        hb_dir = os.path.join(td, "hb")
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(CRASH_WORKER_SRC)
+        outs = [os.path.join(td, f"out{i}.json") for i in range(2)]
+
+        base_env = dict(os.environ)
+        base_env.pop("XLA_FLAGS", None)
+        base_env.pop("FF_FAULTS", None)
+        base_env["PYTHONPATH"] = REPO + os.pathsep + \
+            base_env.get("PYTHONPATH", "")
+
+        def env_builder(i):
+            env = dict(base_env)
+            if i == 1:   # the victim host
+                env["FF_FAULTS"] = "host_crash@step=2"
+            return env
+
+        def argv_builder(i, port):
+            return [str(i), str(port), data_path, ckpt_dir, hb_dir,
+                    outs[i]]
+
+        procs, logs = _spawn_two(script, argv_builder, env_builder)
+        if procs[0].returncode != 0 or procs[1].returncode != CRASH_EXIT:
+            procs, logs = _spawn_two(script, argv_builder,
+                                     env_builder)  # one retry (port)
+        assert procs[1].returncode == CRASH_EXIT, (
+            f"victim exit {procs[1].returncode}, want {CRASH_EXIT}:\n"
+            f"{logs[1][-2000:]}")
+        assert procs[0].returncode == 0, \
+            f"survivor failed:\n{logs[0][-2000:]}"
+        surv = json.load(open(outs[0]))
+        assert surv["dead"] == ["p001"], (
+            f"survivor watchdog flagged {surv['dead']}, want the "
+            f"victim p001")
+        assert surv["detect_s"] < 15.0, (
+            f"detection took {surv['detect_s']:.1f}s against a 2s "
+            f"heartbeat deadline")
+        assert len(surv["losses"]) == TBATCH
+
+        # ---- survivor recovery at reduced shape (1 process) --------
+        builder = lambda: two_proc_model(  # noqa: E731
+            mesh=ff.make_mesh({"data": 4, "model": 2}))
+        with event_log() as log:
+            model, state, extra, path = recover_and_resume(
+                ckpt_dir, builder)
+        assert extra["batches_done"] == 2
+        ev = log.last("recovery")
+        assert ev is not None and ev["phase"] == "resume" \
+            and ev["process_count"] == 1, ev
+        resumed = list(surv["losses"][:2])
+        for t in range(2, TBATCH):
+            state, mets = model.train_step(
+                state, {"dense": dense[t], "sparse": sparse[t]},
+                labels[t])
+            resumed.append(float(mets["loss"]))
+
+        # ---- never-killed same-seed baseline -----------------------
+        m2 = two_proc_model(mesh=ff.make_mesh({"data": 4, "model": 2}))
+        st2 = m2.init(seed=0)
+        ref = []
+        for t in range(TBATCH):
+            st2, mets = m2.train_step(
+                st2, {"dense": dense[t], "sparse": sparse[t]},
+                labels[t])
+            ref.append(float(mets["loss"]))
+        np.testing.assert_allclose(resumed, ref, rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(surv["losses"], ref, rtol=1e-3,
+                                   atol=1e-5)
+        return (f"victim exit {CRASH_EXIT} at step 2, p001 dead in "
+                f"{surv['detect_s']:.1f}s, resumed trajectory tracks "
+                f"baseline")
+
+
+HANG_WORKER_SRC = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port, data_path, ckpt_dir, flight_dir, out_path = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+os.environ["FF_FLIGHT_DIR"] = flight_dir
+
+import numpy as np
+import dlrm_flexflow_tpu as ff
+from dlrm_flexflow_tpu import distributed as dist
+from dlrm_flexflow_tpu.resilience import (CheckpointManager,
+                                          FleetBarrierTimeout,
+                                          faultinject)
+from dlrm_flexflow_tpu.telemetry.fleet import fleet_event_log
+from scripts.check_pod import to_global_state, two_proc_model
+
+info = dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=2, process_id=pid)
+assert info["process_count"] == 2, info
+faultinject.install_from_env()  # victim: host_hang@barrier + FF_HANG_S
+
+data = np.load(data_path)
+m = two_proc_model(mesh=ff.make_mesh({"data": 2, "model": 2},
+                                     devices=jax.local_devices()))
+state = m.init(seed=0)
+state, _ = m.train_step(
+    state, {"dense": data["dense"][0], "sparse": data["sparse"][0]},
+    data["labels"][0])
+mgr = CheckpointManager(ckpt_dir, multihost=True, barrier_timeout_s=3.0)
+with fleet_event_log(os.path.join(flight_dir, "t.jsonl"), mode="w"):
+    try:
+        mgr.save(to_global_state(state), model=m, extra={})
+        verdict = {"pid": pid, "timed_out": False}
+    except FleetBarrierTimeout as e:
+        verdict = {"pid": pid, "timed_out": True,
+                   "missing": list(e.missing), "tag": e.tag,
+                   "is_exception": isinstance(e, Exception)}
+json.dump(verdict, open(out_path, "w"))
+sys.stdout.flush()
+os._exit(0)   # skip jax.distributed teardown: the peer is parked
+"""
+
+
+def scenario_hang_at_barrier() -> str:
+    import json
+    import subprocess
+
+    rng = np.random.default_rng(0)
+    B = 32
+    dense = rng.standard_normal((1, B, 4)).astype(np.float32)
+    sparse = rng.integers(0, 64, size=(1, B, 4, 2)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(1, B, 1)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = os.path.join(td, "data.npz")
+        np.savez(data_path, dense=dense, sparse=sparse, labels=labels)
+        ckpt_dir = os.path.join(td, "ckpt")
+        flight_dir = os.path.join(td, "flight")
+        os.makedirs(flight_dir)
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(HANG_WORKER_SRC)
+        outs = [os.path.join(td, f"out{i}.json") for i in range(2)]
+
+        base_env = dict(os.environ)
+        base_env.pop("XLA_FLAGS", None)
+        base_env.pop("FF_FAULTS", None)
+        base_env["PYTHONPATH"] = REPO + os.pathsep + \
+            base_env.get("PYTHONPATH", "")
+
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        def spawn(i):
+            env = dict(base_env)
+            if i == 1:   # the victim parks at the commit fence
+                env["FF_FAULTS"] = "host_hang@barrier"
+                env["FF_HANG_S"] = "300"
+            return subprocess.Popen(
+                [sys.executable, script, str(i), str(port), data_path,
+                 ckpt_dir, flight_dir, outs[i]],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        procs = [spawn(0), spawn(1)]
+        t0 = time.monotonic()
+        try:
+            out0, _ = procs[0].communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            out0, _ = procs[0].communicate()
+            raise AssertionError(
+                "survivor blocked past the barrier deadline:\n"
+                + out0[-2000:])
+        finally:
+            # the victim sleeps FF_HANG_S by design: reap it
+            procs[1].kill()
+            procs[1].communicate()
+        survivor_wall = time.monotonic() - t0
+        assert procs[0].returncode == 0, \
+            f"survivor failed:\n{out0[-2000:]}"
+        verdict = json.load(open(outs[0]))
+        assert verdict["timed_out"], \
+            "the survivor's save never raised FleetBarrierTimeout"
+        assert verdict["missing"] == ["p1"], verdict
+        assert verdict["is_exception"] is False
+        recs = find_flight_records(flight_dir)
+        assert recs, "no flight-record artifact next to the abort"
+        # the emitted barrier_timeout event landed in the fleet sink
+        sink = os.path.join(flight_dir, "t_p000.jsonl")
+        assert os.path.exists(sink), sorted(os.listdir(flight_dir))
+        evs = [json.loads(ln) for ln in open(sink)]
+        bt = [e for e in evs if e["type"] == "recovery"
+              and e["phase"] == "barrier_timeout"]
+        assert bt and bt[0]["missing"] == ["p1"], bt
+        return (f"survivor named p1 in {survivor_wall:.0f}s (hang was "
+                f"300s), flight record "
+                f"{os.path.basename(recs[0])}")
+
+
+FAST = (("heartbeat_staleness", scenario_heartbeat_staleness),
+        ("barrier_timeout", scenario_barrier_timeout),
+        ("stall_abort", scenario_stall_abort),
+        ("dispatcher_death", scenario_dispatcher_death),
+        ("replica_ejection", scenario_replica_ejection),
+        ("local_recover", scenario_local_recover))
+SLOW = (("host_crash_resume", scenario_host_crash_resume),
+        ("hang_at_barrier", scenario_hang_at_barrier))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    which = dict(FAST)
+    if "--scenario" in argv:
+        name = argv[argv.index("--scenario") + 1]
+        which = {n: f for n, f in FAST + SLOW if n == name}
+        if not which:
+            print(f"check_recovery: unknown scenario {name!r}")
+            return 2
+    elif "--all" in argv:
+        which = dict(FAST + SLOW)
+    failed = 0
+    for name, fn in which.items():
+        try:
+            detail = fn()
+            print(f"check_recovery: {name}: OK ({detail})")
+        except BaseException as e:  # noqa: BLE001 — report and count
+            failed += 1
+            import traceback
+            traceback.print_exc()
+            print(f"check_recovery: {name}: FAIL "
+                  f"({type(e).__name__}: {e})")
+    if failed:
+        print(f"check_recovery: {failed} scenario(s) FAILED")
+        return 1
+    print(f"check_recovery: OK ({len(which)} scenarios)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,7 +68,16 @@ field without the schema and the report CLI seeing it:
      ``dlrm_exposed_comm_pct``) must be declared, skew must gate
      UPWARD in the regress CLI (lower is better), and the per-process
      sink naming + ``--fleet``/``--flight`` report modes must be
-     documented in docs/telemetry.md.
+     documented in docs/telemetry.md;
+ 11. recovery contract — the ``recovery`` event type must carry every
+     failure-domain phase (heartbeat death, barrier timeout, stall,
+     survivor resume, replica ejection, dispatcher death), the
+     watchdog gauge (``dlrm_host_heartbeat_age_s``) and ejection
+     counter (``dlrm_serve_replica_ejected_total``) must be declared,
+     the host-loss fault kinds must parse (including the ``barrier``
+     injection point), and docs/resilience.md, docs/distributed.md,
+     and docs/serving.md must document the watchdog/recovery/ejection
+     entry points next to each other.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -532,6 +541,69 @@ def check_fleet_contract(doc_path: str) -> list:
     return errs
 
 
+RECOVERY_PHASES = ("dead_peer", "barrier_timeout", "stall", "resume",
+                   "eject", "dispatcher_died")
+RECOVERY_FAMILIES = ("dlrm_host_heartbeat_age_s",
+                     "dlrm_serve_replica_ejected_total")
+#: (doc path relative to docs/, needles that must appear backticked)
+RECOVERY_DOC_NEEDLES = (
+    ("resilience.md", ("HostWatchdog", "heartbeat-p", "StallWatchdog",
+                       "FleetBarrierTimeout", "recover_and_resume",
+                       "host_crash", "host_hang",
+                       "dlrm_host_heartbeat_age_s")),
+    ("distributed.md", ("barrier_timeout_s", "FleetBarrierTimeout")),
+    ("serving.md", ("check_health", "ReplicaDead", "dispatcher_dead",
+                    "consecutive_engine_failures",
+                    "dlrm_serve_replica_ejected_total")),
+)
+
+
+def check_recovery_contract() -> list:
+    """The failure-domain recovery contract (docs/resilience.md,
+    docs/serving.md): the ``recovery`` event phases, the watchdog
+    gauge + ejection counter, the host-loss fault specs, and the
+    documented entry points must all exist."""
+    from dlrm_flexflow_tpu.resilience import faultinject
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+
+    errs = []
+    phases = SCHEMA.get("recovery", {}).get("phases") or {}
+    if not phases:
+        errs.append("recovery: event type 'recovery' missing from the "
+                    "schema (or has no phases) — failure-domain "
+                    "telemetry is gone")
+    for ph in RECOVERY_PHASES:
+        if ph not in phases:
+            errs.append(f"recovery: phase {ph!r} missing from the "
+                        f"recovery event schema")
+    for name in RECOVERY_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"recovery: metric family {name!r} not "
+                        f"declared in telemetry.metrics.FAMILIES")
+    # the host-loss fault kinds must parse (barrier point included) —
+    # without them the recovery paths are unprovable
+    for spec in ("host_crash@step=3", "host_hang@step=3",
+                 "host_hang@barrier"):
+        try:
+            faultinject.parse(spec)
+        except Exception as e:
+            errs.append(f"recovery: fault spec {spec!r} no longer "
+                        f"parses: {e}")
+    for doc_name, needles in RECOVERY_DOC_NEEDLES:
+        path = os.path.join(REPO, "docs", doc_name)
+        if not os.path.exists(path):
+            errs.append(f"missing docs/{doc_name} (documented recovery "
+                        f"surface)")
+            continue
+        with open(path) as f:
+            doc = f.read()
+        for needle in needles:
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/{doc_name} does not document "
+                            f"`{needle}`")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -548,7 +620,8 @@ def main() -> int:
                                                   "pipeline.md"))
             + check_pod_contract(os.path.join(REPO, "docs",
                                               "distributed.md"))
-            + check_fleet_contract(doc))
+            + check_fleet_contract(doc)
+            + check_recovery_contract())
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

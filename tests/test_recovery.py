@@ -1,0 +1,352 @@
+"""Failure-domain hardening (docs/resilience.md): host-loss watchdogs,
+barrier deadlines, survivor recovery, serving self-healing — unit tests
+plus the scripts/check_recovery.py smoke matrix.
+
+The two 2-OS-process scenarios (host_crash_resume, hang_at_barrier)
+ride the slow marker: each spawns a fleet joined by jax.distributed
+and one of them deliberately parks a process for the hang window.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dlrm_flexflow_tpu.analysis import (FunctionIndex,  # noqa: E402
+                                        load_modules)
+from dlrm_flexflow_tpu.analysis.passes import (BarrierProtocolPass,  # noqa: E402
+                                               SharedStatePass)
+from dlrm_flexflow_tpu.resilience import (CheckpointManager,  # noqa: E402
+                                          FleetBarrierTimeout,
+                                          faultinject)
+from dlrm_flexflow_tpu.resilience.watchdog import (HostWatchdog,  # noqa: E402
+                                                   StallWatchdog, beat,
+                                                   heartbeat_ages)
+from dlrm_flexflow_tpu.telemetry import event_log  # noqa: E402
+
+CHECK = os.path.join(REPO, "scripts", "check_recovery.py")
+
+
+# ------------------------------------------------------------ heartbeats
+class TestHeartbeats:
+    def test_tmp_debris_and_stale_beats_never_read_live(self, tmp_path):
+        """A process killed mid-beat leaves only the un-renamed
+        ``.tmp-<pid>`` file; it must read as NO beat, not a fresh
+        one — and an aged beat must report its true age."""
+        d = str(tmp_path)
+        beat(d, 0)
+        beat(d, 1)
+        aged = time.time() - 90.0
+        os.utime(os.path.join(d, "heartbeat-p001"), (aged, aged))
+        (tmp_path / "heartbeat-p002.tmp-4242").write_text("")
+        ages = heartbeat_ages(d, 3)
+        assert ages["p000"] is not None and ages["p000"] < 30.0
+        assert ages["p001"] is not None and ages["p001"] > 80.0
+        assert ages["p002"] is None
+
+    def test_beat_is_atomic_rename(self, tmp_path):
+        beat(str(tmp_path), 7)
+        names = sorted(os.listdir(str(tmp_path)))
+        assert names == ["heartbeat-p007"]  # no .tmp left behind
+        assert heartbeat_ages(str(tmp_path), 8)["p007"] < 10.0
+
+    def test_missing_directory_reads_as_no_beats(self, tmp_path):
+        ages = heartbeat_ages(str(tmp_path / "never_made"), 2)
+        assert ages == {"p000": None, "p001": None}
+
+    def test_watchdog_names_dead_peer_once(self, tmp_path):
+        d = str(tmp_path)
+        beat(d, 1)
+        aged = time.time() - 60.0
+        os.utime(os.path.join(d, "heartbeat-p001"), (aged, aged))
+        wd = HostWatchdog(d, 0, 2, interval_s=0.1, deadline_s=5.0)
+        with event_log() as log:
+            assert wd.sweep() == ["p001"]
+            assert wd.sweep() == []  # flagged once, not every sweep
+        assert wd.dead_peers() == ["p001"]
+        ev = log.last("recovery")
+        assert ev["phase"] == "dead_peer" and ev["peer"] == "p001"
+
+    def test_never_beaten_peer_ages_from_watchdog_start(self, tmp_path):
+        # a peer that hasn't beaten YET is not dead at t=0: it ages
+        # from the watchdog's own start, so boot skew isn't a death
+        wd = HostWatchdog(str(tmp_path), 0, 2, deadline_s=30.0)
+        assert wd.sweep() == []
+
+    def test_stall_limit_floor(self):
+        progress = [0.0]
+        w = StallWatchdog(lambda: progress[0], wall=[0.001],
+                          multiple=10.0, floor_s=5.0)
+        assert w.limit_s() == 5.0  # sub-ms steps don't mean 10ms limits
+        w2 = StallWatchdog(lambda: progress[0], wall=[2.0],
+                           multiple=10.0, floor_s=5.0)
+        assert w2.limit_s() == 20.0
+
+
+# ------------------------------------------------------ barrier deadline
+class TestBarrierDeadline:
+    def test_timeout_names_exactly_the_absent_process(self, tmp_path):
+        """Doctored fence: we arrive as p0 of a claimed 2-process
+        fleet, so the p1 slot can never fill — the deadline must
+        raise naming p1 (and only p1) instead of parking forever."""
+        mgr = CheckpointManager(str(tmp_path), multihost=True,
+                                barrier_timeout_s=0.3)
+        with event_log() as log:
+            t0 = time.monotonic()
+            with pytest.raises(FleetBarrierTimeout) as ei:
+                mgr._barrier("3-1", pidx=0, nproc=2)
+            waited = time.monotonic() - t0
+        err = ei.value
+        assert err.missing == ("p1",)
+        assert err.arrived == 1 and err.expected == 2
+        assert "p1" in str(err)
+        assert waited < 5.0
+        ev = log.last("recovery")
+        assert ev["phase"] == "barrier_timeout"
+        assert ev["missing"] == ["p1"] and ev["tag"] == "3-1"
+
+    def test_timeout_is_not_exception_family(self):
+        # save()'s never-abort `except Exception` must not be able to
+        # downgrade a dead fleet to "save failed, continuing"
+        err = FleetBarrierTimeout("t", ["p1"], 1.0)
+        assert isinstance(err, BaseException)
+        assert not isinstance(err, Exception)
+
+    def test_full_fence_passes_within_deadline(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), multihost=True,
+                                barrier_timeout_s=5.0)
+        bdir = os.path.join(str(tmp_path), ".barrier-1-1")
+        os.makedirs(bdir)
+        with open(os.path.join(bdir, "p1"), "w"):
+            pass
+        mgr._barrier("1-1", pidx=0, nproc=2)  # completes, no raise
+
+
+# ------------------------------------------------------------ fault specs
+class TestHostFaultSpecs:
+    @pytest.mark.parametrize("spec", ["host_crash@step=3",
+                                      "host_hang@step=2",
+                                      "host_hang@barrier"])
+    def test_valid_host_loss_specs_parse(self, spec):
+        faults = faultinject.parse(spec)
+        assert len(faults) == 1 and faults[0].kind.startswith("host_")
+
+    @pytest.mark.parametrize("spec", ["host_crash@barrier",
+                                      "host_crash@save",
+                                      "host_hang@save",
+                                      "host_hang@restore",
+                                      "nan_grads@barrier"])
+    def test_invalid_point_combinations_rejected(self, spec):
+        # a silently-unreachable fault spec is worse than none
+        with pytest.raises(ValueError):
+            faultinject.parse(spec)
+
+
+# ------------------------------------------------- dispatcher death
+class _StubEngine:
+    class _Cfg:
+        serve_max_batch = 0
+        serve_max_wait_us = 300.0
+        serve_queue_depth = 64
+        serve_timeout_us = 0.0
+
+    class _Model:
+        pass
+
+    def __init__(self):
+        self.model = self._Model()
+        self.model.config = self._Cfg()
+        self.buckets = [8]
+        self._in_specs = {"x": ((4,), np.float32)}
+
+    def predict(self, joined, queue_wait_us=0.0):
+        return np.zeros((len(joined["x"]), 1), np.float32)
+
+
+class _Kill(BaseException):
+    pass
+
+
+class TestDispatcherDeath:
+    def test_thread_death_fails_queued_futures_loudly(self):
+        """Regression: a non-Exception error killing the dispatcher
+        thread used to leave every queued future parked until its
+        client's own timeout; now they all fail with the killing
+        error and intake closes."""
+        from dlrm_flexflow_tpu.serving import DynamicBatcher, Rejected
+
+        eng = _StubEngine()
+        eng.predict = lambda joined, queue_wait_us=0.0: (
+            (_ for _ in ()).throw(_Kill("engine runtime torn down")))
+        b = DynamicBatcher(eng, autostart=False)
+        futs = [b.submit({"x": np.zeros((1, 4), np.float32)})
+                for _ in range(3)]
+        with event_log() as log:
+            b.start()
+            deadline = time.monotonic() + 10.0
+            while (not b.dispatcher_dead()
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        assert b.dispatcher_dead()
+        for f in futs:
+            with pytest.raises(_Kill):
+                f.result(timeout=5.0)
+        with pytest.raises(Rejected):
+            b.submit({"x": np.zeros((1, 4), np.float32)})
+        ev = log.last("recovery")
+        assert ev["phase"] == "dispatcher_died"
+        assert ev["failed"] == len(futs) and "_Kill" in ev["error"]
+
+    def test_ordinary_engine_exception_keeps_dispatcher_alive(self):
+        # Exception-family failures are per-request errors (the
+        # circuit breaker's food), not thread deaths
+        from dlrm_flexflow_tpu.serving import DynamicBatcher
+
+        eng = _StubEngine()
+        eng.predict = lambda joined, queue_wait_us=0.0: (
+            (_ for _ in ()).throw(RuntimeError("bad batch")))
+        b = DynamicBatcher(eng, autostart=False)
+        f = b.submit({"x": np.zeros((1, 4), np.float32)})
+        b.start()
+        with pytest.raises(RuntimeError):
+            f.result(timeout=5.0)
+        assert not b.dispatcher_dead()
+        assert b.consecutive_engine_failures() >= 1
+        b.close(drain=False, emit_summary=False)
+
+
+# --------------------------------------------------- ffcheck fixtures
+def _run_pass(tmp_path, files, pass_cls):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        d = path.parent
+        while d != tmp_path:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+            d = d.parent
+        path.write_text(src)
+    roots = sorted({rel.split("/")[0] for rel in files})
+    modules = load_modules(roots=roots, repo=str(tmp_path))
+    return pass_cls().run(modules, FunctionIndex(modules))
+
+
+class TestWatchdogShapeFixtures:
+    """The new threaded/fenced code shapes, pinned as analyzer
+    fixtures: the buggy variants FIRE, the shipped idioms stay
+    silent — so ffcheck keeps guarding exactly the discipline the
+    recovery machinery depends on."""
+
+    def test_unlocked_watchdog_dead_set_fires(self, tmp_path):
+        # a sweep thread mutating the dead-set while a public reader
+        # returns it unlocked: the bug HostWatchdog's lock prevents
+        fs = _run_pass(tmp_path, {"pkg/w.py": (
+            "import threading\n"
+            "class WD:\n"
+            "    def __init__(self):\n"
+            "        self.dead = []\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.dead = self.dead + ['p001']\n"
+            "    def dead_peers(self):\n"
+            "        return list(self.dead)\n")}, SharedStatePass)
+        assert sorted({f.code for f in fs}) == ["unlocked-shared-attr"]
+        assert fs[0].detail == "WD.dead"
+
+    def test_locked_watchdog_shape_is_silent(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/w.py": (
+            "import threading\n"
+            "class WD:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.dead = []\n"
+            "        self._t = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.dead = self.dead + ['p001']\n"
+            "    def dead_peers(self):\n"
+            "        with self._lock:\n"
+            "            return list(self.dead)\n")}, SharedStatePass)
+        assert fs == []
+
+    DEADLINED_MGR = (
+        "import os, shutil, time\n"
+        "class E(BaseException):\n"
+        "    pass\n"
+        "class Mgr:\n"
+        "    def __init__(self, d):\n"
+        "        self.directory = d\n"
+        "    def _barrier(self, tag, pidx, nproc, timeout_s):\n"
+        "        bdir = os.path.join(self.directory,\n"
+        "                            f'.barrier-{tag}')\n"
+        "        os.makedirs(bdir, exist_ok=True)\n"
+        "        t0 = time.monotonic()\n"
+        "        while len(os.listdir(bdir)) < nproc:\n"
+        "            if time.monotonic() - t0 > timeout_s:\n"
+        "                raise E(tag)\n"
+        "            time.sleep(0.01)\n"
+        "    def sweep(self):\n"
+        "        for name in os.listdir(self.directory):\n"
+        "            if name.startswith('.barrier-'):\n"
+        "                shutil.rmtree(os.path.join(\n"
+        "                    self.directory, name))\n")
+
+    def test_deadlined_barrier_with_sweep_is_silent(self, tmp_path):
+        # the shipped shape: a deadline-poll fence swept by its
+        # minting class is protocol-clean
+        fs = _run_pass(tmp_path, {"pkg/m.py": self.DEADLINED_MGR},
+                       BarrierProtocolPass)
+        assert fs == []
+
+    def test_retry_around_deadlined_barrier_fires(self, tmp_path):
+        # the tempting-but-fatal "fix": retrying a timed-out fence
+        # mints fresh fences the dead process can never fill,
+        # re-parking every survivor — the single-attempt rule the
+        # deadline exists to protect
+        src = self.DEADLINED_MGR + (
+            "    def save(self, pidx, nproc):\n"
+            "        for attempt in range(3):\n"
+            "            try:\n"
+            "                self._barrier('t', pidx, nproc, 5.0)\n"
+            "            except E:\n"
+            "                continue\n"
+            "            break\n")
+        fs = _run_pass(tmp_path, {"pkg/m.py": src},
+                       BarrierProtocolPass)
+        assert sorted({f.code for f in fs}) == ["barrier-in-retry-loop"]
+        assert fs[0].detail == "Mgr.save"
+
+
+# -------------------------------------------------------- smoke matrix
+class TestCheckRecoverySmoke:
+    def test_check_recovery_smoke(self):
+        out = subprocess.run([sys.executable, CHECK],
+                             capture_output=True, text=True,
+                             timeout=560)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "check_recovery: OK (6 scenarios)" in out.stdout
+
+    @pytest.mark.slow
+    def test_check_recovery_host_crash_resume(self):
+        out = subprocess.run([sys.executable, CHECK, "--scenario",
+                              "host_crash_resume"],
+                             capture_output=True, text=True,
+                             timeout=560)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "host_crash_resume: OK" in out.stdout
+
+    @pytest.mark.slow
+    def test_check_recovery_hang_at_barrier(self):
+        out = subprocess.run([sys.executable, CHECK, "--scenario",
+                              "hang_at_barrier"],
+                             capture_output=True, text=True,
+                             timeout=560)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "hang_at_barrier: OK" in out.stdout
