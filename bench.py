@@ -75,6 +75,8 @@ def _emit(metric, thpt, key, extra=None, unit="samples/s"):
                     hv = "float32"  # records written before act_dtype
                 if k == "quantize" and hv is None:
                     hv = "off"  # records written before serve quantize
+                if k == "storage" and hv is None:
+                    hv = "resident"  # records written before tiering
                 if k == "replicas" and hv is None:
                     hv = 1  # records written before the replica router
                 if k == "hosts" and hv is None:
@@ -865,12 +867,27 @@ def bench_serving():
     # anchor key — an N-replica QPS entry never gates against the
     # single-replica baseline (regress keys ":replicas=N" the same way)
     replicas = int(os.environ.get("BENCH_REPLICAS", 1))
+    # BENCH_STORAGE={resident,tiered}: tiered embedding storage
+    # (docs/storage.md).  A tiered run pays hot-cache miss stalls by
+    # design, so like quantize it is PART of the anchor key — a tiered
+    # entry never gates the fully-resident baseline (regress keys
+    # ":storage=tiered" the same way).  BENCH_HOT_ROWS is the
+    # per-table device budget; BENCH_ID_DIST/BENCH_ZIPF_ALPHA shape
+    # the request-pool id traffic (power-law skew is what makes the
+    # cache win — and what the dispatch gate demands evidence of).
+    storage = (os.environ.get("BENCH_STORAGE", "resident")
+               .strip().lower() or "resident")
+    hot_rows = int(os.environ.get("BENCH_HOT_ROWS", 4096))
+    id_dist = (os.environ.get("BENCH_ID_DIST", "uniform")
+               .strip().lower() or "uniform")
+    zipf_alpha = float(os.environ.get("BENCH_ZIPF_ALPHA", 1.05))
     cfg = DLRMConfig()  # run_random.sh architecture — same as main()
     cfg.embedding_size = [rows] * 8
     cfg.fused_interaction = (os.environ.get("BENCH_FUSED", "off")
                              .strip().lower() or "off")
     fc = ff.FFConfig(batch_size=parse_buckets(buckets)[-1],
-                     compute_dtype=dtype, serve_buckets=buckets)
+                     compute_dtype=dtype, serve_buckets=buckets,
+                     serve_storage=storage, storage_hot_rows=hot_rows)
     model = build_dlrm(cfg, fc)
     model.compile(optimizer=ff.SGDOptimizer(lr=0.01),
                   loss_type="mean_squared_error", metrics=(),
@@ -881,18 +898,36 @@ def bench_serving():
     mesh_str = ("" if model.mesh is None else
                 ",".join(f"{a}={s}" for a, s in
                          zip(model.mesh.axis_names, model.mesh.devices.shape)))
-    engine = InferenceEngine(model, model.init(seed=0),
-                             quantize=quantize)  # warmup: AOT all
     rng = np.random.default_rng(0)
     # request pool in main()'s input convention: uniform tables, one
     # (rows, T, bag) id block — NOT the per-table ragged stacking the
     # tiny serve_bench/check_serving models use
+    def _ids(size):
+        if id_dist == "zipf":
+            from dlrm_flexflow_tpu.data.loader import zipf_ids
+            return zipf_ids(rng, rows, int(np.prod(size)),
+                            a=zipf_alpha).reshape(size)
+        return rng.integers(0, rows, size=size, dtype=np.int64)
     pool = [{"dense": rng.standard_normal(
                  (req_rows, cfg.mlp_bot[0])).astype(np.float32),
-             "sparse": rng.integers(
-                 0, rows, size=(req_rows, 8, cfg.embedding_bag_size),
-                 dtype=np.int64)}
+             "sparse": _ids((req_rows, 8, cfg.embedding_bag_size))}
             for _ in range(128)]
+    if storage == "tiered":
+        # feed the pool's id traffic to the row-frequency counters the
+        # LFU admission warm start and the dispatch gate's predicted
+        # hit rate read — the bench's stand-in for a prior run's
+        # observed traffic (docs/storage.md)
+        from dlrm_flexflow_tpu.telemetry import rowfreq
+        for r in pool:
+            for t in range(r["sparse"].shape[1]):
+                rowfreq.counter(f"sparse[{t}]").observe(r["sparse"][:, t])
+    engine = InferenceEngine(model, model.init(seed=0),
+                             quantize=quantize,  # warmup: AOT all
+                             storage=storage)
+    # anchor the mode that actually RAN: the dispatch gate may refuse
+    # tiering (no skew evidence, budget >= table) and fall back to
+    # resident — that run must share the resident anchor
+    storage = engine.storage.get("mode", storage)
     if replicas > 1:
         from dlrm_flexflow_tpu.serving import ReplicaRouter
 
@@ -907,11 +942,19 @@ def bench_serving():
     extra = {"dtype": dtype, "fused": cfg.fused_interaction,
              **{k: round(summary[k], 1) for k in
                 ("p50_us", "p95_us", "p99_us") if k in summary}}
+    if engine.storage.get("mode") == "tiered":
+        # provenance (excluded from matching): the live cache numbers
+        # behind the dlrm_embed_cache_* gauges this run exported
+        sst = engine.storage_stats()
+        extra.update(id_dist=id_dist,
+                     hot_rows=hot_rows,
+                     hit_pct=round(sst.get("hit_pct", 0.0), 2),
+                     miss_stall_us=round(sst.get("stall_us_last", 0.0), 1))
     _emit("dlrm_serving_qps", qps,
           {"app": "dlrm_serving", "metric": "dlrm_serving_qps",
            "rows": rows, "clients": clients, "req_rows": req_rows,
            "buckets": buckets, "quantize": quantize,
-           "replicas": replicas, "mesh": mesh_str},
+           "replicas": replicas, "mesh": mesh_str, "storage": storage},
           extra=extra, unit="requests/s")
     # second serving headline: engine-forward p99 at the LARGEST bucket
     # the run dispatched (per-bucket histograms, LatencyStats) — the
@@ -930,7 +973,7 @@ def bench_serving():
                    "rows": rows, "clients": clients, "req_rows": req_rows,
                    "buckets": buckets, "quantize": quantize,
                    "bucket": top_bucket, "replicas": replicas,
-                   "mesh": mesh_str},
+                   "mesh": mesh_str, "storage": storage},
                   extra={"dtype": dtype, "fused": cfg.fused_interaction},
                   unit="ms")
 

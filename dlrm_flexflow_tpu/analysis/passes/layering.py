@@ -45,6 +45,11 @@ LAYERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
                     "distributed", "analysis")),
     ("telemetry", ("telemetry",)),
     ("ops", ("ops",)),
+    # tiered embedding storage reads the ops cost gates
+    # (kernel_costs.tiered_storage_wins) and telemetry, and is itself
+    # consumed by serving/checkpoint — between ops and the runtime
+    # stack is the only rank that imports downward both ways
+    ("storage", ("storage",)),
     ("parallel", ("parallel",)),
     ("sim", ("sim", "profiling")),
     ("model", ("model",)),

@@ -194,6 +194,15 @@ class FFConfig:
     # scale (~4x smaller sweep, tolerance-pinned outputs); "bf16" stores
     # bf16 rows (~2x).  Training numerics are never touched.
     serve_quantize: str = "off"
+    # Tiered embedding storage (storage/, docs/storage.md): "resident"
+    # serves full device-resident tables; "tiered" caches only the
+    # hottest ``storage_hot_rows`` rows per table on device and streams
+    # misses from host RAM — the serve-tables-bigger-than-HBM mode.
+    # The kernel_costs.tiered_storage_wins gate may still refuse and
+    # fall back to resident (engine.storage records why); quantize and
+    # tiering are mutually exclusive.
+    serve_storage: str = "resident"
+    storage_hot_rows: int = 4096
     # Live-metrics endpoint (telemetry/exporter.py, docs/telemetry.md):
     # port for the process-wide Prometheus /metrics + /healthz HTTP
     # server, started once at compile().  0 (default) = off — scrapes
@@ -263,6 +272,10 @@ class FFConfig:
                 cfg.serve_timeout_us = float(nxt())
             elif a == "--serve-quantize":
                 cfg.serve_quantize = nxt()
+            elif a == "--serve-storage":
+                cfg.serve_storage = nxt()
+            elif a == "--storage-hot-rows":
+                cfg.storage_hot_rows = int(nxt())
             elif a == "--metrics-port":
                 cfg.metrics_port = int(nxt())
             elif a == "--prefetch":

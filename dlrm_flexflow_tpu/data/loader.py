@@ -110,26 +110,42 @@ class SyntheticDLRMLoader(ArrayDataLoader):
     Input names follow the DLRM app: "dense" (B, num_dense), "sparse"
     (B, T, bag) for the stacked-table path or "sparse_<i>" per table, and
     labels (B, 1) float.
+
+    ``id_dist`` picks the sparse-id law: ``"uniform"`` (default — every
+    row equally likely) or ``"zipf"`` (power-law skew via
+    :func:`zipf_ids`, exponent ``zipf_alpha``) — the knob the tiered
+    embedding storage benches turn, since a hot cache only pays off on
+    skewed traffic (docs/storage.md).
     """
 
     def __init__(self, num_samples: int, num_dense: int, table_sizes,
                  bag_size: int, batch_size: int, stacked: bool = True,
-                 seed: int = 0):
+                 seed: int = 0, id_dist: str = "uniform",
+                 zipf_alpha: float = 1.05):
+        if id_dist not in ("uniform", "zipf"):
+            raise ValueError(
+                f"id_dist must be 'uniform' or 'zipf', got {id_dist!r}")
         rng = np.random.default_rng(seed)
         dense = rng.standard_normal((num_samples, num_dense), dtype=np.float32)
+
+        def ids(rows):
+            if id_dist == "zipf":
+                return zipf_ids(rng, int(rows), (num_samples, bag_size),
+                                a=zipf_alpha)
+            return rng.integers(0, int(rows),
+                                size=(num_samples, bag_size),
+                                dtype=np.int64)
+
         inputs = {"dense": dense}
         if stacked:
             # per-column id ranges: column t draws from [0, rows_t) — the
             # same (B, T, bag) layout serves uniform (StackedEmbedding)
             # and ragged (RaggedStackedEmbedding) table sets
             inputs["sparse"] = np.stack(
-                [rng.integers(0, int(rows), size=(num_samples, bag_size),
-                              dtype=np.int64) for rows in table_sizes],
-                axis=1)
+                [ids(rows) for rows in table_sizes], axis=1)
         else:
             for i, rows in enumerate(table_sizes):
-                inputs[f"sparse_{i}"] = rng.integers(
-                    0, int(rows), size=(num_samples, bag_size), dtype=np.int64)
+                inputs[f"sparse_{i}"] = ids(rows)
         labels = rng.integers(0, 2, size=(num_samples, 1)).astype(np.float32)
         super().__init__(inputs, labels, batch_size)
 

@@ -74,6 +74,16 @@ ICI_GBPS = 45.0
 #: f32 peak 49 TFLOP/s at the machine model's 60% utilisation.
 MXU_F32_FLOPS_PER_NS = 49e3 * 0.6
 
+#: host<->device link bandwidth (GB/s) a tiered-storage miss stream
+#: pays — PCIe-class, ~40x below HBM; the asymmetry is exactly why a
+#: hot cache must absorb most lookups before tiering can win.
+HOST_LINK_GBPS = 16.0
+
+#: fixed latency to start a host->device copy burst (ns): one
+#: start-all-then-wait miss block pays it once regardless of row count
+#: (the same amortization the per-row DMA kernels rely on).
+HOST_LINK_LATENCY_NS = 2500.0
+
 
 def row_set_wins(parent_rows: int, dim: int, n: int,
                  itemsize: int) -> bool:
@@ -176,3 +186,48 @@ def exchange_overlap_wins(local_batch: int, num_tables: int, dim: int,
     hidden_ns = min(ex_ns, dense_ns)
     boundary_ns = 2.0 * (k - 1) * OP_BOUNDARY_NS
     return hidden_ns > DISPATCH_MARGIN * boundary_ns
+
+
+def tiered_storage_wins(num_rows: int, dim: int, itemsize: int,
+                        hot_rows: int, lookups: int,
+                        hit_rate: float) -> bool:
+    """Static dispatch gate for the tiered embedding store
+    (storage/tiered.py) vs streaming every looked-up row over the host
+    link — the fallback a table that doesn't fit device memory would
+    otherwise pay.
+
+    Tiered cost per dispatch: every lookup gathers from the hot buffer
+    (~9 ns/row, the same fused gather pipeline as a resident table),
+    plus ONE start-all-then-wait miss block for the predicted
+    ``(1 - hit_rate) * lookups`` misses — one link-latency hit, then
+    each missing row pays the link transfer and the ~64 ns/row set-
+    kernel write into the hot buffer.
+
+    Streaming cost: the same link latency, then EVERY lookup pays the
+    link transfer plus the gather.
+
+    Refusals by construction (pinned in scripts/check_storage.py):
+    a table that fits the budget (``hot_rows >= num_rows``) stays
+    resident — a cache over a resident table is pure overhead; a
+    budget smaller than one batch's worst-case working set
+    (``hot_rows < lookups``) cannot pin its own batch and would thrash;
+    and a uniform-traffic hit rate (no observed skew) loses to the 2x
+    ``DISPATCH_MARGIN`` — the cache only wins on skew there is
+    evidence for.  High-skew traffic (hit ~0.9 at the serve_bench
+    Zipf default) clears the margin; hit ~0.5 does not."""
+    if hot_rows >= num_rows:
+        return False  # fits on device: resident always wins
+    if lookups <= 0 or hot_rows <= 0:
+        return False
+    if hot_rows < lookups:
+        return False  # cannot pin one batch's worst-case working set
+    hit = min(max(float(hit_rate), 0.0), 1.0)
+    row_link_ns = float(dim) * itemsize / HOST_LINK_GBPS
+    misses = (1.0 - hit) * lookups
+    tiered_ns = lookups * GATHER_NS_PER_ROW
+    if misses > 0:
+        tiered_ns += HOST_LINK_LATENCY_NS \
+            + misses * (row_link_ns + SET_KERNEL_NS_PER_ROW)
+    stream_ns = HOST_LINK_LATENCY_NS \
+        + lookups * (row_link_ns + GATHER_NS_PER_ROW)
+    return tiered_ns * DISPATCH_MARGIN < stream_ns

@@ -99,13 +99,29 @@ class InferenceEngine:
     bit-exact; padding bit-identity within one quantized engine still
     holds (the forward stays row-independent).  Training state is
     never mutated — quantization copies the params tree.
+
+    ``storage`` ("resident" | "tiered", default
+    ``model.config.serve_storage``) selects tiered embedding storage
+    (storage/, docs/storage.md): only the hottest
+    ``model.config.storage_hot_rows`` rows per table stay device-
+    resident, cold rows live in host RAM and stream in on miss.
+    Outputs stay BIT-exact vs the resident engine — cached rows are
+    exact copies and the compiled forward is unchanged (only the ids
+    are remapped to hot slots per dispatch).  Per embedding op the
+    ``kernel_costs.tiered_storage_wins`` gate (predicted hit-rate ×
+    miss latency, FF_TIERED_STORAGE overrides) may refuse and keep
+    the op resident; ``self.storage`` records the mode that ran and
+    every fallback's reason.  Tiering composes with neither quantize
+    (mutually exclusive — raises) nor mesh-native serving (falls back
+    to resident, recorded).
     """
 
     def __init__(self, model, params_or_state=None,
                  buckets: Optional[Union[str, Sequence[int]]] = None,
                  aot: Optional[bool] = None, warmup: bool = True,
                  stats: Optional[LatencyStats] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 storage: Optional[str] = None):
         if getattr(model, "_forward_fn", None) is None:
             raise ValueError(
                 "model must be compile()d before building an "
@@ -184,6 +200,24 @@ class InferenceEngine:
                 # — pinned by the same scenario.
                 self.buckets = sorted({-(-b // dsize) * dsize
                                        for b in self.buckets})
+        # tiered embedding storage (storage/, docs/storage.md): built
+        # AFTER mesh placement (a mesh refuses tiering — recorded) and
+        # BEFORE warmup, so the bucket programs AOT-compile against the
+        # hot-buffer shapes.  Construction-time param swap only; per
+        # dispatch the hot leaves are re-captured read-only.
+        if storage is None:
+            storage = getattr(model.config, "serve_storage", "resident")
+        storage = (storage or "resident").strip().lower() or "resident"
+        self.storage = {"mode": "resident"}
+        self._tiered: Dict[str, Any] = {}  # input name -> (op, store)
+        if storage == "tiered":
+            if self.quantization.get("mode", "off") != "off":
+                raise ValueError(
+                    "serve_storage='tiered' cannot combine with "
+                    "serve_quantize: the hot tier caches the f32 "
+                    "training rows bit-exactly (quantizing the cold "
+                    "tier is a separate mode, not built yet)")
+            self._build_tiered()
         self._compiled: Dict[int, Any] = {}
         self._lock = threading.Lock()
         # live-metrics visibility: per-bucket dispatch counts ride
@@ -230,6 +264,119 @@ class InferenceEngine:
         state = restore_checkpoint(ckpt, model=model, inference_only=True,
                                    on_mesh_change=on_mesh_change)
         return cls(model, state, **kwargs)
+
+    # ------------------------------------------------------- tiered storage
+    def _build_tiered(self) -> None:
+        """Per embedding op: structural eligibility, then the
+        kernel_costs price (predicted hit-rate × miss latency via the
+        row-frequency counters), then build the store, warm-start its
+        LFU admission, and swap the op's ``embedding`` leaf for the
+        hot buffer so warmup AOT-compiles against the hot shapes.
+        Ineligible/refused ops stay resident with the reason recorded
+        in ``self.storage['fallbacks']``."""
+        from ..storage import (TieredEmbeddingTable, default_table_keys,
+                               predicted_hit_rate, tiered_decision)
+
+        cfg = self.model.config
+        hot_budget = int(getattr(cfg, "storage_hot_rows", 4096))
+        top = self.buckets[-1]
+        tables: Dict[str, Any] = {}
+        fallbacks: Dict[str, str] = {}
+        for op in self.model.layers:
+            kind = getattr(op, "op_type", "")
+            if kind not in ("Embedding", "StackedEmbedding",
+                            "RaggedStackedEmbedding"):
+                continue
+            if kind == "Embedding":
+                rows = [op.num_entries]
+            elif kind == "StackedEmbedding":
+                rows = [op.num_entries] * op.num_tables
+            else:
+                rows = list(op.row_counts)
+            # structural eligibility: tiering remaps ids against ONE
+            # plain per-table row space — packed storage views, live
+            # table exchange, host-placed tables, and mesh-sharded
+            # params each change what a row index means
+            reason = None
+            if self.model.mesh is not None:
+                reason = "mesh-native serving (sharded row space)"
+            elif getattr(op, "placement", "tpu") == "cpu":
+                reason = "host-placed table (already off-device)"
+            elif getattr(op, "storage_pack", 1) != 1:
+                reason = "lane-packed storage view"
+            elif getattr(op, "exchange_mode", None):
+                reason = "live table exchange"
+            if reason is None:
+                ishape = op.inputs[0].shape  # includes the batch dim
+                bag = ishape[-1] if len(ishape) >= (
+                    3 if kind != "Embedding" else 2) else 1
+                hot_per = [min(hot_budget, r) for r in rows]
+                if min(hot_per) < top * bag:
+                    reason = (f"hot tier ({min(hot_per)} slots) below "
+                              f"one bucket's worst-case working set "
+                              f"({top}x{bag} ids)")
+            if reason is None:
+                keys = default_table_keys(op.inputs[0].name, len(rows))
+                hit, observed = predicted_hit_rate(keys, rows, hot_per)
+                ok, reason = tiered_decision(
+                    num_rows=sum(rows), dim=op.out_dim,
+                    itemsize=np.dtype(
+                        self._params[op.name]["embedding"].dtype).itemsize,
+                    hot_rows=sum(hot_per), lookups=top * bag * len(rows),
+                    hit_rate=hit)
+                if ok:
+                    store = TieredEmbeddingTable(
+                        op.inputs[0].name,
+                        self._params[op.name]["embedding"], hot_budget,
+                        row_counts=(rows if kind ==
+                                    "RaggedStackedEmbedding" else None),
+                        table_keys=keys)
+                    warmed = store.warm_from_rowfreq()
+                    if not self._tiered:
+                        # _params aliases the caller's state.params
+                        # mapping — copy before swapping leaves so a
+                        # resident engine built from the same state
+                        # keeps its full tables
+                        self._params = dict(self._params)
+                    self._params[op.name] = {
+                        **self._params[op.name],
+                        "embedding": store.hot_param()}
+                    self._tiered[op.inputs[0].name] = (op.name, store)
+                    tables[op.name] = {
+                        "input": op.inputs[0].name, "kind": store.kind,
+                        "rows": store.total_rows,
+                        "hot_slots": store.hot_slots,
+                        "policy": store.policy_name,
+                        "predicted_hit": round(hit, 4),
+                        "observed_traffic": observed,
+                        "warm_admitted": warmed, "why": reason}
+                    continue
+            fallbacks[op.name] = reason
+        self.storage = {
+            "mode": "tiered" if tables else "resident",
+            "hot_rows": hot_budget, "tables": tables,
+            "fallbacks": fallbacks}
+
+    def storage_stats(self) -> Dict[str, Any]:
+        """Aggregate live tiered-store counters across this engine's
+        stores (empty when serving resident) — what the bench records
+        beside the dlrm_embed_cache_* gauges."""
+        stores = [s for _, s in self._tiered.values()]
+        if not stores:
+            return {}
+        stats = [s.stats() for s in stores]
+        lookups = sum(s["lookups"] for s in stats)
+        hits = sum(s["hits"] for s in stats)
+        return {
+            "lookups": lookups, "hits": hits,
+            "misses": sum(s["misses"] for s in stats),
+            "hit_pct": 100.0 * hits / max(1, lookups),
+            "evictions": sum(s["evictions"] for s in stats),
+            "writebacks": sum(s["writebacks"] for s in stats),
+            "stall_us_total": sum(s["stall_us_total"] for s in stats),
+            "stall_us_last": max(s["stall_us_last"] for s in stats),
+            "per_store": stats,
+        }
 
     # ------------------------------------------------------------ compilation
     def warmup(self) -> None:
@@ -393,12 +540,32 @@ class InferenceEngine:
         # event for attribution).
         b = self.bucket_for(m)
         fn = self._ensure(b)
+        params = self._params
+        if self._tiered:
+            # tiered storage: remap raw ids to hot slots (misses
+            # stream in) and capture the hot leaves ATOMICALLY with
+            # the slots — functional updates keep a captured buffer
+            # consistent even as concurrent dispatches keep evicting.
+            # Shapes/dtypes match what warmup compiled, so the AOT
+            # executables run unchanged on the swapped leaves.
+            chunk = dict(chunk)
+            hot_leaves = {}
+            with trace_span("serve.storage_remap",
+                            attrs={"batch": m, "bucket": b}):
+                for name, (opname, store) in self._tiered.items():
+                    ids, hot = store.remap_with_param(chunk[name])
+                    chunk[name] = ids.astype(chunk[name].dtype,
+                                             copy=False)
+                    hot_leaves[opname] = hot
+            params = {k: ({**v, "embedding": hot_leaves[k]}
+                          if k in hot_leaves else v)
+                      for k, v in self._params.items()}
         with trace_span("serve.pad", attrs={"batch": m, "bucket": b}):
             padded = {k: self._pad(v, m, b) for k, v in chunk.items()}
         t0 = time.perf_counter()
         with trace_span("serve.engine_forward",
                         attrs={"batch": m, "bucket": b}):
-            out = fn(self._params, padded, self._bn)
+            out = fn(params, padded, self._bn)
             # host materialization IS the fence: results leave as numpy
             out = jax.tree.map(lambda a: np.asarray(a)[:m], out)
         compute_us = (time.perf_counter() - t0) * 1e6

@@ -1,0 +1,28 @@
+"""Tiered embedding storage: serve and train tables bigger than
+device memory (docs/storage.md — ROADMAP item 4).
+
+Hot rows live in a device-resident cache, cold rows in host RAM;
+lookups remap id→slot on the host and the unchanged compiled forward
+gathers from the hot buffer, with misses streamed host→device in one
+start-all-then-wait block.  Admission/eviction is pluggable (LFU over
+row-frequency telemetry by default; clock/LRU alternates), the
+``kernel_costs.tiered_storage_wins`` gate prices predicted hit-rate ×
+miss latency before dispatch commits to tiering, and
+``save_tiered``/``load_tiered`` checkpoint the cold tier plus a
+manifest of which tier owns which rows.
+"""
+
+from .checkpoint import load_tiered, save_tiered
+from .policy import (ClockPolicy, EvictionPolicy, LFUPolicy, LRUPolicy,
+                     POLICY_NAMES, make_policy)
+from .tiered import (StorageError, TieredEmbeddingTable,
+                     default_table_keys, predicted_hit_rate,
+                     storage_override, tiered_decision)
+
+__all__ = [
+    "ClockPolicy", "EvictionPolicy", "LFUPolicy", "LRUPolicy",
+    "POLICY_NAMES", "StorageError", "TieredEmbeddingTable",
+    "default_table_keys", "load_tiered", "make_policy",
+    "predicted_hit_rate", "save_tiered", "storage_override",
+    "tiered_decision",
+]

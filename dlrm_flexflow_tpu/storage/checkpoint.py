@@ -1,0 +1,89 @@
+"""Checkpointing for tiered embedding tables (docs/storage.md).
+
+A tiered table checkpoints as TWO artifacts, the podshard idea applied
+to tiers instead of hosts — a manifest records which tier owns which
+rows, the payload holds the rows themselves:
+
+* ``cold.npz`` — the full table, host-tier ground truth, written
+  AFTER a dirty-row writeback so sparse training updates riding the
+  hot tier are never lost;
+* ``tiered_manifest.json`` — the device tier's ownership set: per
+  table, the hot-resident ids in retention order with their policy
+  seeds, plus the budget/policy/shape metadata needed to rebuild.
+
+Because the cold tier is complete, the manifest is advisory — a
+restore under a *different* hot budget (the elastic-reshard story)
+just re-admits the recorded hottest prefix that fits; growing the
+budget leaves the extra slots to be filled by live traffic.  A restore
+with ``hot_rows=0``-equivalent (budget 1) still serves correctly —
+everything is a miss until traffic warms it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from .tiered import StorageError, TieredEmbeddingTable
+
+MANIFEST_NAME = "tiered_manifest.json"
+COLD_NAME = "cold.npz"
+
+
+def save_tiered(path: str, store: TieredEmbeddingTable) -> str:
+    """Write ``store`` under directory ``path`` (created if needed):
+    writeback → cold.npz + tiered_manifest.json.  Returns the manifest
+    path."""
+    os.makedirs(path, exist_ok=True)
+    wrote_back = store.writeback()
+    manifest = {
+        "version": 1,
+        "name": store.name,
+        "kind": store.kind,
+        "dim": store.dim,
+        "policy": store.policy_name,
+        "hot_rows": store.hot_rows,
+        "row_counts": [t.rows for t in store.tiers],
+        "table_keys": [t.key for t in store.tiers],
+        "wrote_back": wrote_back,
+        "hot_ids": [[[int(i), int(c)] for i, c in pairs]
+                    for pairs in store.hot_manifest()],
+    }
+    np.savez(os.path.join(path, COLD_NAME), cold=store.cold_full())
+    mpath = os.path.join(path, MANIFEST_NAME)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def load_tiered(path: str, *, hot_rows: Optional[int] = None,
+                policy: Optional[str] = None) -> TieredEmbeddingTable:
+    """Rebuild a tiered table from :func:`save_tiered` output.
+    ``hot_rows`` / ``policy`` override the recorded budget and policy
+    (elastic reshard: a survivor with less HBM re-admits the recorded
+    hottest prefix that fits its new budget)."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise StorageError(f"no tiered manifest at {mpath}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("version") != 1:
+        raise StorageError(
+            f"unknown tiered manifest version {manifest.get('version')}")
+    with np.load(os.path.join(path, COLD_NAME)) as z:
+        cold = z["cold"]
+    kind = manifest["kind"]
+    store = TieredEmbeddingTable(
+        manifest["name"], cold,
+        int(hot_rows if hot_rows is not None else manifest["hot_rows"]),
+        row_counts=manifest["row_counts"] if kind == "ragged" else None,
+        policy=policy or manifest["policy"],
+        table_keys=manifest["table_keys"])
+    store.warm_start([[(int(i), int(c)) for i, c in pairs]
+                      for pairs in manifest.get("hot_ids", [])])
+    return store

@@ -1,0 +1,554 @@
+"""Two-tier embedding tables: hot rows in device memory, cold rows in
+host RAM (docs/storage.md — ROADMAP item 4).
+
+The "millions of users" tables dwarf HBM even after hashing, but DLRM
+id traffic is power-law: a small hot head absorbs almost every lookup.
+:class:`TieredEmbeddingTable` keeps that head resident on device and
+streams the misses in:
+
+* **hot tier** — one flat ``(H_total, dim)`` device buffer holding up
+  to ``hot_rows`` rows per table, contiguous per-table regions at
+  ``hot_off[t]``.  Lookups are remapped id→slot on the host and the
+  compiled forward gathers from the hot buffer exactly as it would
+  from a resident table — same jnp ops, same bits.
+* **cold tier** — the full table in host RAM (numpy), ground truth
+  for every row.  Misses are admitted by copying cold rows up; dirty
+  rows (sparse training updates) are written back on eviction.
+
+Miss streaming follows the fused-interact kernels' start-all-then-wait
+DMA discipline (ops/pallas_embedding.py): ONE ``jax.device_put`` of
+the packed miss block starts the host→device copy for every missing
+row at once, the functional ``hot.at[slots].set(block)`` chains on it,
+and the single ``block_until_ready`` at the end is the only wait —
+measured and exported as ``dlrm_embed_cache_miss_stall_us``.  The
+wait happens *outside* the store lock (lock-discipline: no blocking
+under a lock); the swap of the hot-buffer reference happens inside
+it, and because jnp updates are functional, a reference captured by
+:meth:`remap_with_param` stays internally consistent even while other
+threads keep admitting and evicting.
+
+Admission/eviction policy is pluggable (storage/policy.py): LFU over
+the PR-16 :mod:`~..telemetry.rowfreq` counts by default (warm-started
+through :func:`~..telemetry.rowfreq.hot_rows`), clock/LRU alternates.
+Whether tiering is worth it at all is priced by
+:func:`~..ops.kernel_costs.tiered_storage_wins` — predicted hit-rate
+times miss latency against streaming every row — surfaced here as
+:func:`tiered_decision` with the ``FF_TIERED_STORAGE`` override
+(``auto`` | ``on`` | ``off``) for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..telemetry import emit
+from ..telemetry import metrics as _metrics
+from ..telemetry import rowfreq
+from .policy import EvictionPolicy, make_policy
+
+
+class StorageError(RuntimeError):
+    """A tiered-storage invariant was violated (id out of range, or a
+    single batch's working set exceeds the hot tier)."""
+
+
+def storage_override() -> str:
+    """``FF_TIERED_STORAGE`` = ``auto`` (cost gate decides, default),
+    ``on`` (skip the gate; structural checks still apply), ``off``
+    (always fully-resident)."""
+    v = os.environ.get("FF_TIERED_STORAGE", "auto").strip().lower()
+    return v if v in ("auto", "on", "off") else "auto"
+
+
+def default_table_keys(name: str, tables: int) -> List[str]:
+    """RowFreqCounter keys for the sparse input ``name`` — mirrors
+    rowfreq._tables: per-table ``name[t]`` streams when the input
+    carries a table axis, the bare input name otherwise."""
+    if tables > 1:
+        return [f"{name}[{t}]" for t in range(tables)]
+    return [name]
+
+
+def predicted_hit_rate(table_keys: Sequence[str],
+                       rows_per_table: Sequence[int],
+                       hot_per_table: Sequence[int]
+                       ) -> Tuple[float, bool]:
+    """(predicted hit rate, any-observed-traffic) for the dispatch
+    gate: per table, the head mass the RowFreqCounter saw land in the
+    hottest ``h`` ids over everything it observed; without observed
+    traffic, the uniform floor ``h/rows`` (which the gate will refuse
+    — a cache only wins on skew it has evidence for)."""
+    rates: List[float] = []
+    observed = False
+    for key, rows, h in zip(table_keys, rows_per_table, hot_per_table):
+        head, seen = rowfreq.head_mass(key, h)
+        if seen > 0:
+            rates.append(head / seen)
+            observed = True
+        else:
+            rates.append(min(1.0, h / max(1, rows)))
+    if not rates:
+        return 0.0, False
+    return sum(rates) / len(rates), observed
+
+
+def tiered_decision(*, num_rows: int, dim: int, itemsize: int,
+                    hot_rows: int, lookups: int,
+                    hit_rate: float) -> Tuple[bool, str]:
+    """Should this table serve tiered?  Applies the FF_TIERED_STORAGE
+    override, the fits-in-budget short circuit, and the
+    kernel_costs.tiered_storage_wins price."""
+    mode = storage_override()
+    if mode == "off":
+        return False, "disabled by FF_TIERED_STORAGE=off"
+    if hot_rows >= num_rows:
+        return False, "table fits the hot budget — staying resident"
+    if mode == "on":
+        return True, "forced by FF_TIERED_STORAGE=on"
+    from ..ops.kernel_costs import tiered_storage_wins
+    if tiered_storage_wins(num_rows=num_rows, dim=dim,
+                           itemsize=itemsize, hot_rows=hot_rows,
+                           lookups=lookups, hit_rate=hit_rate):
+        return True, (f"cost gate: predicted hit rate {hit_rate:.2f} "
+                      "beats streaming every row")
+    return False, (f"cost gate: predicted hit rate {hit_rate:.2f} "
+                   "loses — staying resident")
+
+
+class _Tier:
+    """One table's slot bookkeeping inside the shared hot buffer."""
+
+    __slots__ = ("rows", "base", "hot_off", "slots", "slot_of",
+                 "id_at", "free", "policy", "key")
+
+    def __init__(self, rows: int, base: int, hot_off: int, slots: int,
+                 policy: EvictionPolicy, key: str):
+        self.rows = rows          # cold rows this table owns
+        self.base = base          # this table's first cold flat row
+        self.hot_off = hot_off    # this table's first global hot slot
+        self.slots = slots        # hot slots budgeted to this table
+        self.slot_of: Dict[int, int] = {}   # id -> local slot
+        self.id_at = np.full(slots, -1, dtype=np.int64)
+        self.free = list(range(slots - 1, -1, -1))  # pop() -> 0,1,2…
+        self.policy = policy
+        self.key = key            # RowFreqCounter name
+
+
+class TieredEmbeddingTable:
+    """Hot-cache-over-host-RAM view of one embedding parameter.
+
+    ``cold`` is the full table: ``(rows, dim)`` (one table),
+    ``(tables, rows, dim)`` (stacked), or flat ``(total_rows, dim)``
+    with ``row_counts`` (ragged).  ``hot_rows`` is the per-table
+    device budget; each table gets ``min(hot_rows, rows_t)`` slots in
+    the shared flat hot buffer.
+
+    :meth:`remap_with_param` is the serving surface: it takes raw ids
+    shaped like the op input, makes every touched row resident, and
+    returns (remapped ids, hot parameter) such that the *unchanged*
+    compiled forward — StackedEmbedding's vmap ``jnp.take``, the
+    ragged ``flat_ids`` add — reads exactly the rows the raw ids name.
+    :meth:`gather_rows` / :meth:`scatter_apply` are the ``rows__``
+    -style sparse training surface; dirty rows ride the hot tier until
+    eviction or :meth:`writeback` pushes them down to cold.
+    """
+
+    def __init__(self, name: str, cold, hot_rows: int, *,
+                 row_counts: Optional[Sequence[int]] = None,
+                 policy: str = "lfu",
+                 table_keys: Optional[Sequence[str]] = None):
+        self.name = str(name)
+        self.policy_name = (policy or "lfu").strip().lower() or "lfu"
+        arr = np.array(cold)  # own host copy = the cold tier
+        if arr.ndim == 3:
+            self.kind = "stacked"
+            tables, rows, dim = arr.shape
+            counts = [rows] * tables
+            arr = arr.reshape(tables * rows, dim)
+        elif arr.ndim == 2 and row_counts is not None:
+            self.kind = "ragged"
+            counts = [int(r) for r in row_counts]
+            # RaggedStackedEmbedding pads the flat row space up to a
+            # lane-pack alignment; pad rows beyond the per-table counts
+            # are unreachable and simply never get hot
+            if sum(counts) > arr.shape[0]:
+                raise StorageError(
+                    f"row_counts sum {sum(counts)} > rows {arr.shape[0]}")
+        elif arr.ndim == 2:
+            self.kind = "single"
+            counts = [arr.shape[0]]
+        else:
+            raise StorageError(f"cold table must be 2-D or 3-D, "
+                               f"got shape {arr.shape}")
+        self.cold = arr
+        self.dim = int(arr.shape[1])
+        self.tables = len(counts)
+        self.hot_rows = int(hot_rows)
+        if self.hot_rows < 1:
+            raise StorageError("hot_rows must be >= 1")
+        keys = list(table_keys) if table_keys is not None \
+            else default_table_keys(self.name, self.tables)
+        if len(keys) != self.tables:
+            raise StorageError(f"{len(keys)} table_keys for "
+                               f"{self.tables} tables")
+        self.tiers: List[_Tier] = []
+        base = hot_off = 0
+        for t, rows in enumerate(counts):
+            slots = min(self.hot_rows, rows)
+            self.tiers.append(_Tier(rows, base, hot_off, slots,
+                                    make_policy(self.policy_name, slots),
+                                    keys[t]))
+            base += rows
+            hot_off += slots
+        self.total_rows = base
+        self.hot_slots = hot_off
+        self._hot = jnp.zeros((self.hot_slots, self.dim),
+                              dtype=arr.dtype)
+        self._dirty: set = set()   # global hot slots with unsynced rows
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._lookups = 0
+        self._evictions = 0
+        self._writebacks = 0
+        self._admitted = 0
+        self._stall_us_total = 0.0
+        self._stall_us_last = 0.0
+
+    # ------------------------------------------------------ internals
+
+    def _writeback_locked(self, gslots: Sequence[int]) -> int:
+        """Push the given DIRTY global slots' rows down to cold (caller
+        holds the lock and has checked membership in self._dirty)."""
+        if not gslots:
+            return 0
+        gs = np.asarray(sorted(gslots), dtype=np.int64)
+        src = np.empty(gs.size, dtype=np.int64)
+        bounds = np.asarray([t.hot_off for t in self.tiers], np.int64)
+        which = np.searchsorted(bounds, gs, side="right") - 1
+        for i, (g, t) in enumerate(zip(gs.tolist(), which.tolist())):
+            tier = self.tiers[t]
+            src[i] = tier.base + int(tier.id_at[g - tier.hot_off])
+        rows = np.asarray(jnp.take(self._hot, jnp.asarray(gs), axis=0))
+        self.cold[src] = rows
+        for g in gs.tolist():
+            self._dirty.discard(g)
+        self._writebacks += gs.size
+        return int(gs.size)
+
+    def _remap_locked(self, a: np.ndarray) -> Tuple[np.ndarray,
+                                                    np.ndarray, Any, dict]:
+        """Make every id in ``a`` resident; return (op-adjusted ids,
+        global hot slots, hot buffer ref, info).  The miss H2D copy is
+        *started* here; the caller waits outside the lock."""
+        out = np.empty(a.shape, dtype=np.int64)
+        gout = np.empty(a.shape, dtype=np.int64)
+        miss_g: List[int] = []
+        miss_src: List[int] = []
+        hits = misses = evicted = admitted = 0
+        for t in range(self.tables):
+            tier = self.tiers[t]
+            col = a[:, t] if self.tables > 1 else a
+            flat = col.reshape(-1)
+            if flat.size == 0:
+                continue
+            uniq, ucnt = np.unique(flat, return_counts=True)
+            if int(uniq[0]) < 0 or int(uniq[-1]) >= tier.rows:
+                raise StorageError(
+                    f"{self.name}[{t}]: id out of range "
+                    f"[{int(uniq[0])}, {int(uniq[-1])}] for "
+                    f"{tier.rows} rows")
+            if uniq.size > tier.slots:
+                raise StorageError(
+                    f"{self.name}[{t}]: batch working set {uniq.size} "
+                    f"exceeds hot tier ({tier.slots} slots) — raise "
+                    "storage_hot_rows or shrink the batch")
+            slot_of = tier.slot_of
+            resident = np.fromiter((i in slot_of for i in uniq.tolist()),
+                                   dtype=bool, count=uniq.size)
+            hits += int(ucnt[resident].sum())
+            misses += int(ucnt[~resident].sum())
+            pinned = {slot_of[i] for i in uniq[resident].tolist()}
+            miss_ids = uniq[~resident].tolist()
+            miss_cnt = ucnt[~resident].tolist()
+            need = len(miss_ids)
+            nvict = need - len(tier.free)
+            if nvict > 0:
+                # free slots are not victims (nothing to displace) —
+                # the policy must only rank OCCUPIED, unpinned slots
+                vics = tier.policy.victims(nvict,
+                                           pinned | set(tier.free))
+                if len(vics) < nvict:
+                    raise StorageError(
+                        f"{self.name}[{t}]: eviction starved "
+                        f"({len(vics)}/{nvict} victims)")
+                wb = [tier.hot_off + v for v in vics
+                      if (tier.hot_off + v) in self._dirty]
+                self._writeback_locked(wb)
+                for v in vics:
+                    old = int(tier.id_at[v])
+                    del slot_of[old]
+                    tier.id_at[v] = -1
+                    tier.free.append(v)
+                evicted += nvict
+            for mid, mcnt in zip(miss_ids, miss_cnt):
+                s = tier.free.pop()
+                slot_of[mid] = s
+                tier.id_at[s] = mid
+                tier.policy.fill(s, seed=int(mcnt))
+                pinned.add(s)
+                miss_g.append(tier.hot_off + s)
+                miss_src.append(tier.base + mid)
+            admitted += need
+            for i in uniq[resident].tolist():
+                tier.policy.touch(slot_of[i])
+            gmap = np.fromiter(
+                (tier.hot_off + slot_of[i] for i in uniq.tolist()),
+                dtype=np.int64, count=uniq.size)
+            gcol = gmap[np.searchsorted(uniq, flat)].reshape(col.shape)
+            if self.kind == "ragged":
+                ocol = gcol - tier.base
+            elif self.kind == "stacked":
+                ocol = gcol - tier.hot_off
+            else:
+                ocol = gcol
+            if self.tables > 1:
+                out[:, t] = ocol
+                gout[:, t] = gcol
+            else:
+                out[...] = ocol
+                gout[...] = gcol
+        t0 = time.perf_counter()
+        if miss_g:
+            # start-all-then-wait: one packed device_put starts the
+            # host->device copy for every missing row, the functional
+            # .at[].set chains on it; the caller's single
+            # block_until_ready (outside the lock) is the only wait
+            block = jax.device_put(self.cold[np.asarray(miss_src)])
+            self._hot = self._hot.at[jnp.asarray(
+                np.asarray(miss_g, dtype=np.int64))].set(block)
+        self._hits += hits
+        self._misses += misses
+        self._lookups += hits + misses
+        self._evictions += evicted
+        self._admitted += admitted
+        info = {"hits": hits, "misses": misses, "evicted": evicted,
+                "admitted": admitted, "t0": t0,
+                "hit_pct": 100.0 * self._hits / max(1, self._lookups)}
+        return out, gout, self._hot, info
+
+    def _note(self, hot, info: dict) -> None:
+        """Post-remap accounting OUTSIDE the lock: the one blocking
+        wait (miss stall), gauge sets, and storage events — emits and
+        blocking calls must not happen under the store lock."""
+        stall_us = 0.0
+        if info["misses"]:
+            hot.block_until_ready()
+            stall_us = (time.perf_counter() - info["t0"]) * 1e6
+            with self._lock:
+                self._stall_us_total += stall_us
+                self._stall_us_last = stall_us
+            _metrics.EMBED_CACHE_MISS_STALL_US.set(stall_us)
+        _metrics.EMBED_CACHE_HIT_PCT.set(info["hit_pct"])
+        if info["misses"]:
+            emit("storage", phase="miss", table=self.name,
+                 misses=info["misses"], stall_us=stall_us,
+                 hits=info["hits"], hit_pct=info["hit_pct"],
+                 admitted=info["admitted"])
+        if info["evicted"]:
+            emit("storage", phase="evict", table=self.name,
+                 evicted=info["evicted"], policy=self.policy_name)
+
+    # ------------------------------------------------- serving surface
+
+    def remap(self, ids) -> np.ndarray:
+        """Remapped ids (same shape, int64) for the compiled forward,
+        after making every touched row hot-resident."""
+        return self.remap_with_param(ids)[0]
+
+    def remap_with_param(self, ids) -> Tuple[np.ndarray, Any]:
+        """(remapped ids, hot parameter) captured atomically: the
+        returned device array is the exact buffer the returned slots
+        index, immune to other threads' later evictions (functional
+        updates never mutate a captured reference)."""
+        a = np.asarray(ids)
+        if self.tables > 1 and (a.ndim < 2 or a.shape[1] != self.tables):
+            raise StorageError(
+                f"{self.name}: expected a table axis of {self.tables} "
+                f"at dim 1, got shape {a.shape}")
+        with self._lock:
+            out, _, hot, info = self._remap_locked(a)
+        self._note(hot, info)
+        return out, self._shape_param(hot)
+
+    def _shape_param(self, hot) -> Any:
+        if self.kind == "stacked":
+            return hot.reshape(self.tables, self.tiers[0].slots,
+                               self.dim)
+        return hot
+
+    def hot_param(self) -> Any:
+        """The current hot buffer, shaped like the op's ``embedding``
+        parameter (no residency changes)."""
+        with self._lock:
+            hot = self._hot
+        return self._shape_param(hot)
+
+    # ------------------------------------------------ training surface
+
+    def gather_rows(self, ids) -> Any:
+        """Embedding rows for ``ids`` (shape ``ids.shape + (dim,)``)
+        through the hot tier — the sparse-training read path."""
+        a = np.asarray(ids)
+        with self._lock:
+            _, gout, hot, info = self._remap_locked(a)
+        self._note(hot, info)
+        flat = jnp.take(hot, jnp.asarray(gout.reshape(-1)), axis=0)
+        return flat.reshape(a.shape + (self.dim,))
+
+    def scatter_apply(self, ids, row_grads, scale=1.0) -> None:
+        """Apply ``rows__``-style sparse updates: row ``ids[...]`` gets
+        ``scale * row_grads[...]`` added (duplicate ids accumulate, as
+        scatter-add training semantics require).  Updated rows stay in
+        the hot tier, marked dirty; eviction / :meth:`writeback` pushes
+        them down to cold."""
+        a = np.asarray(ids)
+        g = jnp.asarray(row_grads).reshape(-1, self.dim)
+        with self._lock:
+            _, gout, _, info = self._remap_locked(a)
+            flat = gout.reshape(-1)
+            self._hot = self._hot.at[jnp.asarray(flat)].add(
+                jnp.asarray(scale, dtype=self._hot.dtype) * g)
+            hot = self._hot
+            self._dirty.update(int(x) for x in np.unique(flat))
+        self._note(hot, info)
+
+    def writeback(self) -> int:
+        """Flush every dirty hot row down to cold; returns the number
+        of rows written back."""
+        with self._lock:
+            n = self._writeback_locked(list(self._dirty))
+        return n
+
+    def cold_full(self):
+        """The full table (writeback first), shaped like the original
+        parameter — the bit-exactness / checkpoint ground truth."""
+        self.writeback()
+        with self._lock:
+            arr = self.cold.copy()
+        if self.kind == "stacked":
+            return arr.reshape(self.tables, self.tiers[0].rows,
+                               self.dim)
+        return arr
+
+    # ----------------------------------------------- admission warmup
+
+    def warm_start(self, per_table: Sequence[Sequence[Tuple[int, int]]]
+                   ) -> int:
+        """Admit known-hot ids before traffic: ``per_table[t]`` is
+        (id, count) pairs, hottest first (the
+        :func:`~..telemetry.rowfreq.hot_rows` snapshot shape); counts
+        seed the LFU ranking.  Returns rows admitted."""
+        miss_g: List[int] = []
+        miss_src: List[int] = []
+        with self._lock:
+            for t, pairs in enumerate(per_table):
+                if t >= self.tables:
+                    break
+                tier = self.tiers[t]
+                for rid, cnt in pairs:
+                    rid = int(rid)
+                    if not tier.free:
+                        break
+                    if not (0 <= rid < tier.rows) or rid in tier.slot_of:
+                        continue
+                    s = tier.free.pop()
+                    tier.slot_of[rid] = s
+                    tier.id_at[s] = rid
+                    tier.policy.fill(s, seed=int(cnt))
+                    miss_g.append(tier.hot_off + s)
+                    miss_src.append(tier.base + rid)
+            if miss_g:
+                block = jax.device_put(self.cold[np.asarray(miss_src)])
+                self._hot = self._hot.at[jnp.asarray(
+                    np.asarray(miss_g, dtype=np.int64))].set(block)
+            hot = self._hot
+            self._admitted += len(miss_g)
+        hot.block_until_ready()
+        if miss_g:
+            emit("storage", phase="admit", table=self.name,
+                 admitted=len(miss_g), policy=self.policy_name,
+                 rows=self.total_rows, slots=self.hot_slots)
+        return len(miss_g)
+
+    def warm_from_rowfreq(self) -> int:
+        """Warm-start from the process RowFreqCounters under this
+        store's table keys (the LFU admission default)."""
+        return self.warm_start([rowfreq.hot_rows(t.key, t.slots)
+                                for t in self.tiers])
+
+    # ------------------------------------------------------- inspection
+
+    def resident_ids(self, table: int = 0) -> List[int]:
+        """Sorted ids currently hot-resident for ``table``."""
+        with self._lock:
+            return sorted(self.tiers[table].slot_of)
+
+    def hot_manifest(self) -> List[List[Tuple[int, int]]]:
+        """Per-table [(id, seed), ...] of hot-resident rows, most
+        retainable first — what the checkpoint manifest records as the
+        device tier's ownership, and what :meth:`warm_start` accepts
+        back.  Seeds carry the policy's ranking signal (LFU counts /
+        LRU recency rank) so a reload under a SMALLER budget re-admits
+        the hottest prefix."""
+        out: List[List[Tuple[int, int]]] = []
+        with self._lock:
+            for tier in self.tiers:
+                pairs = list(tier.slot_of.items())  # (id, slot)
+                score = getattr(tier.policy, "_count", None)
+                if score is None:
+                    score = getattr(tier.policy, "_stamp", None)
+                if score is not None:
+                    pairs.sort(key=lambda p: (-score[p[1]], p[0]))
+                    out.append([(int(i), max(1, int(score[s])))
+                                for i, s in pairs])
+                else:  # clock keeps no ranking — retention rank only
+                    pairs.sort(key=lambda p: p[0])
+                    n = len(pairs)
+                    out.append([(int(i), n - r)
+                                for r, (i, _) in enumerate(pairs)])
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lk = self._lookups
+            return {
+                "table": self.name, "kind": self.kind,
+                "tables": self.tables, "rows": self.total_rows,
+                "hot_slots": self.hot_slots, "dim": self.dim,
+                "policy": self.policy_name, "lookups": lk,
+                "hits": self._hits, "misses": self._misses,
+                "hit_pct": 100.0 * self._hits / max(1, lk),
+                "evictions": self._evictions,
+                "admitted": self._admitted,
+                "writebacks": self._writebacks,
+                "dirty": len(self._dirty),
+                "stall_us_total": self._stall_us_total,
+                "stall_us_last": self._stall_us_last,
+            }
+
+    def describe(self) -> str:
+        s = self.stats()
+        return (f"{s['table']}: {s['kind']} {s['rows']}x{s['dim']} "
+                f"({s['tables']} tables), hot {s['hot_slots']} slots, "
+                f"policy {s['policy']}, hit {s['hit_pct']:.1f}% "
+                f"({s['hits']}/{s['lookups']}), "
+                f"{s['evictions']} evictions")

@@ -27,6 +27,9 @@ typed, schema-checked events from every layer of the framework:
                   next to the cost model's prediction (the fit loops)
   * ``row_freq`` — per-table embedding row-access frequency summaries
                   (telemetry/rowfreq.py — LFU admission input)
+  * ``storage`` — tiered embedding store admissions, evictions, and
+                  miss-stream stalls (storage/tiered.py,
+                  docs/storage.md)
 
 Multi-host runs write one ``telemetry_pNNN.jsonl`` sink per process,
 stamped with ``pidx``/``slice`` (``fleet_event_log``); ``report`` on
@@ -55,7 +58,7 @@ from .fleet import (dump_flight_record, find_flight_records,
                     load_fleet_events, load_flight_record,
                     process_sink_path)
 from .jax_hooks import compile_stats, install_compile_hooks
-from .rowfreq import RowFreqCounter
+from .rowfreq import RowFreqCounter, hot_rows
 from .schema import SCHEMA, SCHEMA_VERSION, validate_event
 from .trace import (NULL_SPAN, Span, current_span, open_span_records,
                     record_span, span, start_span)
@@ -69,4 +72,5 @@ __all__ = [
     "dump_flight_record", "find_flight_records", "fleet_data",
     "fleet_event_log", "fleet_stamp", "load_fleet_events",
     "load_flight_record", "process_sink_path", "RowFreqCounter",
+    "hot_rows",
 ]

@@ -125,6 +125,14 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
                    "ReplicaRouter health probe (dead dispatcher "
                    "thread or tripped consecutive-engine-failure "
                    "circuit breaker — docs/serving.md)"),
+    "dlrm_embed_cache_hit_pct": (
+        "gauge", "tiered embedding store cumulative hit rate: percent "
+                 "of lookups served from the device-resident hot tier "
+                 "(storage/tiered.py — docs/storage.md)"),
+    "dlrm_embed_cache_miss_stall_us": (
+        "gauge", "wall microseconds the most recent tiered-store miss "
+                 "block stalled streaming cold rows host->device "
+                 "(start-all-then-wait — docs/storage.md)"),
 }
 
 
@@ -752,3 +760,10 @@ HOST_HEARTBEAT_AGE = REGISTRY.register(
     Gauge("dlrm_host_heartbeat_age_s"))
 REPLICA_EJECTED = REGISTRY.register(
     Counter("dlrm_serve_replica_ejected_total"))
+# tiered embedding storage (storage/tiered.py): the store sets both
+# after every remap outside its lock — hit-pct is cumulative over the
+# store's lifetime, miss-stall is the latest miss block's wait.
+EMBED_CACHE_HIT_PCT = REGISTRY.register(
+    Gauge("dlrm_embed_cache_hit_pct"))
+EMBED_CACHE_MISS_STALL_US = REGISTRY.register(
+    Gauge("dlrm_embed_cache_miss_stall_us"))

@@ -89,6 +89,14 @@ def _history_metrics(entries: List[dict]) -> Dict[str, float]:
         ov = h.get("overlap")
         if ov and ov != "off":
             name = f"{name}:overlap={ov}"
+        # tiered-storage entries anchor separately as well (bench.py
+        # keys "storage" the same way): a hot-cache run pays miss
+        # stalls by design, so it must never gate the fully-resident
+        # baseline — nor inherit its anchor (entries predating the
+        # field count as resident)
+        st = h.get("storage")
+        if st and st != "resident":
+            name = f"{name}:storage={st}"
         # per-bucket latency headlines likewise: the largest dispatched
         # bucket is load-dependent, and a bucket-8 p99 must never
         # anchor a bucket-64 run (bench.py keys the entry the same way)

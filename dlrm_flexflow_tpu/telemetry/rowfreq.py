@@ -31,7 +31,13 @@ from .events import EventLog, active_log
 
 
 class RowFreqCounter:
-    """Bounded id-frequency counter for one embedding table."""
+    """Bounded id-frequency counter for one embedding table.
+
+    Counter state is guarded by a per-instance lock: the training
+    thread writes through :meth:`observe` while the serving engine's
+    admission path (and the /metrics scrape thread via :meth:`emit`)
+    reads snapshots through :meth:`top` / :meth:`head_mass` — the
+    public admission API ROADMAP item 4's LFU policy consumes."""
 
     def __init__(self, table: str, capacity: int = 65536):
         self.table = str(table)
@@ -40,6 +46,7 @@ class RowFreqCounter:
         self.rows_seen = 0
         self.sampled_batches = 0
         self.evicted = 0
+        self._lock = threading.Lock()
 
     def observe(self, ids) -> None:
         """Count one batch of ids (any shape — flattened).  Cost is one
@@ -49,31 +56,47 @@ class RowFreqCounter:
         if arr.size == 0:
             return
         uniq, cnt = np.unique(arr, return_counts=True)
-        self.rows_seen += int(arr.size)
-        self.sampled_batches += 1
-        counts = self.counts
-        for i, n in zip(uniq.tolist(), cnt.tolist()):
-            counts[i] = counts.get(i, 0) + n
-        if len(counts) > 2 * self.capacity:
-            self._prune()
+        with self._lock:
+            self.rows_seen += int(arr.size)
+            self.sampled_batches += 1
+            counts = self.counts
+            for i, n in zip(uniq.tolist(), cnt.tolist()):
+                counts[i] = counts.get(i, 0) + n
+            if len(counts) > 2 * self.capacity:
+                self._prune()
 
     def _prune(self) -> None:
-        # keep the hottest ``capacity`` ids: on a power-law stream the
-        # dropped tail is ids seen a handful of times, so the head
-        # ranking (what LFU admission reads) survives eviction intact
+        # caller holds the lock.  Keep the hottest ``capacity`` ids: on
+        # a power-law stream the dropped tail is ids seen a handful of
+        # times, so the head ranking (what LFU admission reads)
+        # survives eviction intact
         keep = heapq.nlargest(self.capacity, self.counts.items(),
                               key=lambda kv: (kv[1], -kv[0]))
         self.evicted += len(self.counts) - len(keep)
         self.counts = dict(keep)
 
-    def top(self, k: int = 16) -> List[tuple]:
-        """The k hottest (id, count) pairs, hottest first (count desc,
-        then id asc for a deterministic order)."""
+    def _top(self, k: int) -> List[tuple]:
+        # caller holds the lock
         return heapq.nsmallest(k, self.counts.items(),
                                key=lambda kv: (-kv[1], kv[0]))
 
-    def bucket_counts(self) -> List[int]:
-        """``out[b]`` = distinct ids with count in [2^b, 2^(b+1))."""
+    def top(self, k: int = 16) -> List[tuple]:
+        """The k hottest (id, count) pairs, hottest first (count desc,
+        then id asc for a deterministic order)."""
+        with self._lock:
+            return self._top(k)
+
+    def head_mass(self, k: int) -> tuple:
+        """(accesses landing in the k hottest ids, total accesses
+        observed) — one consistent snapshot; the ratio is the hit rate
+        a k-slot LFU cache would have had on the observed stream, which
+        is what the tiered-storage dispatch gate prices."""
+        with self._lock:
+            head = sum(c for _, c in self._top(k))
+            return head, self.rows_seen
+
+    def _buckets(self) -> List[int]:
+        # caller holds the lock
         if not self.counts:
             return []
         out: List[int] = []
@@ -84,24 +107,33 @@ class RowFreqCounter:
             out[b] += 1
         return out
 
+    def bucket_counts(self) -> List[int]:
+        """``out[b]`` = distinct ids with count in [2^b, 2^(b+1))."""
+        with self._lock:
+            return self._buckets()
+
     def emit(self, log: Optional[EventLog] = None,
              top_k: int = 16) -> Optional[dict]:
         """Emit this table's ``row_freq`` summary event (no-op when
         telemetry is off or nothing was observed)."""
         log = log if log is not None else active_log()
-        if log is None or not self.rows_seen:
+        if log is None:
             return None
-        pairs = self.top(top_k)
-        return log.emit(
-            "row_freq", table=self.table, rows_seen=self.rows_seen,
-            unique_ids=len(self.counts),
-            top_ids=[int(i) for i, _ in pairs],
-            top_counts=[int(c) for _, c in pairs],
-            bucket_counts=self.bucket_counts(),
-            sampled_batches=self.sampled_batches,
-            sample_every=_sample_every(),
-            capacity=self.capacity,
-            evicted=(self.evicted or None))
+        with self._lock:  # snapshot only — the emit happens unlocked
+            if not self.rows_seen:
+                return None
+            pairs = self._top(top_k)
+            payload = dict(
+                table=self.table, rows_seen=self.rows_seen,
+                unique_ids=len(self.counts),
+                top_ids=[int(i) for i, _ in pairs],
+                top_counts=[int(c) for _, c in pairs],
+                bucket_counts=self._buckets(),
+                sampled_batches=self.sampled_batches,
+                sample_every=_sample_every(),
+                capacity=self.capacity,
+                evicted=(self.evicted or None))
+        return log.emit("row_freq", **payload)
 
 
 # ------------------------------------------------------- process registry
@@ -136,6 +168,31 @@ def reset() -> None:
     with _lock:
         _counters.clear()
         _batch_no = 0
+
+
+def get(table: str) -> Optional[RowFreqCounter]:
+    """The existing counter for ``table``, or None — unlike
+    :func:`counter` this never creates one (admission probes must not
+    fabricate empty counters for tables nothing observed)."""
+    return _counters.get(table)
+
+
+def hot_rows(table: str, k: int) -> List[tuple]:
+    """Public admission API (ROADMAP item 4): the k hottest (id,
+    count) pairs observed for ``table``, hottest first — what the
+    tiered store's LFU warm start admits.  Empty when the table was
+    never observed; the read path is one lock-guarded snapshot of the
+    counter (ffcheck shared-state audited)."""
+    c = get(table)
+    return c.top(k) if c is not None else []
+
+
+def head_mass(table: str, k: int) -> tuple:
+    """(accesses in ``table``'s k hottest ids, total observed) —
+    (0, 0) when never observed.  head/total predicts a k-slot cache's
+    hit rate for the dispatch gate."""
+    c = get(table)
+    return c.head_mass(k) if c is not None else (0, 0)
 
 
 def _tables(name: str, arr) -> List[tuple]:

@@ -274,6 +274,28 @@ SCHEMA: Dict[str, dict] = {
                      "sample_every": int, "capacity": int,
                      "evicted": int},
     },
+    # one tiered-embedding-store action (storage/tiered.py —
+    # docs/storage.md).  ``phase`` selects the sub-shape: a warm-start
+    # / checkpoint-reload admission batch ("admit" — how many rows
+    # entered the hot tier under which policy), an eviction batch
+    # ("evict" — rows displaced to make room, dirty ones written back
+    # to the cold tier first), or one remap's miss block ("miss" — the
+    # lookups that left the hot tier, with the start-all-then-wait
+    # host->device stall they paid).  ``table`` is the store name (the
+    # sparse input it backs); ``hit_pct`` mirrors the
+    # dlrm_embed_cache_hit_pct gauge at emit time.
+    "storage": {
+        "required": {"phase": str, "table": str},
+        "optional": {"rows": int, "slots": int, "hit_pct": float,
+                     "hits": int, "misses": int, "evicted": int,
+                     "admitted": int, "stall_us": float, "policy": str,
+                     "dirty": int, "writebacks": int},
+        "phases": {
+            "admit": ("admitted", "policy"),
+            "evict": ("evicted",),
+            "miss": ("misses", "stall_us"),
+        },
+    },
     # one closed span (telemetry/trace.py) — a Dapper-style timed,
     # attributed region of a request or training run, emitted at span
     # END.  ``start_s`` is the wall-clock start (time.time());

@@ -1,0 +1,386 @@
+"""Tiered embedding storage smoke matrix (tier-1:
+tests/test_storage.py runs it).
+
+End-to-end checks of the two-tier embedding table
+(dlrm_flexflow_tpu/storage/ — docs/storage.md) against resident
+ground truth, so the claim the subsystem stands on — *same numbers,
+smaller device footprint* — is pinned:
+
+  1. bit_exact — stacked AND ragged tiered gathers under eviction
+     churn (table 4x the hot budget) must match a resident
+     ``jnp.take`` bit-exactly on BOTH uniform and power-law id
+     streams, including ``gather_rows`` and the training-side
+     ``scatter_apply`` + ``cold_full`` roundtrip vs a ``np.add.at``
+     reference;
+  2. hit_rate_skew — the same hot budget must turn power-law traffic
+     into a high hit rate (warm-started from ``RowFreqCounter``
+     observations) while uniform traffic over the same table stays
+     low — the asymmetry the dispatch gate prices;
+  3. eviction_pressure — a table 8x the budget with a drifting hot
+     set must keep serving bit-exactly while evicting, and dirty
+     training rows must survive eviction via write-back (cold tier
+     equals the numpy reference after churn);
+  4. dispatch_gate — ``kernel_costs.tiered_storage_wins`` refusal
+     regimes recomputed by hand (fits-on-device, can't-pin-batch,
+     uniform-has-no-head, skewed-wins) plus the
+     ``FF_TIERED_STORAGE`` off/on overrides through
+     ``tiered_decision``;
+  5. checkpoint_roundtrip — ``save_tiered``/``load_tiered`` must
+     rebuild the exact cold tier and respect a SMALLER reload
+     budget (manifest hot ids re-admitted retention-first);
+  6. engine_metrics (slow — gated on ``os.cpu_count()`` in main())
+     — a real ``InferenceEngine(storage="tiered")`` serving zipf
+     traffic must stay bit-exact vs its resident twin while the
+     ``dlrm_embed_cache_hit_pct`` / ``dlrm_embed_cache_miss_stall_us``
+     gauges go live on a scraped ``/metrics`` endpoint and the
+     ``storage`` telemetry events validate against the schema.
+
+Exit 0 when every requested scenario passes; prints one line per
+scenario and exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _zipf(rng, rows, size, a=1.2):
+    from dlrm_flexflow_tpu.data.loader import zipf_ids
+
+    return zipf_ids(rng, rows, size, a=a)
+
+
+def _resident_gather(cold: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Ground truth: what a fully-resident stacked table returns for
+    (n, T) ids — row ids[i, t] from table t."""
+    out = np.stack([cold[t][ids[:, t]] for t in range(cold.shape[0])],
+                   axis=1)
+    return out
+
+
+def scenario_bit_exact() -> str:
+    from dlrm_flexflow_tpu.storage import TieredEmbeddingTable
+
+    rng = np.random.default_rng(0)
+    T, R, D = 3, 256, 8
+    cold = rng.standard_normal((T, R, D)).astype(np.float32)
+
+    batches = 0
+    for dist in ("uniform", "zipf"):
+        # hot budget = R/8 per table -> guaranteed eviction churn
+        store = TieredEmbeddingTable("sparse", cold.copy(), R // 8)
+        for _ in range(20):
+            n = int(rng.integers(4, 17))
+            if dist == "zipf":
+                ids = np.stack([_zipf(rng, R, n) for _ in range(T)],
+                               axis=1)
+            else:
+                ids = rng.integers(0, R, size=(n, T), dtype=np.int64)
+            got = np.asarray(store.gather_rows(ids))
+            want = _resident_gather(cold, ids)
+            assert np.array_equal(got, want), \
+                f"{dist} gather diverged from resident"
+            batches += 1
+        st = store.stats()
+        assert st["evictions"] > 0, f"{dist}: no churn exercised"
+
+    # ragged: 2-D flat param + per-table row counts
+    counts = [96, 32, 128]
+    flat = rng.standard_normal((sum(counts), D)).astype(np.float32)
+    store = TieredEmbeddingTable("sparse", flat.copy(), 32,
+                                 row_counts=counts)
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    for _ in range(8):
+        n = int(rng.integers(1, 5))
+        ids = np.stack([rng.integers(0, c, size=n, dtype=np.int64)
+                        for c in counts], axis=1)
+        got = np.asarray(store.gather_rows(ids))
+        want = np.stack([flat[offs[t] + ids[:, t]]
+                         for t in range(len(counts))], axis=1)
+        assert np.array_equal(got, want), "ragged gather diverged"
+        batches += 1
+
+    # training side: scatter_apply accumulates into hot, write-back
+    # drains to cold — cold_full must equal the np.add.at reference
+    store = TieredEmbeddingTable("sparse", cold.copy(), R // 4)
+    ref = cold.copy()
+    for _ in range(6):
+        n = 4
+        ids = np.stack([_zipf(rng, R, n) for _ in range(T)], axis=1)
+        g = rng.standard_normal((n, T, D)).astype(np.float32)
+        store.gather_rows(ids)
+        store.scatter_apply(ids, g, scale=-0.1)
+        for t in range(T):
+            np.add.at(ref[t], ids[:, t], -0.1 * g[:, t])
+    got = np.asarray(store.cold_full())
+    assert np.allclose(got, ref, rtol=0, atol=1e-6), \
+        "post-training cold tier diverged from np.add.at reference"
+    return f"{batches} churn batches bit-exact (stacked+ragged), " \
+           f"scatter/writeback exact"
+
+
+def scenario_hit_rate_skew() -> str:
+    from dlrm_flexflow_tpu.storage import TieredEmbeddingTable
+    from dlrm_flexflow_tpu.telemetry import rowfreq
+
+    rng = np.random.default_rng(1)
+    R, D, HOT = 4096, 16, 512  # table 8x the hot budget
+    cold = rng.standard_normal((1, R, D)).astype(np.float32)
+
+    rates = {}
+    for dist in ("zipf", "uniform"):
+        key = f"skewcheck_{dist}"
+        c = rowfreq.counter(key)
+        warm = (_zipf(rng, R, 8192) if dist == "zipf"
+                else rng.integers(0, R, size=8192, dtype=np.int64))
+        c.observe(warm)
+        store = TieredEmbeddingTable("x", cold.copy(), HOT,
+                                     table_keys=[key])
+        admitted = store.warm_from_rowfreq()
+        assert admitted > 0, f"{dist}: warm start admitted nothing"
+        for _ in range(16):
+            ids = (_zipf(rng, R, (32, 1)) if dist == "zipf"
+                   else rng.integers(0, R, size=(32, 1),
+                                     dtype=np.int64))
+            store.gather_rows(ids)
+        rates[dist] = store.stats()["hit_pct"]
+    assert rates["zipf"] > 60.0, \
+        f"zipf hit rate too low: {rates['zipf']:.1f}%"
+    assert rates["zipf"] > rates["uniform"] + 20.0, \
+        f"skew asymmetry missing: zipf {rates['zipf']:.1f}% vs " \
+        f"uniform {rates['uniform']:.1f}%"
+    return (f"hot budget 1/8 of table: zipf {rates['zipf']:.1f}% hit "
+            f"vs uniform {rates['uniform']:.1f}%")
+
+
+def scenario_eviction_pressure() -> str:
+    from dlrm_flexflow_tpu.storage import TieredEmbeddingTable
+
+    rng = np.random.default_rng(2)
+    R, D, HOT = 2048, 8, 256  # 8x pressure
+    cold = rng.standard_normal((1, R, D)).astype(np.float32)
+    store = TieredEmbeddingTable("x", cold.copy(), HOT)
+    ref = cold.copy()
+    # drifting hot set: each phase hammers a different id window, so
+    # the previous phase's (dirty) residents must be evicted + written
+    # back while serving stays exact
+    for phase in range(4):
+        lo = phase * (R // 4)
+        for _ in range(16):
+            n = 16
+            ids = rng.integers(lo, lo + R // 4, size=(n, 1),
+                               dtype=np.int64)
+            got = np.asarray(store.gather_rows(ids))
+            assert np.array_equal(got, ref[0][ids[:, 0]][:, None]), \
+                f"phase {phase}: serve diverged under eviction"
+            g = rng.standard_normal((n, 1, D)).astype(np.float32)
+            store.scatter_apply(ids, g, scale=-0.05)
+            np.add.at(ref[0], ids[:, 0], -0.05 * g[:, 0])
+    st = store.stats()
+    assert st["evictions"] > HOT, \
+        f"expected heavy eviction, got {st['evictions']}"
+    assert st["writebacks"] > 0, "dirty evictions never wrote back"
+    got = np.asarray(store.cold_full())
+    assert np.allclose(got, ref, rtol=0, atol=1e-6), \
+        "cold tier lost training updates under eviction pressure"
+    return (f"8x pressure, {st['evictions']} evictions / "
+            f"{st['writebacks']} writebacks, serving + cold exact")
+
+
+def scenario_dispatch_gate() -> str:
+    from dlrm_flexflow_tpu.ops.kernel_costs import tiered_storage_wins
+    from dlrm_flexflow_tpu.storage import tiered_decision
+
+    kw = dict(num_rows=1 << 20, dim=128, itemsize=4, lookups=4096)
+    assert tiered_storage_wins(hot_rows=1 << 16, hit_rate=0.9, **kw), \
+        "skewed regime must win"
+    assert not tiered_storage_wins(hot_rows=1 << 16, hit_rate=0.5,
+                                   **kw), "coin-flip regime must lose"
+    assert not tiered_storage_wins(num_rows=4096, dim=128, itemsize=4,
+                                   lookups=512, hot_rows=8192,
+                                   hit_rate=0.99), \
+        "fits-on-device must stay resident"
+    assert not tiered_storage_wins(hot_rows=1024, hit_rate=0.99,
+                                   **kw), "can't-pin-batch must refuse"
+    uniform = (1 << 16) / (1 << 20)
+    assert not tiered_storage_wins(hot_rows=1 << 16, hit_rate=uniform,
+                                   **kw), "uniform floor must lose"
+
+    gk = dict(num_rows=1 << 20, dim=128, itemsize=4,
+              hot_rows=1 << 16, lookups=4096)
+    ok, why = tiered_decision(hit_rate=0.9, **gk)
+    assert ok, why
+    for mode, want in (("off", False), ("on", True)):
+        os.environ["FF_TIERED_STORAGE"] = mode
+        try:
+            ok, why = tiered_decision(hit_rate=0.0, **gk)
+        finally:
+            del os.environ["FF_TIERED_STORAGE"]
+        assert ok is want, f"FF_TIERED_STORAGE={mode}: {why}"
+    return "4 refusal regimes + win regime + env overrides exact"
+
+
+def scenario_checkpoint_roundtrip() -> str:
+    import tempfile
+
+    from dlrm_flexflow_tpu.storage import (TieredEmbeddingTable,
+                                           load_tiered, save_tiered)
+
+    rng = np.random.default_rng(3)
+    T, R, D = 2, 128, 8
+    cold = rng.standard_normal((T, R, D)).astype(np.float32)
+    store = TieredEmbeddingTable("sparse", cold.copy(), 32)
+    for _ in range(6):
+        ids = np.stack([_zipf(rng, R, 8) for _ in range(T)], axis=1)
+        store.gather_rows(ids)
+        g = rng.standard_normal((8, T, D)).astype(np.float32)
+        store.scatter_apply(ids, g, scale=-0.1)
+    with tempfile.TemporaryDirectory() as d:
+        save_tiered(d, store)
+        back = load_tiered(d, hot_rows=8)  # smaller budget on reload
+        assert np.allclose(np.asarray(back.cold_full()),
+                           np.asarray(store.cold_full()),
+                           rtol=0, atol=0), "cold tier not preserved"
+        for t in range(T):
+            res = back.resident_ids(t)
+            assert len(res) <= 8, \
+                f"reload budget ignored: {len(res)} resident"
+        ids = np.stack([_zipf(rng, R, 4) for _ in range(T)], axis=1)
+        assert np.array_equal(np.asarray(back.gather_rows(ids)),
+                              np.asarray(store.gather_rows(ids))), \
+            "reloaded store serves different rows"
+    return "save/load exact, smaller reload budget respected"
+
+
+def scenario_engine_metrics() -> str:
+    """Slow: compiles a real model, serves zipf traffic tiered vs
+    resident, scrapes /metrics for the live gauges, and validates the
+    emitted ``storage`` events against the telemetry schema."""
+    import json
+    import tempfile
+    import urllib.request
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.serving import InferenceEngine
+    from dlrm_flexflow_tpu.telemetry import event_log, rowfreq
+    from dlrm_flexflow_tpu.telemetry.exporter import start_metrics_server
+    from dlrm_flexflow_tpu.telemetry.schema import validate_event
+
+    T, R, D, BAG = 4, 512, 8, 2
+    cfg = DLRMConfig(sparse_feature_size=D,
+                     embedding_size=[R] * T,
+                     embedding_bag_size=BAG,
+                     mlp_bot=[16, 32, D],
+                     mlp_top=[D * T + D, 32, 1])
+    fc = ff.FFConfig(batch_size=32, serve_buckets="1,8,32",
+                     serve_storage="tiered",
+                     storage_hot_rows=R // 4)  # 4x hot budget
+    m = build_dlrm(cfg, fc)
+    m.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=())
+    state = m.init(seed=0)
+
+    rng = np.random.default_rng(4)
+    for t in range(T):
+        rowfreq.counter(f"sparse[{t}]").observe(_zipf(rng, R, 4096))
+
+    resident = InferenceEngine(m, state)
+    os.environ["FF_TIERED_STORAGE"] = "on"
+    try:
+        tiered = InferenceEngine(m, state, storage="tiered")
+    finally:
+        del os.environ["FF_TIERED_STORAGE"]
+    assert tiered.storage["mode"] == "tiered", tiered.storage
+
+    with tempfile.TemporaryDirectory() as d:
+        tele = os.path.join(d, "telemetry.jsonl")
+        with event_log(tele, mode="w"):
+            for _ in range(10):
+                n = int(rng.integers(1, 9))
+                req = {
+                    "dense": rng.standard_normal(
+                        (n, 16)).astype(np.float32),
+                    "sparse": np.stack(
+                        [_zipf(rng, R, (n, BAG)) for _ in range(T)],
+                        axis=1),
+                }
+                a = np.asarray(resident.predict(dict(req)))
+                b = np.asarray(tiered.predict(dict(req)))
+                assert np.array_equal(a, b), \
+                    "tiered engine diverged from resident"
+        stype = 0
+        with open(tele) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("type") == "storage":
+                    validate_event(ev)
+                    stype += 1
+        assert stype > 0, "no storage events emitted"
+
+    st = tiered.storage_stats()
+    assert st["lookups"] > 0 and st["hits"] > 0, st
+    srv = start_metrics_server(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            timeout=10).read().decode()
+    finally:
+        srv.stop()
+    for gauge in ("dlrm_embed_cache_hit_pct",
+                  "dlrm_embed_cache_miss_stall_us"):
+        assert f"{gauge} " in body or f"{gauge}{{" in body, \
+            f"{gauge} missing from /metrics"
+    return (f"engine bit-exact over 10 zipf batches, hit "
+            f"{st['hit_pct']:.1f}%, {stype} schema-valid storage "
+            f"events, both gauges live on /metrics")
+
+
+FAST = (("bit_exact", scenario_bit_exact),
+        ("hit_rate_skew", scenario_hit_rate_skew),
+        ("eviction_pressure", scenario_eviction_pressure),
+        ("dispatch_gate", scenario_dispatch_gate),
+        ("checkpoint_roundtrip", scenario_checkpoint_roundtrip))
+#: model-compiling scenarios — main() skips them on starved
+#: single-core containers (same tier-1 budget rule as the examples);
+#: run explicitly with --scenario engine_metrics
+SLOW = (("engine_metrics", scenario_engine_metrics),)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cpus = os.cpu_count() or 1
+    which = dict(FAST + SLOW) if cpus >= 4 else dict(FAST)
+    if "--scenario" in argv:
+        name = argv[argv.index("--scenario") + 1]
+        which = {n: f for n, f in FAST + SLOW if n == name}
+        if not which:
+            print(f"check_storage: unknown scenario {name!r}")
+            return 2
+    failed = 0
+    for name, fn in which.items():
+        try:
+            detail = fn()
+            print(f"check_storage: {name}: OK ({detail})")
+        except BaseException as e:  # noqa: BLE001 — report and count
+            failed += 1
+            import traceback
+            traceback.print_exc()
+            print(f"check_storage: {name}: FAIL "
+                  f"({type(e).__name__}: {e})")
+    if failed:
+        print(f"check_storage: {failed} scenario(s) FAILED")
+        return 1
+    print(f"check_storage: OK ({len(which)} scenarios)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,7 +77,16 @@ field without the schema and the report CLI seeing it:
      the host-loss fault kinds must parse (including the ``barrier``
      injection point), and docs/resilience.md, docs/distributed.md,
      and docs/serving.md must document the watchdog/recovery/ejection
-     entry points next to each other.
+     entry points next to each other;
+ 12. tiered-storage contract — the ``storage`` event type must carry
+     the admit/evict/miss phases, the cache gauges
+     (``dlrm_embed_cache_hit_pct``,
+     ``dlrm_embed_cache_miss_stall_us``) must be declared with the
+     stall gating UPWARD and the hit rate NOT, docs/storage.md must
+     document the subsystem's knobs and entry points, and the regress
+     anchor keys must keep the ``:storage=`` suffix so a hot-cache
+     run (which pays miss stalls by design) can never gate the
+     fully-resident baseline.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -604,6 +613,77 @@ def check_recovery_contract() -> list:
     return errs
 
 
+STORAGE_PHASES = ("admit", "evict", "miss")
+STORAGE_FAMILIES = ("dlrm_embed_cache_hit_pct",
+                    "dlrm_embed_cache_miss_stall_us")
+STORAGE_DOC_NEEDLES = ("TieredEmbeddingTable", "hot_rows",
+                       "tiered_storage_wins", ":storage=",
+                       "BENCH_STORAGE", "--storage", "--id-dist",
+                       "--zipf-alpha", "serve_storage",
+                       "storage_hot_rows", "FF_TIERED_STORAGE",
+                       "dlrm_embed_cache_hit_pct",
+                       "dlrm_embed_cache_miss_stall_us",
+                       "save_tiered", "load_tiered", "lfu", "lru",
+                       "clock")
+
+
+def check_storage_contract(doc_path: str) -> list:
+    """The tiered-storage contract (docs/storage.md): the ``storage``
+    event phases, the cache gauges with their gating directions, the
+    documented knob surface, and the ``:storage=`` regress anchor."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry.regress import (_history_metrics,
+                                                     lower_is_better)
+
+    errs = []
+    phases = SCHEMA.get("storage", {}).get("phases") or {}
+    if not phases:
+        errs.append("storage: event type 'storage' missing from the "
+                    "schema (or has no phases) — tier telemetry is "
+                    "gone")
+    for ph in STORAGE_PHASES:
+        if ph not in phases:
+            errs.append(f"storage: phase {ph!r} missing from the "
+                        f"storage event schema")
+    for name in STORAGE_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"storage: metric family {name!r} not declared "
+                        f"in telemetry.metrics.FAMILIES")
+    if not lower_is_better("dlrm_embed_cache_miss_stall_us"):
+        errs.append("storage: regress treats the miss stall as "
+                    "higher-is-better — a streaming regression would "
+                    "read as an improvement")
+    if lower_is_better("dlrm_embed_cache_hit_pct"):
+        errs.append("storage: regress treats the hit rate as "
+                    "lower-is-better — a cache-thrash regression "
+                    "would read as an improvement")
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented tiered "
+                    f"storage subsystem)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in STORAGE_DOC_NEEDLES:
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/storage.md does not document "
+                            f"`{needle}`")
+    anchors = _history_metrics([
+        {"metric": "m", "value": 1.0, "fenced": True},
+        {"metric": "m", "value": 2.0, "fenced": True,
+         "storage": "resident"},
+        {"metric": "m", "value": 3.0, "fenced": True,
+         "storage": "tiered"}])
+    if "m:storage=tiered" not in anchors:
+        errs.append("storage: regress anchor key 'm:storage=tiered' "
+                    "missing — a tiered run could gate the resident "
+                    "baseline (telemetry/regress.py _history_metrics)")
+    if anchors.get("m") != 2.0:
+        errs.append("storage: an explicit storage='resident' entry "
+                    "must anchor the BARE metric key (same anchor as "
+                    "entries predating the field)")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -621,7 +701,9 @@ def main() -> int:
             + check_pod_contract(os.path.join(REPO, "docs",
                                               "distributed.md"))
             + check_fleet_contract(doc)
-            + check_recovery_contract())
+            + check_recovery_contract()
+            + check_storage_contract(os.path.join(REPO, "docs",
+                                                  "storage.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

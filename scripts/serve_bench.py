@@ -115,7 +115,9 @@ def build_model(args):
                      serve_buckets=args.buckets,
                      serve_max_wait_us=args.max_wait_us,
                      serve_queue_depth=args.queue_depth,
-                     serve_timeout_us=args.timeout_us)
+                     serve_timeout_us=args.timeout_us,
+                     serve_storage=getattr(args, "storage", "resident"),
+                     storage_hot_rows=getattr(args, "hot_rows", 4096))
     # table-parallel strategies only make sense with a model axis to
     # shard over; a pure-data mesh serves replicated params
     table_parallel = bool(mesh_shape and mesh_shape.get("model", 1) > 1)
@@ -134,8 +136,22 @@ def max_bucket(args) -> int:
 
 def request_pool(cfg, args, n_pool: int = 256):
     """Pre-generate a pool of requests so the load loop measures
-    serving, not numpy RNG."""
+    serving, not numpy RNG.  ``--id-dist zipf`` draws the sparse ids
+    power-law skewed (exponent ``--zipf-alpha``) — the regime a tiered
+    hot cache (``--storage tiered``) is built for."""
+    from dlrm_flexflow_tpu.data.loader import zipf_ids
+
     rng = np.random.default_rng(args.seed)
+    zipf = getattr(args, "id_dist", "uniform") == "zipf"
+    alpha = getattr(args, "zipf_alpha", 1.05)
+
+    def ids(r, n):
+        if zipf:
+            return zipf_ids(rng, r, (n, cfg.embedding_bag_size),
+                            a=alpha)
+        return rng.integers(0, r, size=(n, cfg.embedding_bag_size),
+                            dtype=np.int64)
+
     pool = []
     for _ in range(n_pool):
         n = args.rows
@@ -143,9 +159,7 @@ def request_pool(cfg, args, n_pool: int = 256):
             "dense": rng.standard_normal(
                 (n, cfg.mlp_bot[0])).astype(np.float32),
             "sparse": np.stack(
-                [rng.integers(0, r, size=(n, cfg.embedding_bag_size),
-                              dtype=np.int64)
-                 for r in cfg.embedding_size], axis=1),
+                [ids(r, n) for r in cfg.embedding_size], axis=1),
         })
     return pool
 
@@ -247,6 +261,24 @@ def main(argv=None) -> int:
                    help="row-quantize the embedding tables at engine "
                         "load (docs/serving.md; tolerance-pinned "
                         "outputs, ~4x/2x smaller table sweep)")
+    p.add_argument("--storage", default="resident",
+                   choices=("resident", "tiered"),
+                   help="embedding residency: resident keeps full "
+                        "tables on device; tiered caches --hot-rows "
+                        "hot rows and streams misses from host RAM "
+                        "(docs/storage.md; mutually exclusive with "
+                        "--quantize)")
+    p.add_argument("--hot-rows", type=int, default=4096,
+                   help="per-table device hot-row budget for "
+                        "--storage tiered")
+    p.add_argument("--id-dist", default="uniform",
+                   choices=("uniform", "zipf"),
+                   help="sparse-id law for the request pool; zipf "
+                        "gives the power-law skew a tiered hot cache "
+                        "is built for")
+    p.add_argument("--zipf-alpha", type=float, default=1.05,
+                   help="zipf exponent for --id-dist zipf (>1; "
+                        "higher = more skew)")
     p.add_argument("--telemetry",
                    default=os.path.join(REPO, "artifacts",
                                         "telemetry_serving.jsonl"))
@@ -270,17 +302,45 @@ def main(argv=None) -> int:
               f"http://{args.metrics_host}:{srv.port}/metrics")
     cfg, model = build_model(args)
     with event_log(args.telemetry, mode="w"):
+        # pool before engine: a tiered engine prices + warms its hot
+        # tier from observed id frequencies, so feed the counters the
+        # traffic it is about to serve (docs/storage.md)
+        pool = request_pool(cfg, args)
+        if args.storage == "tiered":
+            from dlrm_flexflow_tpu.telemetry import rowfreq
+
+            for req in pool:
+                for t in range(len(cfg.embedding_size)):
+                    rowfreq.counter(f"sparse[{t}]").observe(
+                        req["sparse"][:, t, :])
         if args.checkpoint:
             engine = InferenceEngine.from_checkpoint(
-                model, args.checkpoint, quantize=args.quantize)
+                model, args.checkpoint, quantize=args.quantize,
+                storage=args.storage)
         else:
             engine = InferenceEngine(model, model.init(seed=args.seed),
-                                     quantize=args.quantize)
+                                     quantize=args.quantize,
+                                     storage=args.storage)
         if engine.quantization["mode"] != "off":
             q = engine.quantization
             print(f"serve_bench: quantized tables ({q['mode']}): "
                   f"{q['bytes_before']:,} -> {q['bytes_after']:,} bytes")
-        pool = request_pool(cfg, args)
+        if args.storage == "tiered":
+            s = engine.storage
+            if s["mode"] == "tiered":
+                tot_rows = sum(t["rows"] for t in s["tables"].values())
+                tot_hot = sum(t["hot_slots"]
+                              for t in s["tables"].values())
+                print(f"serve_bench: tiered storage: {tot_hot:,} hot "
+                      f"slots over {tot_rows:,} rows "
+                      f"({len(s['tables'])} table group(s), "
+                      f"{args.id_dist} ids)")
+            else:
+                why = "; ".join(f"{k}: {v}"
+                                for k, v in s["fallbacks"].items()) \
+                    or "no embedding ops"
+                print(f"serve_bench: tiered storage fell back to "
+                      f"resident — {why}")
         if args.replicas > 1:
             # N batcher replicas over ONE engine (shared params + AOT
             # cache; each replica still has its own queue + dispatcher
@@ -316,6 +376,12 @@ def main(argv=None) -> int:
         print(f"serve_bench:   replica {i}: {rep['requests']} served / "
               f"{rep['dispatches']} dispatched, {rep['rejected']} shed, "
               f"p99 {p99}")
+    if engine.storage["mode"] == "tiered":
+        st = engine.storage_stats()
+        print(f"serve_bench: storage hit {st['hit_pct']:.1f}% "
+              f"({st['hits']:,}/{st['lookups']:,} lookups), "
+              f"{st['evictions']:,} evictions, miss stall last "
+              f"{st['stall_us_last']:.0f} us")
     if args.replicas > 1:
         print(f"serve_bench:   router shed "
               f"{summary.get('router_shed', 0)} request(s) — a shed "

@@ -1,0 +1,339 @@
+"""Tiered embedding storage tests (dlrm_flexflow_tpu/storage/ —
+docs/storage.md): slot remapping vs resident ground truth, eviction
+policies, the kernel-cost dispatch gate, RowFreqCounter's admission
+API, checkpoint manifests, and the telemetry/regress surfaces the
+subsystem feeds."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dlrm_flexflow_tpu.data.loader import SyntheticDLRMLoader, zipf_ids
+from dlrm_flexflow_tpu.ops.kernel_costs import tiered_storage_wins
+from dlrm_flexflow_tpu.storage import (ClockPolicy, LFUPolicy, LRUPolicy,
+                                       StorageError,
+                                       TieredEmbeddingTable,
+                                       load_tiered, make_policy,
+                                       predicted_hit_rate, save_tiered,
+                                       tiered_decision)
+from dlrm_flexflow_tpu.telemetry import EventLog, rowfreq, set_event_log
+from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+from dlrm_flexflow_tpu.telemetry.schema import validate_event
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    rowfreq.reset()
+    yield
+    rowfreq.reset()
+
+
+def make_store(T=2, R=64, D=4, hot=16, seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    cold = rng.standard_normal((T, R, D)).astype(np.float32)
+    return cold, TieredEmbeddingTable("sparse", cold.copy(), hot, **kw)
+
+
+class TestSmokeMatrix:
+    def test_check_storage_passes(self):
+        """The full smoke matrix (bit-exact churn, hit-rate asymmetry,
+        eviction pressure, gate regimes, checkpoint roundtrip) — the
+        acceptance pins live there."""
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_storage.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+        assert "check_storage: OK (" in out.stdout
+
+
+class TestTieredTable:
+    def test_gather_bit_exact_vs_resident(self):
+        cold, store = make_store()
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            ids = rng.integers(0, 64, size=(5, 2), dtype=np.int64)
+            got = np.asarray(store.gather_rows(ids))
+            want = np.stack([cold[t][ids[:, t]] for t in range(2)],
+                            axis=1)
+            assert np.array_equal(got, want)
+        assert store.stats()["evictions"] > 0  # churn was real
+
+    def test_out_of_range_id_raises(self):
+        _, store = make_store()
+        with pytest.raises(StorageError, match="out of range"):
+            store.gather_rows(np.array([[0, 64]], dtype=np.int64))
+
+    def test_batch_bigger_than_hot_tier_raises(self):
+        _, store = make_store(hot=4)
+        ids = np.arange(8, dtype=np.int64)[:, None].repeat(2, axis=1)
+        with pytest.raises(StorageError, match="working set"):
+            store.gather_rows(ids)
+
+    def test_fully_resident_never_misses(self):
+        cold, store = make_store(hot=64)  # budget covers every row
+        rng = np.random.default_rng(2)
+        store.gather_rows(rng.integers(0, 64, size=(8, 2),
+                                       dtype=np.int64))
+        st = store.stats()
+        assert st["misses"] == st["lookups"]  # first touch streams
+        store.gather_rows(rng.integers(0, 64, size=(8, 2),
+                                       dtype=np.int64))
+
+    def test_stats_shape(self):
+        _, store = make_store()
+        store.gather_rows(np.zeros((1, 2), dtype=np.int64))
+        st = store.stats()
+        for k in ("lookups", "hits", "misses", "hit_pct", "evictions",
+                  "admitted", "writebacks", "stall_us_total"):
+            assert k in st, k
+
+
+class TestPolicies:
+    def _fill(self, p):
+        for s in range(4):
+            p.fill(s)
+
+    def test_lfu_prefers_cold_slots(self):
+        p = LFUPolicy(4)
+        self._fill(p)
+        p.touch(2)
+        p.touch(2)
+        p.touch(0)
+        assert p.victims(2, pinned={1}) == [3, 0]
+
+    def test_lru_prefers_stale_slots(self):
+        p = LRUPolicy(4)
+        self._fill(p)
+        p.touch(2)
+        p.touch(2)
+        p.touch(0)
+        assert p.victims(2, pinned={1}) == [3, 2]
+
+    def test_clock_second_chance(self):
+        p = ClockPolicy(4)
+        self._fill(p)
+        p.touch(2)
+        assert p.victims(2, pinned={1}) == [0, 2]
+
+    def test_make_policy_registry(self):
+        assert isinstance(make_policy("lfu", 2), LFUPolicy)
+        assert isinstance(make_policy("lru", 2), LRUPolicy)
+        assert isinstance(make_policy("clock", 2), ClockPolicy)
+        with pytest.raises(ValueError, match="unknown eviction"):
+            make_policy("arc", 2)
+
+    def test_policy_threads_through_store(self):
+        _, store = make_store(policy="clock")
+        assert store.policy_name == "clock"
+        assert store.stats()["policy"] == "clock"
+
+
+class TestDispatchGate:
+    KW = dict(num_rows=1 << 20, dim=128, itemsize=4, lookups=4096)
+
+    def test_skewed_wins_coinflip_loses(self):
+        assert tiered_storage_wins(hot_rows=1 << 16, hit_rate=0.9,
+                                   **self.KW)
+        assert not tiered_storage_wins(hot_rows=1 << 16, hit_rate=0.5,
+                                       **self.KW)
+
+    def test_fits_on_device_refuses(self):
+        assert not tiered_storage_wins(num_rows=1024, dim=128,
+                                       itemsize=4, lookups=256,
+                                       hot_rows=2048, hit_rate=0.99)
+
+    def test_cannot_pin_batch_refuses(self):
+        assert not tiered_storage_wins(hot_rows=1024, hit_rate=0.99,
+                                       **self.KW)
+
+    def test_env_override(self, monkeypatch):
+        gk = dict(num_rows=1 << 20, dim=128, itemsize=4,
+                  hot_rows=1 << 16, lookups=4096)
+        monkeypatch.setenv("FF_TIERED_STORAGE", "off")
+        ok, why = tiered_decision(hit_rate=0.99, **gk)
+        assert not ok and "FF_TIERED_STORAGE" in why
+        monkeypatch.setenv("FF_TIERED_STORAGE", "on")
+        ok, why = tiered_decision(hit_rate=0.0, **gk)
+        assert ok and "forced" in why
+
+    def test_predicted_hit_rate_uses_observed_head(self):
+        c = rowfreq.counter("gate[0]")
+        c.observe(np.array([7] * 90 + list(range(10, 20)),
+                           dtype=np.int64))
+        rate, observed = predicted_hit_rate(["gate[0]"], [1000], [1])
+        assert observed and rate == pytest.approx(0.9)
+        # no traffic -> uniform floor hot/rows, flagged unobserved
+        rate, observed = predicted_hit_rate(["nope[0]"], [1000], [100])
+        assert not observed and rate == pytest.approx(0.1)
+
+
+class TestRowFreqAdmissionAPI:
+    def test_hot_rows_matches_histogram_head(self):
+        """`hot_rows(table, k)` must agree with the power-of-two
+        bucket histogram: the ids it returns carry exactly the counts
+        the buckets account for."""
+        c = rowfreq.counter("emb")
+        ids = np.repeat(np.arange(8, dtype=np.int64),
+                        [128, 64, 32, 16, 8, 4, 2, 1])
+        np.random.default_rng(0).shuffle(ids)
+        c.observe(ids)
+        top = rowfreq.hot_rows("emb", 4)
+        assert [i for i, _ in top] == [0, 1, 2, 3]
+        assert [n for _, n in top] == [128, 64, 32, 16]
+        # histogram buckets 2^0..2^7 each hold exactly one of the 8
+        # ids (counts are exact powers of two)
+        assert c.bucket_counts() == [1] * 8
+
+    def test_hot_rows_unknown_table_empty(self):
+        assert rowfreq.hot_rows("ghost", 4) == []
+
+    def test_head_mass_snapshot(self):
+        c = rowfreq.counter("emb")
+        c.observe(np.array([1] * 6 + [2] * 3 + [3], dtype=np.int64))
+        head, seen = c.head_mass(2)
+        assert (head, seen) == (9, 10)
+        assert rowfreq.head_mass("emb", 2) == (9, 10)
+        assert rowfreq.head_mass("ghost", 2) == (0, 0)
+
+    def test_concurrent_observe_and_admit(self):
+        """The admission read path races live observation — must never
+        throw and must return a coherent (id, count) snapshot."""
+        c = rowfreq.counter("emb")
+        stop = threading.Event()
+        errs = []
+
+        def writer():
+            rng = np.random.default_rng(1)
+            while not stop.is_set():
+                c.observe(zipf_ids(rng, 512, 256, a=1.3))
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    for i, n in rowfreq.hot_rows("emb", 16):
+                        assert 0 <= i < 512 and n > 0
+                    c.head_mass(16)
+                    c.bucket_counts()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=writer),
+              threading.Thread(target=reader)]
+        for t in ts:
+            t.start()
+        threading.Event().wait(0.3)
+        stop.set()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+
+
+class TestWarmStart:
+    def test_warm_from_rowfreq_pins_hot_head(self):
+        rowfreq.counter("sparse[0]").observe(
+            np.array([3] * 50 + [9] * 30 + [1] * 5, dtype=np.int64))
+        rowfreq.counter("sparse[1]").observe(
+            np.array([7] * 40, dtype=np.int64))
+        _, store = make_store(hot=2)  # hot_rows is PER TABLE
+        assert store.warm_from_rowfreq() == 3
+        assert sorted(store.resident_ids(0)) == [3, 9]
+        assert store.resident_ids(1) == [7]
+
+    def test_manifest_orders_by_retention(self):
+        _, store = make_store(hot=4)
+        store.warm_start([[(3, 50), (9, 30)], [(7, 40)]])
+        man = store.hot_manifest()
+        assert man[0][0] == (3, 50)  # hottest first
+        assert man[1] == [(7, 40)]
+
+
+class TestCheckpoint:
+    def test_roundtrip_and_smaller_budget(self, tmp_path):
+        cold, store = make_store()
+        rng = np.random.default_rng(3)
+        for _ in range(4):
+            ids = rng.integers(0, 64, size=(4, 2), dtype=np.int64)
+            store.gather_rows(ids)
+            store.scatter_apply(
+                ids, rng.standard_normal((4, 2, 4)).astype(np.float32),
+                scale=-0.1)
+        save_tiered(str(tmp_path), store)
+        assert (tmp_path / "tiered_manifest.json").exists()
+        back = load_tiered(str(tmp_path), hot_rows=4)
+        assert np.array_equal(np.asarray(back.cold_full()),
+                              np.asarray(store.cold_full()))
+        for t in range(2):  # hot_rows is a per-table budget
+            assert len(back.resident_ids(t)) <= 4
+
+    def test_manifest_is_valid_json_with_tier_ownership(self, tmp_path):
+        _, store = make_store()
+        store.gather_rows(np.zeros((1, 2), dtype=np.int64))
+        save_tiered(str(tmp_path), store)
+        doc = json.loads((tmp_path / "tiered_manifest.json")
+                         .read_text())
+        assert doc["kind"] == "stacked" and doc["version"] == 1
+        assert len(doc["hot_ids"]) == 2  # per-table ownership lists
+
+
+class TestLoaderIdDist:
+    def test_zipf_option_skews_ids(self):
+        uni = SyntheticDLRMLoader(256, 4, [1000, 1000], 2, 32, seed=0)
+        zip_ = SyntheticDLRMLoader(256, 4, [1000, 1000], 2, 32, seed=0,
+                                   id_dist="zipf", zipf_alpha=1.3)
+        for lo in (uni, zip_):
+            assert lo.inputs["sparse"].shape == (256, 2, 2)
+            assert lo.inputs["sparse"].max() < 1000
+        # skew: the most common id takes far more mass under zipf
+        def head(a):
+            _, n = np.unique(a, return_counts=True)
+            return n.max() / a.size
+        assert head(zip_.inputs["sparse"]) > 4 * head(uni.inputs["sparse"])
+
+    def test_unknown_dist_raises(self):
+        with pytest.raises(ValueError, match="id_dist"):
+            SyntheticDLRMLoader(8, 4, [10], 2, 4, id_dist="pareto")
+
+
+class TestTelemetrySurfaces:
+    def test_storage_events_validate(self):
+        log = EventLog()
+        prev = set_event_log(log)
+        try:
+            _, store = make_store()
+            rng = np.random.default_rng(5)
+            for _ in range(6):
+                store.gather_rows(rng.integers(0, 64, size=(6, 2),
+                                               dtype=np.int64))
+        finally:
+            set_event_log(prev)
+        evs = log.events("storage")
+        assert evs, "no storage events emitted"
+        for e in evs:
+            validate_event(e)
+        assert {e["phase"] for e in evs} >= {"miss"}
+
+    def test_regress_direction_for_new_gauges(self):
+        assert lower_is_better("dlrm_embed_cache_miss_stall_us") is True
+        assert lower_is_better("dlrm_embed_cache_hit_pct") is False
+
+    def test_history_anchor_suffix(self):
+        from dlrm_flexflow_tpu.telemetry.regress import _history_metrics
+        hist = [{"metric": "dlrm_serving_qps", "value": 100.0,
+                 "fenced": True, "storage": "tiered"},
+                {"metric": "dlrm_serving_qps", "value": 200.0,
+                 "fenced": True, "storage": "resident"},
+                {"metric": "dlrm_serving_qps", "value": 300.0,
+                 "fenced": True}]
+        m = _history_metrics(hist)
+        assert "dlrm_serving_qps:storage=tiered" in m
+        # resident (explicit or predating the field) anchors bare
+        assert m["dlrm_serving_qps"] == 300.0
