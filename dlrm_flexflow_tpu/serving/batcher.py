@@ -17,6 +17,7 @@ then the dispatcher exits and a ``serve`` summary event is emitted.
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 import time
@@ -166,6 +167,17 @@ class DynamicBatcher:
                  stats: Optional[LatencyStats] = None):
         cfg = engine.model.config
         self.engine = engine
+        # engines predating the ``timings`` out-param (subclasses
+        # overriding predict with the old signature) still work — they
+        # just get the default phase attribution in the tail exemplars
+        try:
+            sig = inspect.signature(engine.predict)
+            self._predict_takes_timings = (
+                "timings" in sig.parameters
+                or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in sig.parameters.values()))
+        except (TypeError, ValueError):  # C-level or exotic callables
+            self._predict_takes_timings = False
         self.max_batch_size = int(
             max_batch_size
             or getattr(cfg, "serve_max_batch", 0)
@@ -236,7 +248,7 @@ class DynamicBatcher:
                 # the batcher may already be RETIRED from /metrics (its
                 # stats folded): record_shed_late routes the reject into
                 # the retained base so the Prometheus counter sees it
-                _metrics.record_shed_late(self.stats)
+                _metrics.record_shed_late(self.stats, cause="shutdown")
                 emit("serve", phase="reject", reason="shutdown")
                 start_span("serve.request").set_attr(
                     "reason", "shutdown").end(status="shed")
@@ -294,8 +306,9 @@ class DynamicBatcher:
                 # record_shed_late routes a post-fold count into the
                 # retained base.  _miss/cancel paths need no such guard
                 # — they run on the dispatcher (or inside _close
-                # itself), strictly before the fold.
-                _metrics.record_shed_late(self.stats)
+                # itself), strictly before the fold.  The shed reason
+                # IS the cause label of dlrm_serve_shed_total.
+                _metrics.record_shed_late(self.stats, cause=shed)
                 emit("serve", phase="reject", reason=shed)
             # a silent router probe's refusal is NOT a shed — the
             # request may be served by the next replica, and a
@@ -448,9 +461,19 @@ class DynamicBatcher:
         push_span(dsp)
         fwd_start_s = time.time()
         t_fwd = time.perf_counter()
+        # per-dispatch phase decomposition for the tail exemplars
+        # (docs/slo.md): the engine fills bucket / pad_us / compute_us /
+        # stall_us with plain dict writes — no locking added to its
+        # forward path
+        timings: Dict[str, float] = {}
         try:
-            out = self.engine.predict(joined,
-                                      queue_wait_us=queue_wait_us)
+            if self._predict_takes_timings:
+                out = self.engine.predict(joined,
+                                          queue_wait_us=queue_wait_us,
+                                          timings=timings)
+            else:
+                out = self.engine.predict(joined,
+                                          queue_wait_us=queue_wait_us)
         except Exception as e:  # deliver the failure, keep serving
             pop_span(dsp)
             dsp.end(status="error")
@@ -466,11 +489,28 @@ class DynamicBatcher:
         fwd_us = (time.perf_counter() - t_fwd) * 1e6
         self.stats.record_dispatch()
         done = time.perf_counter()
+        bucket = int(timings.get("bucket",
+                                 sum(r.rows for r in batch)))
         lo = 0
         for r in batch:
             r.future._set(jax.tree.map(
                 lambda a, lo=lo, hi=lo + r.rows: a[lo:hi], out))
-            self.stats.record((done - r.t_submit) * 1e6)
+            lat_us = (done - r.t_submit) * 1e6
+            self.stats.record(lat_us)
+            # tail exemplar: this request's end-to-end wall decomposed
+            # into queue-wait (submit -> batch formed) + the engine's
+            # pad / forward / miss-stall walls, carrying the request's
+            # trace id so a p99 spike links back to the exact span
+            # chain.  One comparison + (top-K admission only) one short
+            # lock in LatencyStats — the engine forward path above is
+            # untouched.
+            self.stats.record_exemplar(
+                bucket=bucket, lat_us=lat_us,
+                trace_id=r.span.trace_id or "",
+                queue_wait_us=(t_fwd - r.t_submit) * 1e6,
+                pad_us=timings.get("pad_us", 0.0),
+                compute_us=timings.get("compute_us", fwd_us),
+                stall_us=timings.get("stall_us", 0.0))
             record_span("serve.forward", fwd_start_s, fwd_us,
                         parent=r.span, attrs={"rows": r.rows})
             r.span.end()

@@ -492,12 +492,20 @@ class InferenceEngine:
         pad = np.zeros((b - n,) + arr.shape[1:], dtype=arr.dtype)
         return np.concatenate([arr, pad], axis=0)
 
-    def predict(self, inputs: Dict[str, Any], queue_wait_us: float = 0.0):
+    def predict(self, inputs: Dict[str, Any], queue_wait_us: float = 0.0,
+                timings: Optional[Dict[str, float]] = None):
         """Run the labels-free forward on ``inputs`` (dict name ->
         (n, ...) array), padding to the enclosing bucket and slicing the
         padding back off; batches larger than the top bucket run as
         top-bucket chunks.  Returns host numpy outputs (a pytree when
-        the model has multiple outputs)."""
+        the model has multiple outputs).
+
+        ``timings`` (optional out-param) receives the last chunk's
+        dispatch decomposition — ``bucket``, ``pad_us``,
+        ``compute_us``, ``stall_us`` (the dlrm_embed_cache_miss_stall_us
+        gauge after the forward) — plain dict writes and one lock-free
+        gauge read, so the batcher's tail exemplars (docs/slo.md) cost
+        the forward path nothing."""
         arrs = {}
         n = None
         for name, (_shape, dtype) in self._in_specs.items():
@@ -524,14 +532,15 @@ class InferenceEngine:
             m = min(n - lo, top)
             chunks.append(self._dispatch(
                 {k: v[lo:lo + m] for k, v in arrs.items()}, m,
-                queue_wait_us))
+                queue_wait_us, timings))
         if len(chunks) == 1:
             return chunks[0]
         return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0),
                             *chunks)
 
     def _dispatch(self, chunk: Dict[str, np.ndarray], m: int,
-                  queue_wait_us: float):
+                  queue_wait_us: float,
+                  timings: Optional[Dict[str, float]] = None):
         # spans nest under the caller's current span (the batcher's
         # serve.dispatch) when tracing is on; off, each trace_span call
         # is one active-log None-check.  _ensure stays OUTSIDE the pad
@@ -560,6 +569,7 @@ class InferenceEngine:
             params = {k: ({**v, "embedding": hot_leaves[k]}
                           if k in hot_leaves else v)
                       for k, v in self._params.items()}
+        t_pad = time.perf_counter()
         with trace_span("serve.pad", attrs={"batch": m, "bucket": b}):
             padded = {k: self._pad(v, m, b) for k, v in chunk.items()}
         t0 = time.perf_counter()
@@ -574,6 +584,16 @@ class InferenceEngine:
         # family dlrm_serve_bucket_latency_us and the serving-p99 bench
         # headline read it, no extra lock on this path
         self.stats.record_dispatch(bucket=b, lat_us=compute_us)
+        if timings is not None:
+            # tail-exemplar decomposition (docs/slo.md): dict writes +
+            # one lock-free set-gauge read — nothing added to the
+            # forward path's locking
+            timings["bucket"] = float(b)
+            timings["pad_us"] = (t0 - t_pad) * 1e6
+            timings["compute_us"] = compute_us
+            stall = _metrics.EMBED_CACHE_MISS_STALL_US.value
+            timings["stall_us"] = (float(stall) if self._tiered
+                                   and stall is not None else 0.0)
         emit("serve", phase="dispatch", batch=m, bucket=b, padded=b - m,
              fill=m / b, queue_wait_us=float(queue_wait_us),
              compute_us=compute_us)

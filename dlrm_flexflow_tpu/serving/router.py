@@ -239,7 +239,8 @@ class ReplicaRouter:
             raise self._reject_shutdown()
         # THE router-level shed.  The count goes through the metrics
         # module so it stays monotone even when the fold-on-retire
-        # races a late submit; the emit runs outside every lock.
+        # races a late submit; the emit runs outside every lock.  The
+        # cell also backs dlrm_serve_shed_total{cause="saturated"}.
         _metrics.record_router_shed(self._shed_cell)
         emit("serve", phase="reject", reason="router_saturated")
         raise Rejected(
@@ -259,7 +260,7 @@ class ReplicaRouter:
                      else self._folded_stats[0] if self._folded_stats
                      else None)
         if stats is not None:
-            _metrics.record_shed_late(stats)
+            _metrics.record_shed_late(stats, cause="shutdown")
         emit("serve", phase="reject", reason="shutdown")
         return Rejected("router is shut down")
 

@@ -64,6 +64,16 @@ class LatencyStats:
         # fed by record_dispatch under the lock it already takes
         self._bucket_hist: Dict[int, List[int]] = {}
         self._bucket_lat_sum: Dict[int, float] = {}
+        # shed counts split by cause (queue_full / deadline / shutdown
+        # — the dlrm_serve_shed_total{cause=} family, docs/slo.md);
+        # always a subset-sum of rejected + deadline_misses
+        self._shed_causes: Dict[str, int] = {}
+        # bounded top-K slowest requests per bucket, each carrying its
+        # trace id + span-derived phase decomposition (queue-wait /
+        # pad / engine-forward / storage miss-stall) — the "== tail =="
+        # report section and the exporter's exemplar lines read these
+        self.tail_k = 8
+        self._tail: Dict[int, List[dict]] = {}
         self._t0 = time.perf_counter()
 
     # ------------------------------------------------------------ recording
@@ -84,13 +94,25 @@ class LatencyStats:
         for v in lats_us:
             self.record(v)
 
-    def record_reject(self) -> None:
+    def record_reject(self, cause: str = "shutdown") -> None:
+        """One shed request.  ``cause`` feeds the labelled
+        dlrm_serve_shed_total split: "queue_full" (batcher queue at
+        capacity) or "shutdown" (rejected while closing / replica
+        lost)."""
         with self._lock:
             self.rejected += 1
+            self._shed_causes[cause] = self._shed_causes.get(cause, 0) + 1
 
     def record_deadline_miss(self) -> None:
         with self._lock:
             self.deadline_misses += 1
+            self._shed_causes["deadline"] = \
+                self._shed_causes.get("deadline", 0) + 1
+
+    def shed_causes(self) -> Dict[str, int]:
+        """One locked snapshot of the per-cause shed counts."""
+        with self._lock:
+            return dict(self._shed_causes)
 
     def record_dispatch(self, bucket: Optional[int] = None,
                         lat_us: Optional[float] = None) -> None:
@@ -113,6 +135,50 @@ class LatencyStats:
                     h[bisect.bisect_left(LATENCY_BUCKETS_US, lat)] += 1
                     self._bucket_lat_sum[b] = \
                         self._bucket_lat_sum.get(b, 0.0) + lat
+
+    def record_exemplar(self, bucket: int, lat_us: float, trace_id: str,
+                        queue_wait_us: float = 0.0, pad_us: float = 0.0,
+                        compute_us: float = 0.0,
+                        stall_us: float = 0.0) -> None:
+        """Admit one completed request into the bounded top-K slowest
+        set of its bucket (docs/slo.md).  The phase walls are the
+        span-derived decomposition of ``lat_us``: time queued before
+        dispatch, bucket padding, the engine forward wall, and the
+        tiered-store miss stall inside it; ``dominant`` (the largest)
+        is precomputed here so readers rank without re-deriving.  One
+        short lock, only when the request beats the bucket's current
+        K-th worst — the common (fast) request pays one comparison."""
+        lat = float(lat_us)
+        row = {"bucket": int(bucket), "lat_us": lat,
+               "trace_id": str(trace_id),
+               "queue_wait_us": float(queue_wait_us),
+               "pad_us": float(pad_us),
+               "compute_us": float(compute_us),
+               "stall_us": float(stall_us)}
+        phases = (("queue_wait", row["queue_wait_us"]),
+                  ("pad", row["pad_us"]),
+                  ("engine_forward", row["compute_us"]),
+                  ("miss_stall", row["stall_us"]))
+        row["dominant"] = max(phases, key=lambda kv: kv[1])[0]
+        with self._lock:
+            top = self._tail.setdefault(int(bucket), [])
+            if len(top) < self.tail_k:
+                top.append(row)
+            else:
+                i = min(range(len(top)),
+                        key=lambda j: top[j]["lat_us"])
+                if lat > top[i]["lat_us"]:
+                    top[i] = row
+                else:
+                    return
+
+    def tail_exemplars(self) -> List[dict]:
+        """Worst-first copy of every bucket's top-K exemplar rows (the
+        metrics sweep and the ``serve`` ``phase="tail"`` events)."""
+        with self._lock:
+            rows = [dict(r) for top in self._tail.values() for r in top]
+        rows.sort(key=lambda r: -r["lat_us"])
+        return rows
 
     # ------------------------------------------------------------ histogram
     def histogram(self) -> Tuple[List[int], float, int]:
@@ -227,11 +293,20 @@ class LatencyStats:
                            p99_us=float(p99), mean_us=float(a.mean()))
         return out
 
-    def emit_summary(self, wall_s: Optional[float] = None) -> Dict[str, float]:
+    def emit_summary(self, wall_s: Optional[float] = None,
+                     tail: int = 8) -> Dict[str, float]:
         """Emit the summary as one ``serve`` ``phase="summary"`` event
-        (no-op when telemetry is off) and return the payload."""
+        plus up to ``tail`` worst-first ``phase="tail"`` exemplar
+        events (no-op when telemetry is off) and return the summary
+        payload.  The tail events are how the exemplars reach the
+        recorded event log the report CLI's ``== tail ==`` section
+        reads — emitted OUTSIDE the stats lock, and BEFORE the summary
+        so the summary stays the run's terminal serve event (drain
+        consumers read ``log.last("serve")`` as the fold)."""
         from ..telemetry import emit
 
         s = self.summary(wall_s)
+        for r in self.tail_exemplars()[:max(int(tail), 0)]:
+            emit("serve", phase="tail", **r)
         emit("serve", phase="summary", **s)
         return s

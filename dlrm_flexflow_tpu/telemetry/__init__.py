@@ -30,6 +30,9 @@ typed, schema-checked events from every layer of the framework:
   * ``storage`` — tiered embedding store admissions, evictions, and
                   miss-stream stalls (storage/tiered.py,
                   docs/storage.md)
+  * ``slo``     — serving SLO evaluations, multi-window burn-rate
+                  breaches, and recoveries (telemetry/slo.py,
+                  docs/slo.md)
 
 Multi-host runs write one ``telemetry_pNNN.jsonl`` sink per process,
 stamped with ``pidx``/``slice`` (``fleet_event_log``); ``report`` on
@@ -60,6 +63,7 @@ from .fleet import (dump_flight_record, find_flight_records,
 from .jax_hooks import compile_stats, install_compile_hooks
 from .rowfreq import RowFreqCounter, hot_rows
 from .schema import SCHEMA, SCHEMA_VERSION, validate_event
+from .slo import SLO, SLOMonitor, parse_slos
 from .trace import (NULL_SPAN, Span, current_span, open_span_records,
                     record_span, span, start_span)
 
@@ -72,5 +76,5 @@ __all__ = [
     "dump_flight_record", "find_flight_records", "fleet_data",
     "fleet_event_log", "fleet_stamp", "load_fleet_events",
     "load_flight_record", "process_sink_path", "RowFreqCounter",
-    "hot_rows",
+    "hot_rows", "SLO", "SLOMonitor", "parse_slos",
 ]

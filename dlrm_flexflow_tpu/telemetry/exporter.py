@@ -29,9 +29,33 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from .metrics import REGISTRY, MetricsRegistry
+from .metrics import REGISTRY, MetricsRegistry, render_exemplars
 
 # ------------------------------------------------------------- HTTP exporter
+
+# /healthz state: "ok" until an SLOMonitor breach flips it to
+# "degraded" (telemetry/slo.py — docs/slo.md).  The degraded reply
+# names the breached objectives and still returns 200: the probe
+# reports QUALITY, not liveness — ElasticController-style automation
+# (ROADMAP item 6) keys off the status field, while an orchestrator's
+# liveness check keeps passing (a breached server must not be killed,
+# it must be scaled).
+_health_lock = threading.Lock()
+_health = {"status": "ok", "reason": ""}
+
+
+def set_health(status: str, reason: str = "") -> None:
+    """Flip the /healthz verdict ("ok" / "degraded" + reason) — called
+    by the SLOMonitor's breach/recover transitions."""
+    with _health_lock:
+        _health["status"] = str(status)
+        _health["reason"] = str(reason)
+
+
+def health() -> dict:
+    """The current /healthz verdict (a copy)."""
+    with _health_lock:
+        return dict(_health)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -48,7 +72,12 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
             try:
-                body = self.server.registry.render().encode("utf-8")
+                # tail exemplars ride after the exposition as comment
+                # lines (worst requests with trace id + dominant
+                # phase — docs/slo.md); a Prometheus parser skips
+                # them, a human or the SLO tooling reads them
+                body = (self.server.registry.render()
+                        + render_exemplars()).encode("utf-8")
             except Exception as e:  # a broken collector must not 500-loop
                 self._reply(500, f"collect failed: {e!r}\n".encode(),
                             "text/plain; charset=utf-8")
@@ -56,7 +85,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
-            self._reply(200, b'{"status": "ok"}\n', "application/json")
+            self._reply(200, (json.dumps(health()) + "\n").encode(),
+                        "application/json")
         else:
             self._reply(404, b"not found\n", "text/plain; charset=utf-8")
 

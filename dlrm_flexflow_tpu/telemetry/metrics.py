@@ -133,6 +133,21 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
         "gauge", "wall microseconds the most recent tiered-store miss "
                  "block stalled streaming cold rows host->device "
                  "(start-all-then-wait — docs/storage.md)"),
+    "dlrm_serve_shed_total": (
+        "counter", "requests shed, labelled by cause: queue_full "
+                   "(batcher queue at capacity), deadline (expired "
+                   "before dispatch), shutdown (rejected while "
+                   "closing / replica lost), saturated (router found "
+                   "every replica queue full) — docs/slo.md; the "
+                   "availability SLO reads this split"),
+    "dlrm_slo_error_budget_pct": (
+        "gauge", "error budget remaining per declared SLO since the "
+                 "monitor started, percent (100 = untouched, 0 = "
+                 "exhausted — telemetry/slo.py, docs/slo.md)"),
+    "dlrm_slo_burn_rate": (
+        "gauge", "worst-window burn rate per declared SLO: observed "
+                 "error rate over budgeted error rate (1.0 = burning "
+                 "exactly the budget — telemetry/slo.py, docs/slo.md)"),
 }
 
 
@@ -211,6 +226,11 @@ class LabeledCounter(Metric):
         self.label = label
         self._fn = fn
 
+    def sample(self) -> Dict[str, float]:
+        """{label_value: value} right now (what a scrape would see) —
+        the SLOMonitor's programmatic read (telemetry/slo.py)."""
+        return dict(self._fn())
+
     def expose(self) -> List[str]:
         return [f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}'
                 for k, v in sorted(self._fn().items())]
@@ -235,6 +255,11 @@ class Histogram(Metric):
         super().__init__(name)
         self.buckets = tuple(buckets)
         self._fn = fn
+
+    def sample(self) -> Tuple[List[float], float, float]:
+        """(cumulative counts per edge + +Inf, sum, count) right now —
+        the SLOMonitor's programmatic read (telemetry/slo.py)."""
+        return self._fn()
 
     def expose(self) -> List[str]:
         cum, total_sum, n = self._fn()
@@ -262,6 +287,11 @@ class LabeledHistogram(Metric):
         self.label = label
         self.buckets = tuple(buckets)
         self._fn = fn
+
+    def sample(self) -> Dict[str, Tuple[List[float], float, float]]:
+        """{label_value: (cumulative counts, sum, count)} right now —
+        the SLOMonitor's per-bucket latency read (telemetry/slo.py)."""
+        return dict(self._fn())
 
     def expose(self) -> List[str]:
         lines: List[str] = []
@@ -296,6 +326,13 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics)
 
+    def get(self, name: str) -> Optional[Metric]:
+        """The registered instrument for ``name`` (None if absent) —
+        the SLOMonitor samples instruments through this instead of
+        parsing the text exposition."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def render(self) -> str:
         """The ``/metrics`` body (Prometheus text format 0.0.4)."""
         with self._lock:
@@ -326,6 +363,10 @@ _live_batchers: "weakref.WeakSet" = weakref.WeakSet()  # queue depth only
 _pending_folds: deque = deque()
 _retired_lock = threading.Lock()
 _retired = {"requests": 0, "rejected": 0, "deadline": 0}
+# shed-by-cause retained base (dlrm_serve_shed_total{cause=} — the
+# availability SLO's denominator split, docs/slo.md); causes beyond
+# the router's "saturated" fold here from LatencyStats.shed_causes()
+_retired_shed_causes: Dict[str, int] = {}
 _retired_hist = [0] * (len(LATENCY_BUCKETS_US) + 1)  # cumulative
 _retired_sum = 0.0
 _retired_count = 0
@@ -365,6 +406,9 @@ def _fold_stats_locked(stats) -> None:
             base[i] += int(c)
         _retired_bucket_sum[b] = _retired_bucket_sum.get(b, 0.0) + float(bs)
         _retired_bucket_n[b] = _retired_bucket_n.get(b, 0) + int(bn)
+    for cause, c in stats.shed_causes().items():
+        _retired_shed_causes[cause] = (_retired_shed_causes.get(cause, 0)
+                                       + int(c))
     _live_stats.discard(stats)
 
 
@@ -418,18 +462,24 @@ def track_engine(engine) -> None:
     weakref.finalize(engine, _finalize_stats, engine.stats)
 
 
-def record_shed_late(stats, kind: str = "rejected") -> None:
+def record_shed_late(stats, kind: str = "rejected",
+                     cause: str = "shutdown") -> None:
     """Count one shed (``kind="rejected"``) or deadline miss
     (``"deadline"``) that may land AFTER its batcher retired (a submit
     racing close): once the stats object is folded its counters are
     invisible to scrapes, so the count goes straight into the retained
     base; before the fold it rides the stats object like any other
-    (lock order retired->stats matches ``_fold_stats_locked``)."""
+    (lock order retired->stats matches ``_fold_stats_locked``).
+    ``cause`` feeds the dlrm_serve_shed_total{cause=} split (deadline
+    misses always count under cause="deadline")."""
     with _retired_lock:
         if getattr(stats, "_metrics_folded", False):
             _retired[kind] += 1
+            key = "deadline" if kind == "deadline" else cause
+            _retired_shed_causes[key] = (
+                _retired_shed_causes.get(key, 0) + 1)
         elif kind == "rejected":
-            stats.record_reject()
+            stats.record_reject(cause=cause)
         else:
             stats.record_deadline_miss()
 
@@ -631,6 +681,72 @@ def _dispatch_buckets() -> Dict[str, float]:
     return out
 
 
+def _shed_causes() -> Dict[str, float]:
+    """Scrape collector for dlrm_serve_shed_total{cause=}: retained
+    base + live LatencyStats sweep for the batcher-level causes
+    (queue_full / deadline / shutdown), plus the router-level
+    "saturated" count — all under the one exactly-once lock, so the
+    labelled split sums to rejected+deadline+router_shed."""
+    with _retired_lock:
+        _drain_pending_locked()
+        _drain_router_pending_locked()
+        out = {k: float(v) for k, v in _retired_shed_causes.items()}
+        for st in _live_stats:
+            for cause, c in st.shed_causes().items():
+                out[cause] = out.get(cause, 0.0) + c
+        sat = float(_retired_router_shed
+                    + sum(c.n for c in _live_shed_cells))
+        if sat:
+            out["saturated"] = out.get("saturated", 0.0) + sat
+    return out
+
+
+def tail_exemplars(limit: int = 10) -> List[dict]:
+    """Worst-first tail exemplars swept from the live LatencyStats
+    (each row: bucket, lat_us, trace_id + the span-derived phase
+    decomposition — serving/stats.py).  Exemplars carry no
+    monotonicity contract, so retired stats contribute nothing; the
+    sweep holds _retired_lock like every other collector and each
+    stats snapshots under its own lock."""
+    rows: List[dict] = []
+    with _retired_lock:
+        _drain_pending_locked()
+        for st in _live_stats:
+            rows.extend(st.tail_exemplars())
+    rows.sort(key=lambda r: -float(r.get("lat_us", 0.0)))
+    return rows[:limit] if limit else rows
+
+
+def render_exemplars(limit: int = 10) -> str:
+    """OpenMetrics-flavoured exemplar lines the exporter appends after
+    the text exposition: one comment line per tail exemplar next to
+    the dlrm_serve_latency_us histogram, carrying the trace id and the
+    dominant attributed phase so a scrape can jump from a p99 spike to
+    the exact slow request (docs/slo.md)."""
+    lines = []
+    for r in tail_exemplars(limit):
+        lines.append(
+            f'# EXEMPLAR dlrm_serve_latency_us'
+            f'{{bucket="{r.get("bucket", "")}",'
+            f'trace_id="{r.get("trace_id", "")}",'
+            f'dominant="{r.get("dominant", "")}"}} '
+            f'{_fmt(r.get("lat_us", 0.0))}')
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _slo_rows(which: str) -> Callable[[], Dict[str, float]]:
+    """Collector factory for the dlrm_slo_* gauge families: defers to
+    telemetry/slo.py at scrape time (lazy import — slo.py imports this
+    module, and a process with no live SLOMonitor exposes no rows)."""
+    def fn() -> Dict[str, float]:
+        try:
+            from . import slo as _slo
+            return _slo.gauge_rows(which)
+        except Exception:
+            return {}
+    return fn
+
+
 # ---------------------------------------------------------- checkpoint age
 _last_ckpt_ts: Optional[float] = None
 
@@ -767,3 +883,13 @@ EMBED_CACHE_HIT_PCT = REGISTRY.register(
     Gauge("dlrm_embed_cache_hit_pct"))
 EMBED_CACHE_MISS_STALL_US = REGISTRY.register(
     Gauge("dlrm_embed_cache_miss_stall_us"))
+# serving SLO engine (telemetry/slo.py — docs/slo.md): the shed split
+# the availability objective reads, plus per-SLO budget/burn gauges
+# whose rows appear with a live SLOMonitor and vanish with it.
+SERVE_SHED = REGISTRY.register(
+    LabeledCounter("dlrm_serve_shed_total", "cause", _shed_causes))
+SLO_ERROR_BUDGET = REGISTRY.register(
+    LabeledGauge("dlrm_slo_error_budget_pct", "slo",
+                 _slo_rows("budget_pct")))
+SLO_BURN_RATE = REGISTRY.register(
+    LabeledGauge("dlrm_slo_burn_rate", "slo", _slo_rows("burn")))

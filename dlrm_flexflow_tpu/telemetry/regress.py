@@ -54,12 +54,15 @@ def lower_is_better(name: str) -> bool:
     and friends gate on the new value RISING past tolerance, where the
     throughput metrics gate on falling.  Host-overhead/stall shares
     (``host_overhead_pct``, ``data_stall_pct`` — docs/pipeline.md) are
-    likewise better when smaller.  Checked per ``:``-qualifier segment
-    (names may carry suffixes like ``:quantize=int8``)."""
+    likewise better when smaller, as are SLO burn rates
+    (``dlrm_slo_burn_rate`` — docs/slo.md: a rising burn spends error
+    budget faster).  Checked per ``:``-qualifier segment (names may
+    carry suffixes like ``:quantize=int8``)."""
     for seg in name.lower().split(":"):
         if (seg.endswith("_ms") or seg.endswith("_us")
                 or "latency" in seg or "_p99" in seg or "_p95" in seg
-                or "_p50" in seg or "overhead" in seg or "stall" in seg):
+                or "_p50" in seg or "overhead" in seg or "stall" in seg
+                or "burn_rate" in seg):
             return True
     return False
 

@@ -536,6 +536,113 @@ def serving_summary(events: List[dict]) -> List[str]:
     return lines
 
 
+#: exemplar phase keys -> the attributed-phase names the tail section
+#: ranks (the order is display order for the breakdown column)
+_TAIL_PHASES = (("queue_wait", "queue_wait_us"), ("pad", "pad_us"),
+                ("engine_forward", "compute_us"),
+                ("miss_stall", "stall_us"))
+
+
+def _tail_rows(events: List[dict]) -> List[dict]:
+    """THE tail-exemplar row selection + ranking (text section and
+    ``report_data`` share it so the two forms can never order
+    differently — the `_per_op_rows` discipline): one row per
+    ``serve`` ``phase="tail"`` exemplar, deduped by trace id (a
+    re-emitted summary must not double a request; the slowest
+    observation wins), ranked by end-to-end latency WORST-FIRST."""
+    latest: Dict[str, dict] = {}
+    anon: List[dict] = []
+    for e in events:
+        if e.get("type") != "serve" or e.get("phase") != "tail":
+            continue
+        tid = e.get("trace_id") or ""
+        if not tid:
+            anon.append(e)
+        elif (tid not in latest
+                or float(e["lat_us"]) > float(latest[tid]["lat_us"])):
+            latest[tid] = e
+    rows = list(latest.values()) + anon
+    rows.sort(key=lambda e: -float(e["lat_us"]))
+    return rows
+
+
+def _tail_phase_ranking(rows: List[dict]) -> List[Tuple[str, float]]:
+    """(phase, attributed us) summed across the exemplar rows,
+    worst-first — the 'what makes the p99 slow' answer both renderers
+    share."""
+    sums = {name: 0.0 for name, _k in _TAIL_PHASES}
+    for e in rows:
+        for name, key in _TAIL_PHASES:
+            sums[name] += float(e.get(key, 0.0))
+    return sorted(sums.items(), key=lambda kv: -kv[1])
+
+
+def tail_summary(events: List[dict]) -> List[str]:
+    """Tail-latency exemplars (serving/stats.py top-K — docs/slo.md):
+    the slowest recorded requests with their span-derived phase
+    decomposition, plus the phase ranking that names what the p99 is
+    made of."""
+    rows = _tail_rows(events)
+    if not rows:
+        return []
+    lines = ["== tail =="]
+    ranking = _tail_phase_ranking(rows)
+    total = sum(v for _n, v in ranking) or 1.0
+    lines.append("p99 contributors by attributed phase (worst-first): "
+                 + ", ".join(f"{n} {100.0 * v / total:.0f}%"
+                             for n, v in ranking))
+    lines.append(f"{'lat(us)':>10s} {'bucket':>7s} {'dominant':>15s} "
+                 f"{'queue(us)':>10s} {'pad(us)':>8s} {'fwd(us)':>10s} "
+                 f"{'stall(us)':>10s}  trace")
+    for e in rows:
+        lines.append(
+            f"{float(e['lat_us']):10.1f} {int(e.get('bucket', 0)):7d} "
+            f"{e.get('dominant', '?'):>15s} "
+            f"{float(e.get('queue_wait_us', 0.0)):10.1f} "
+            f"{float(e.get('pad_us', 0.0)):8.1f} "
+            f"{float(e.get('compute_us', 0.0)):10.1f} "
+            f"{float(e.get('stall_us', 0.0)):10.1f}  "
+            f"{e.get('trace_id', '')}")
+    return lines
+
+
+def slo_summary(events: List[dict]) -> List[str]:
+    """SLO engine readout (telemetry/slo.py — docs/slo.md): per
+    objective, the newest evaluation's budget/burn plus the breach and
+    recover tallies."""
+    slos = [e for e in events if e.get("type") == "slo"]
+    if not slos:
+        return []
+    latest: Dict[str, dict] = {}
+    breaches: Dict[str, int] = {}
+    recovers: Dict[str, int] = {}
+    for e in slos:
+        name = e.get("slo", "?")
+        latest[name] = e
+        if e.get("phase") == "breach":
+            breaches[name] = breaches.get(name, 0) + 1
+        elif e.get("phase") == "recover":
+            recovers[name] = recovers.get(name, 0) + 1
+    lines = ["== slo =="]
+    for name in sorted(latest):
+        e = latest[name]
+        line = (f"{name}: budget {float(e.get('budget_pct', 0.0)):.2f}% "
+                f"remaining, burn fast "
+                f"{float(e.get('burn_fast', 0.0)):.2f} / slow "
+                f"{float(e.get('burn_slow', 0.0)):.2f}")
+        nb, nr = breaches.get(name, 0), recovers.get(name, 0)
+        if nb or nr:
+            line += f" ({nb} breach(es), {nr} recover(s)"
+            doms = [x.get("dominant") for x in slos
+                    if x.get("slo") == name and x.get("phase") == "breach"
+                    and x.get("dominant")]
+            if doms:
+                line += f"; dominant tail phase {doms[-1]}"
+            line += ")"
+        lines.append(line)
+    return lines
+
+
 def span_summary(events: List[dict]) -> List[str]:
     """Span roll-up (telemetry/trace.py): per-name counts and mean
     duration, trace count, and the non-ok status tally — the quick
@@ -732,6 +839,8 @@ SECTIONS = (
     ("tuning", tuning_summary),
     ("resilience", resilience_summary),
     ("serving", serving_summary),
+    ("tail", tail_summary),
+    ("slo", slo_summary),
     ("spans", span_summary),
 )
 
@@ -893,6 +1002,34 @@ def report_data(events: List[dict],
                                      "p99_us", "rejected",
                                      "deadline_misses", "dispatches")
             if k in sums[-1]}
+    tail_rows = _tail_rows(events)
+    if tail_rows:
+        # the SAME selection the text section renders (ordering cannot
+        # drift between --format json and the text table)
+        headline["tail"] = {
+            "rows": [{k: e[k] for k in ("bucket", "lat_us", "trace_id",
+                                        "dominant", "queue_wait_us",
+                                        "pad_us", "compute_us",
+                                        "stall_us")
+                      if k in e}
+                     for e in tail_rows],
+            "phase_ranking": [
+                {"phase": n, "us": v}
+                for n, v in _tail_phase_ranking(tail_rows)]}
+    slos = by.get("slo", [])
+    if slos:
+        latest_slo: Dict[str, dict] = {}
+        for e in slos:
+            latest_slo[e.get("slo", "?")] = e
+        headline["slo"] = {
+            "objectives": {
+                n: {k: e[k] for k in ("phase", "value", "burn_fast",
+                                      "burn_slow", "budget_pct",
+                                      "dominant", "flight")
+                    if k in e}
+                for n, e in sorted(latest_slo.items())},
+            "breaches": sum(1 for e in slos
+                            if e.get("phase") == "breach")}
     spans = by.get("span", [])
     if spans:
         names: Dict[str, int] = {}

@@ -149,8 +149,11 @@ SCHEMA: Dict[str, dict] = {
     },
     # online serving (serving/, docs/serving.md).  ``phase`` selects the
     # sub-shape: one engine dispatch (a padded bucket run), one shed or
-    # deadline-missed request, or the run's latency summary the report
-    # CLI's "== serving ==" section reads.
+    # deadline-missed request, the run's latency summary the report
+    # CLI's "== serving ==" section reads, or one tail exemplar (a
+    # top-K slowest request with its span-derived phase decomposition —
+    # the "== tail ==" section and docs/slo.md read these; ``dominant``
+    # names the phase that contributed the most wall).
     "serve": {
         "required": {"phase": str},
         "optional": {"batch": int, "bucket": int, "padded": int,
@@ -160,12 +163,15 @@ SCHEMA: Dict[str, dict] = {
                      "rejected": int, "deadline_misses": int,
                      "wall_s": float, "qps": float, "p50_us": float,
                      "p95_us": float, "p99_us": float, "mean_us": float,
-                     "replicas": int, "router_shed": int},
+                     "replicas": int, "router_shed": int,
+                     "lat_us": float, "trace_id": str, "pad_us": float,
+                     "stall_us": float, "dominant": str},
         "phases": {
             "dispatch": ("batch", "bucket", "queue_wait_us",
                          "compute_us"),
             "reject": ("reason",),
             "summary": ("requests", "qps"),
+            "tail": ("bucket", "lat_us", "trace_id", "dominant"),
         },
     },
     # one elastic-topology action (elastic/, docs/elastic.md).
@@ -294,6 +300,33 @@ SCHEMA: Dict[str, dict] = {
             "admit": ("admitted", "policy"),
             "evict": ("evicted",),
             "miss": ("misses", "stall_us"),
+        },
+    },
+    # one SLO evaluation tick (telemetry/slo.py — docs/slo.md).
+    # ``phase`` selects the sub-shape: one multi-window burn-rate
+    # evaluation of one declared objective ("eval" — every monitor
+    # tick), a breach verdict ("breach" — a burn window crossed its
+    # threshold; names the objective, the measured windowed bad
+    # fraction, the dominant tail phase, and the flight-record path
+    # when one was dumped), or the return below threshold ("recover").
+    # ``value`` is the windowed bad fraction (latency: share of
+    # requests over threshold; availability: shed share; freshness:
+    # share of stale samples); ``burn_fast``/``burn_slow`` are the
+    # Google-SRE burn rates over the fast/slow windows (observed error
+    # rate over budgeted error rate); ``budget_pct`` is the error
+    # budget remaining since monitor start.
+    "slo": {
+        "required": {"phase": str, "slo": str},
+        "optional": {"kind": str, "value": float, "objective": float,
+                     "burn_fast": float, "burn_slow": float,
+                     "budget_pct": float, "window_s": float,
+                     "dominant": str, "flight": str,
+                     "good": int, "bad": int},
+        "phases": {
+            "eval": ("value", "burn_fast", "burn_slow", "budget_pct"),
+            "breach": ("value", "burn_fast", "budget_pct", "dominant"),
+            "recover": ("value", "burn_fast", "burn_slow",
+                        "budget_pct"),
         },
     },
     # one closed span (telemetry/trace.py) — a Dapper-style timed,

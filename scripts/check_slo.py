@@ -1,0 +1,378 @@
+"""Serving SLO engine smoke matrix (tier-1: tests/test_slo.py runs it).
+
+End-to-end checks of the declarative-SLO loop (telemetry/slo.py —
+docs/slo.md), driven on a fake clock so the whole burn-rate state
+machine runs deterministically in milliseconds:
+
+  1. breach_loop — THE acceptance scenario: a healthy latency stream
+     on one compiled bucket, then a planted 10x-p99 step change with
+     queue-wait-dominated exemplars.  The fast window must trip a
+     breach within 2 evaluation intervals of the step, every emitted
+     ``slo`` event must validate against the schema, exactly ONE
+     parseable flight record must land naming the breached SLO,
+     ``/healthz`` must flip to degraded (and recover), the budget/burn
+     gauge rows must be live in the rendered exposition, and the
+     report's ``== tail ==`` section must rank the planted dominant
+     phase (queue_wait) worst;
+  2. healthy_budget — the same shape with NO planted step: every slo
+     event stays phase ``eval``, less than 1% of the error budget
+     burns, no flight record is dumped, and health stays ok;
+  3. shed_split — the availability objective reads the cause-split
+     ``dlrm_serve_shed_total`` family: planted queue_full/deadline/
+     shutdown sheds (plus post-retirement strays through
+     ``record_shed_late``) must appear under their causes and drive
+     the availability burn over threshold;
+  4. serve_live (slow — gated on ``os.cpu_count()`` in main()) — a
+     real ``InferenceEngine`` + ``DynamicBatcher`` under a threaded
+     ``SLOMonitor`` with an unmeetable latency objective: the monitor
+     must breach from live registry reads, degrade ``/healthz`` on a
+     scraped endpoint next to ``# EXEMPLAR`` lines, and restore health
+     on stop().
+
+Exit 0 when every requested scenario passes; prints one line per
+scenario and exits 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class _StubEngine:
+    """Engine-shaped carrier: track_engine only needs ``.stats`` to
+    register it with the live metrics sweep."""
+
+    def __init__(self):
+        from dlrm_flexflow_tpu.serving.stats import LatencyStats
+
+        self.stats = LatencyStats()
+
+
+def _slo_events(path: str):
+    from dlrm_flexflow_tpu.telemetry.schema import validate_event
+
+    out = []
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("type") == "slo":
+                validate_event(ev)
+                out.append(ev)
+    return out
+
+
+def scenario_breach_loop() -> str:
+    from dlrm_flexflow_tpu.telemetry import (SLO, SLOMonitor, event_log,
+                                             metrics as tmetrics)
+    from dlrm_flexflow_tpu.telemetry import exporter
+    from dlrm_flexflow_tpu.telemetry.report import (load_events,
+                                                    tail_summary)
+
+    stub = _StubEngine()
+    tmetrics.track_engine(stub)
+    BUCKET, HEALTHY_US, BAD_US = 8, 500.0, 5000.0  # planted 10x p99
+    slo = SLO("p99_1ms", "latency", objective=0.99,
+              threshold_us=1000.0, bucket=BUCKET,
+              fast_window_s=2.0, slow_window_s=6.0)
+    clk = [0.0]
+    with tempfile.TemporaryDirectory() as d:
+        tele = os.path.join(d, "telemetry.jsonl")
+        flights = os.path.join(d, "flights")
+        with event_log(tele, mode="w"):
+            mon = SLOMonitor([slo], clock=lambda: clk[0],
+                             flight_dir=flights)
+            try:
+                # healthy regime: 5 ticks of sub-threshold dispatches,
+                # exemplars dominated by queue wait (the planted phase)
+                for k in range(5):
+                    for i in range(20):
+                        stub.stats.record_dispatch(bucket=BUCKET,
+                                                   lat_us=HEALTHY_US)
+                    stub.stats.record_exemplar(
+                        bucket=BUCKET, lat_us=HEALTHY_US,
+                        trace_id=f"t{k}", queue_wait_us=400.0,
+                        pad_us=20.0, compute_us=80.0)
+                    clk[0] += 1.0
+                    mon.tick()
+                assert not mon.breached(), \
+                    f"healthy regime breached: {mon.breached()}"
+                assert mon.breach_count == 0
+                # the step change: 10x the healthy latency on the same
+                # bucket — the fast window must trip within 2 intervals
+                ticks_to_breach = None
+                for k in range(2):
+                    for i in range(20):
+                        stub.stats.record_dispatch(bucket=BUCKET,
+                                                   lat_us=BAD_US)
+                    stub.stats.record_exemplar(
+                        bucket=BUCKET, lat_us=BAD_US,
+                        trace_id=f"bad{k}", queue_wait_us=4000.0,
+                        pad_us=100.0, compute_us=900.0)
+                    clk[0] += 1.0
+                    evs = mon.tick()
+                    if any(e["phase"] == "breach" for e in evs):
+                        ticks_to_breach = k + 1
+                        breach = [e for e in evs
+                                  if e["phase"] == "breach"][0]
+                        break
+                assert ticks_to_breach is not None and \
+                    ticks_to_breach <= 2, \
+                    f"fast window did not trip within 2 intervals"
+                assert breach["slo"] == "p99_1ms"
+                assert breach["dominant"] == "queue_wait", breach
+                assert breach["value"] > 0.4, breach
+                assert exporter.health()["status"] == "degraded", \
+                    exporter.health()
+                assert "p99_1ms" in exporter.health()["reason"]
+                # gauge rows live while breached
+                rendered = tmetrics.REGISTRY.render()
+                assert 'dlrm_slo_burn_rate{slo="p99_1ms"}' in rendered
+                assert ('dlrm_slo_error_budget_pct{slo="p99_1ms"}'
+                        in rendered)
+                # healthy traffic again: the windows drain and the
+                # monitor must emit recover + restore health
+                recovered = False
+                for k in range(12):
+                    for i in range(20):
+                        stub.stats.record_dispatch(bucket=BUCKET,
+                                                   lat_us=HEALTHY_US)
+                    clk[0] += 1.0
+                    evs = mon.tick()
+                    if any(e["phase"] == "recover" for e in evs):
+                        recovered = True
+                        break
+                assert recovered, "no recover after the bad window aged"
+                assert exporter.health()["status"] == "ok"
+                assert mon.breach_count == 1
+            finally:
+                mon.stop()
+            stub.stats.emit_summary()
+        # exactly one parseable flight record naming the breached SLO
+        recs = sorted(os.listdir(flights)) if os.path.isdir(flights) \
+            else []
+        assert len(recs) == 1, f"want exactly 1 flight record: {recs}"
+        with open(os.path.join(flights, recs[0])) as f:
+            doc = json.load(f)
+        named = [e for e in doc.get("events", [])
+                 if e.get("type") == "slo"
+                 and e.get("slo") == "p99_1ms"]
+        assert named, "flight record does not name the breached SLO"
+        assert breach.get("flight", "").endswith(recs[0]), breach
+        # every slo event in the log is schema-valid, and the report's
+        # tail section ranks the planted phase worst
+        slo_evs = _slo_events(tele)
+        phases = {e["phase"] for e in slo_evs}
+        assert phases == {"eval", "breach", "recover"}, phases
+        tail = "\n".join(tail_summary(load_events(tele)))
+        assert "== tail ==" in tail
+        ranking = [ln for ln in tail.splitlines()
+                   if "worst-first" in ln][0]
+        assert ranking.split("): ")[1].startswith("queue_wait"), ranking
+    return (f"breach in {ticks_to_breach} interval(s), "
+            f"{len(slo_evs)} schema-valid slo events, 1 flight "
+            f"record, tail dominated by queue_wait, health "
+            f"degraded+restored")
+
+
+def scenario_healthy_budget() -> str:
+    from dlrm_flexflow_tpu.telemetry import (SLO, SLOMonitor, event_log,
+                                             metrics as tmetrics)
+    from dlrm_flexflow_tpu.telemetry import exporter
+
+    stub = _StubEngine()
+    tmetrics.track_engine(stub)
+    BUCKET = 4
+    slo = SLO("p99_1ms", "latency", objective=0.99,
+              threshold_us=1000.0, bucket=BUCKET,
+              fast_window_s=2.0, slow_window_s=6.0)
+    clk = [0.0]
+    with tempfile.TemporaryDirectory() as d:
+        tele = os.path.join(d, "telemetry.jsonl")
+        flights = os.path.join(d, "flights")
+        with event_log(tele, mode="w"):
+            mon = SLOMonitor([slo], clock=lambda: clk[0],
+                             flight_dir=flights)
+            try:
+                for k in range(7):
+                    for i in range(20):
+                        stub.stats.record_dispatch(bucket=BUCKET,
+                                                   lat_us=500.0)
+                    clk[0] += 1.0
+                    mon.tick()
+                summ = mon.summary()["p99_1ms"]
+                assert summ["budget_pct"] > 99.0, summ
+                assert not summ["breached"]
+                assert exporter.health()["status"] == "ok"
+            finally:
+                mon.stop()
+        assert not os.path.isdir(flights) or not os.listdir(flights), \
+            "healthy run dumped a flight record"
+        slo_evs = _slo_events(tele)
+        phases = {e["phase"] for e in slo_evs}
+        assert phases == {"eval"}, \
+            f"healthy run emitted non-eval phases: {phases}"
+        assert len(slo_evs) == 7
+        budget = slo_evs[-1]["budget_pct"]
+        assert budget > 99.0, f"healthy run burned {100 - budget:.2f}%"
+    return (f"{len(slo_evs)} eval-only events, "
+            f"{100 - budget:.3f}% budget burned, no flight record")
+
+
+def scenario_shed_split() -> str:
+    from dlrm_flexflow_tpu.telemetry import SLO, SLOMonitor, event_log
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+
+    stub = _StubEngine()
+    tmetrics.track_engine(stub)
+    slo = SLO("avail", "availability", objective=0.999,
+              fast_window_s=2.0, slow_window_s=6.0)
+    clk = [0.0]
+    with tempfile.TemporaryDirectory() as d:
+        with event_log(os.path.join(d, "t.jsonl"), mode="w"):
+            mon = SLOMonitor([slo], clock=lambda: clk[0], flight=False)
+            try:
+                # record served traffic so the denominator is real
+                for i in range(100):
+                    stub.stats.record(500.0)
+                clk[0] += 1.0
+                mon.tick()
+                assert not mon.breached()
+                # planted sheds across the causes the family documents
+                for i in range(10):
+                    stub.stats.record_reject(cause="queue_full")
+                for i in range(5):
+                    stub.stats.record_deadline_miss()
+                tmetrics.record_shed_late(stub.stats, cause="shutdown")
+                clk[0] += 1.0
+                mon.tick()
+                sample = tmetrics.SERVE_SHED.sample()
+                for cause, want in (("queue_full", 10), ("deadline", 5),
+                                    ("shutdown", 1)):
+                    assert sample.get(cause, 0) >= want, \
+                        f"{cause}: {sample}"
+                assert "avail" in mon.breached(), \
+                    f"16/116 bad did not breach 99.9%: {mon.summary()}"
+            finally:
+                mon.stop()
+    return (f"causes {sorted(sample)} live on dlrm_serve_shed_total, "
+            f"availability breached on planted sheds")
+
+
+def scenario_serve_live() -> str:
+    """Slow: compiles a real model and lets a THREADED monitor breach
+    from live registry reads while a scrape endpoint watches."""
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    import dlrm_flexflow_tpu as ff
+    from dlrm_flexflow_tpu.apps.dlrm import DLRMConfig, build_dlrm
+    from dlrm_flexflow_tpu.serving import DynamicBatcher, InferenceEngine
+    from dlrm_flexflow_tpu.telemetry import event_log
+    from dlrm_flexflow_tpu.telemetry import exporter
+    from dlrm_flexflow_tpu.telemetry.exporter import start_metrics_server
+    from dlrm_flexflow_tpu.telemetry.slo import SLOMonitor, parse_slos
+
+    T, R, D, BAG = 2, 128, 8, 2
+    cfg = DLRMConfig(sparse_feature_size=D,
+                     embedding_size=[R] * T,
+                     embedding_bag_size=BAG,
+                     mlp_bot=[16, 32, D],
+                     mlp_top=[D * T + D, 32, 1])
+    fc = ff.FFConfig(batch_size=8, serve_buckets="1,8")
+    m = build_dlrm(cfg, fc)
+    m.compile(optimizer=ff.SGDOptimizer(0.01),
+              loss_type="mean_squared_error", metrics=())
+    engine = InferenceEngine(m, m.init(seed=0))
+
+    rng = np.random.default_rng(5)
+    with tempfile.TemporaryDirectory() as d:
+        with event_log(os.path.join(d, "t.jsonl"), mode="w"):
+            batcher = DynamicBatcher(engine)
+            # p99_us=1: a CPU forward cannot make 1 us, so the monitor
+            # must breach purely from live histogram reads
+            mon = SLOMonitor(
+                parse_slos("p99_us=1", fast_window_s=0.2,
+                           slow_window_s=1.0),
+                interval_s=0.05, flight_dir=d).start()
+            srv = start_metrics_server(0)
+            try:
+                for _ in range(30):
+                    batcher.predict({
+                        "dense": rng.standard_normal(
+                            (1, 16)).astype(np.float32),
+                        "sparse": rng.integers(
+                            0, R, size=(1, T, BAG), dtype=np.int64),
+                    })
+                deadline = time.monotonic() + 10.0
+                while (not mon.breached()
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                assert mon.breached() == ["p99_us"], mon.summary()
+                hz = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz",
+                    timeout=10).read().decode())
+                assert hz["status"] == "degraded", hz
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    timeout=10).read().decode()
+                assert 'dlrm_slo_burn_rate{slo="p99_us"}' in body
+                assert "# EXEMPLAR dlrm_serve_latency_us{" in body
+            finally:
+                srv.stop()
+                mon.stop()
+                batcher.close()
+    assert exporter.health()["status"] == "ok", exporter.health()
+    assert mon.breach_count >= 1 and mon.flight_paths
+    return (f"threaded monitor breached a live engine in "
+            f"{mon.breach_count} transition(s), /healthz degraded on "
+            f"the wire, exemplars on /metrics, health restored")
+
+
+FAST = (("breach_loop", scenario_breach_loop),
+        ("healthy_budget", scenario_healthy_budget),
+        ("shed_split", scenario_shed_split))
+#: model-compiling scenarios — main() skips them on starved
+#: single-core containers (same tier-1 budget rule as the examples);
+#: run explicitly with --scenario serve_live
+SLOW = (("serve_live", scenario_serve_live),)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cpus = os.cpu_count() or 1
+    which = dict(FAST + SLOW) if cpus >= 4 else dict(FAST)
+    if "--scenario" in argv:
+        name = argv[argv.index("--scenario") + 1]
+        which = {n: f for n, f in FAST + SLOW if n == name}
+        if not which:
+            print(f"check_slo: unknown scenario {name!r}")
+            return 2
+    failed = 0
+    for name, fn in which.items():
+        try:
+            detail = fn()
+            print(f"check_slo: {name}: OK ({detail})")
+        except BaseException as e:  # noqa: BLE001 — report and count
+            failed += 1
+            import traceback
+            traceback.print_exc()
+            print(f"check_slo: {name}: FAIL "
+                  f"({type(e).__name__}: {e})")
+    if failed:
+        print(f"check_slo: {failed} scenario(s) FAILED")
+        return 1
+    print(f"check_slo: OK ({len(which)} scenarios)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

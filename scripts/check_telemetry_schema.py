@@ -86,7 +86,16 @@ field without the schema and the report CLI seeing it:
      document the subsystem's knobs and entry points, and the regress
      anchor keys must keep the ``:storage=`` suffix so a hot-cache
      run (which pays miss stalls by design) can never gate the
-     fully-resident baseline.
+     fully-resident baseline;
+ 13. SLO contract — the ``slo`` event type must carry the
+     eval/breach/recover phases, the objective gauge families
+     (``dlrm_slo_error_budget_pct``, ``dlrm_slo_burn_rate``) and the
+     cause-split shed counter (``dlrm_serve_shed_total``) must be
+     declared, the burn rate must gate UPWARD in the regress CLI (a
+     rising burn spends budget faster, so it must never read as an
+     improvement), and docs/slo.md must document the spec
+     mini-language, the burn-rate windows, the tail exemplars, and the
+     breach → flight-record flow.
 
 Exit 0 when clean; prints one line per violation and exits 1 otherwise.
 """
@@ -684,6 +693,72 @@ def check_storage_contract(doc_path: str) -> list:
     return errs
 
 
+SLO_PHASES = ("eval", "breach", "recover")
+SLO_FAMILIES = ("dlrm_slo_error_budget_pct", "dlrm_slo_burn_rate",
+                "dlrm_serve_shed_total")
+SLO_DOC_NEEDLES = ("SLO", "SLOMonitor", "parse_slos", "--slo",
+                   "p99_ms", "availability", "freshness",
+                   "burn_fast", "burn_slow", "fast_window_s",
+                   "slow_window_s", "dump_flight_record", "/healthz",
+                   "queue_wait", "engine_forward", "miss_stall",
+                   "dominant", "trace_id",
+                   "dlrm_slo_error_budget_pct", "dlrm_slo_burn_rate",
+                   "dlrm_serve_shed_total")
+SLO_SHED_CAUSES = ("queue_full", "deadline", "shutdown", "saturated")
+
+
+def check_slo_contract(doc_path: str) -> list:
+    """The serving-SLO contract (docs/slo.md): the ``slo`` event
+    phases, the budget/burn gauge families + cause-split shed counter,
+    the burn rate's regress direction, and the documented spec
+    mini-language / exemplar / breach-response surface."""
+    from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+    from dlrm_flexflow_tpu.telemetry import slo as tslo
+    from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+
+    errs = []
+    phases = SCHEMA.get("slo", {}).get("phases") or {}
+    if not phases:
+        errs.append("slo: event type 'slo' missing from the schema "
+                    "(or has no phases) — objective telemetry is gone")
+    for ph in SLO_PHASES:
+        if ph not in phases:
+            errs.append(f"slo: phase {ph!r} missing from the slo "
+                        f"event schema")
+    for name in SLO_FAMILIES:
+        if name not in tmetrics.FAMILIES:
+            errs.append(f"slo: metric family {name!r} not declared in "
+                        f"telemetry.metrics.FAMILIES")
+    if not lower_is_better("dlrm_slo_burn_rate"):
+        errs.append("slo: regress treats dlrm_slo_burn_rate as "
+                    "higher-is-better — a budget-burning regression "
+                    "would read as an improvement")
+    # the spec mini-language serve_bench documents must keep parsing
+    try:
+        parsed = tslo.parse_slos("p99_ms=5,availability=99.9,"
+                                 "freshness=600")
+        kinds = [s.kind for s in parsed]
+        if kinds != ["latency", "availability", "freshness"]:
+            errs.append(f"slo: parse_slos kinds drifted: {kinds}")
+    except Exception as e:
+        errs.append(f"slo: the documented --slo spec no longer "
+                    f"parses: {e}")
+    if not os.path.exists(doc_path):
+        errs.append(f"missing {doc_path} (the documented SLO engine)")
+    else:
+        with open(doc_path) as f:
+            doc = f.read()
+        for needle in SLO_DOC_NEEDLES:
+            if f"`{needle}" not in doc:
+                errs.append(f"docs/slo.md does not document "
+                            f"`{needle}`")
+        for cause in SLO_SHED_CAUSES:
+            if cause not in doc:
+                errs.append(f"docs/slo.md does not document shed "
+                            f"cause {cause!r}")
+    return errs
+
+
 def main() -> int:
     doc = os.path.join(REPO, "docs", "telemetry.md")
     errs = (check_self_consistency()
@@ -703,7 +778,9 @@ def main() -> int:
             + check_fleet_contract(doc)
             + check_recovery_contract()
             + check_storage_contract(os.path.join(REPO, "docs",
-                                                  "storage.md")))
+                                                  "storage.md"))
+            + check_slo_contract(os.path.join(REPO, "docs",
+                                              "slo.md")))
     for e in errs:
         print(f"check_telemetry_schema: {e}")
     if errs:

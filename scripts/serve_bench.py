@@ -30,6 +30,15 @@ inference-only restore) instead of a fresh init; ``--metrics-port N``
 serves live Prometheus metrics at ``http://:N/metrics`` for the run's
 duration (docs/telemetry.md).
 
+``--slo "p99_ms=5,availability=99.9"`` declares serving objectives for
+the run (docs/slo.md): an :class:`SLOMonitor` evaluates multi-window
+burn rates against the live metrics registry while the load runs
+(windows shrunk to bench scale via ``--slo-fast-window`` /
+``--slo-slow-window``), emits schema-checked ``slo`` events into the
+telemetry JSONL, and the end-of-run summary prints remaining error
+budget, the worst burn rate, and the dominant tail phase from the
+latency exemplars.
+
 ``--replicas N`` routes the load through a least-loaded
 :class:`ReplicaRouter` over N batcher replicas (per-replica breakdown
 in the report: dispatched / shed / p99 — the router-absorbs-overload
@@ -279,6 +288,20 @@ def main(argv=None) -> int:
     p.add_argument("--zipf-alpha", type=float, default=1.05,
                    help="zipf exponent for --id-dist zipf (>1; "
                         "higher = more skew)")
+    p.add_argument("--slo", default="",
+                   help='serving objectives for the run, e.g. '
+                        '"p99_ms=5,availability=99.9" (docs/slo.md); '
+                        "monitored at --slo-interval with burn-rate "
+                        "windows shrunk to bench scale, summarized "
+                        "at end of run")
+    p.add_argument("--slo-interval", type=float, default=0.25,
+                   help="--slo evaluation period seconds")
+    p.add_argument("--slo-fast-window", type=float, default=1.0,
+                   help="--slo fast burn-rate window seconds (the "
+                        "SRE default is 60s; a bench run wants the "
+                        "whole state machine inside its wall)")
+    p.add_argument("--slo-slow-window", type=float, default=5.0,
+                   help="--slo slow burn-rate window seconds")
     p.add_argument("--telemetry",
                    default=os.path.join(REPO, "artifacts",
                                         "telemetry_serving.jsonl"))
@@ -348,12 +371,29 @@ def main(argv=None) -> int:
             batcher = ReplicaRouter([engine] * args.replicas)
         else:
             batcher = DynamicBatcher(engine)
+        monitor, slo_sum, slo_dom = None, None, "none"
+        if args.slo:
+            from dlrm_flexflow_tpu.telemetry import slo as slo_mod
+
+            monitor = slo_mod.SLOMonitor(
+                slo_mod.parse_slos(
+                    args.slo, fast_window_s=args.slo_fast_window,
+                    slow_window_s=args.slo_slow_window),
+                interval_s=args.slo_interval).start()
         if args.mode == "closed":
             wall, rejected = closed_loop(batcher, pool, args.clients,
                                          args.requests)
         else:
             wall, rejected = open_loop(batcher, pool, args.qps,
                                        args.duration)
+        if monitor is not None:
+            # one final pass over the drained counters (the thread may
+            # be mid-sleep), then read the tail attribution BEFORE
+            # close() retires the replica stats out of the exemplar sweep
+            monitor.tick()
+            slo_dom = slo_mod.dominant_tail_phase()
+            slo_sum = monitor.summary()
+            monitor.stop()
         summary = batcher.close()  # drains + emits the serve summary
     served = summary["requests"]
     qps = served / max(wall, 1e-9)
@@ -386,6 +426,21 @@ def main(argv=None) -> int:
         print(f"serve_bench:   router shed "
               f"{summary.get('router_shed', 0)} request(s) — a shed "
               f"means ALL {args.replicas} replicas were saturated")
+    if slo_sum:
+        for name in sorted(slo_sum):
+            s = slo_sum[name]
+            state = "BREACHED" if s["breached"] else "ok"
+            print(f"serve_bench: slo {name}: {state}, "
+                  f"{s['budget_pct']:.1f}% error budget remaining, "
+                  f"burn {s['burn']:.2f}x")
+        worst = max(slo_sum.items(), key=lambda kv: kv[1]["burn"])
+        line = (f"serve_bench: slo worst burn {worst[1]['burn']:.2f}x "
+                f"({worst[0]}); dominant tail phase: {slo_dom}")
+        if monitor.breach_count:
+            line += f"; {monitor.breach_count} breach(es)"
+            if monitor.flight_paths:
+                line += f", flight record -> {monitor.flight_paths[-1]}"
+        print(line)
     print(f"serve_bench: telemetry -> {args.telemetry} "
           f"(python -m dlrm_flexflow_tpu.telemetry report "
           f"{os.path.relpath(args.telemetry, os.getcwd())})")

@@ -1,0 +1,418 @@
+"""Serving SLO engine tests (telemetry/slo.py — docs/slo.md): the
+declarative spec mini-language, deterministic fake-clock multi-window
+burn-rate evaluation (no sleeps anywhere), breach/recover transitions
+with flight records and the /healthz flip, exact error-budget
+accounting on synthetic streams, the cause-split shed counter's
+fold-on-retire monotonicity, tail-exemplar selection shared between
+the text and JSON report forms, and the end-to-end smoke matrix
+(scripts/check_slo.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dlrm_flexflow_tpu.serving.stats import LatencyStats
+from dlrm_flexflow_tpu.telemetry import (SLO, SLOMonitor, EventLog,
+                                         parse_slos, set_event_log)
+from dlrm_flexflow_tpu.telemetry import exporter
+from dlrm_flexflow_tpu.telemetry import metrics as tmetrics
+from dlrm_flexflow_tpu.telemetry import slo as tslo
+from dlrm_flexflow_tpu.telemetry.regress import lower_is_better
+from dlrm_flexflow_tpu.telemetry.report import (_tail_rows, report_data,
+                                                tail_summary)
+from dlrm_flexflow_tpu.telemetry.schema import SCHEMA, validate_event
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeClock:
+    """Injectable monotonic clock — tests advance it explicitly."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def step(self, dt: float = 1.0) -> float:
+        self.t += dt
+        return self.t
+
+
+class _Stream:
+    """A scripted cumulative (total, bad) probe: append increments with
+    ``feed``; the monitor reads the running totals."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.bad = 0.0
+
+    def feed(self, n: float, bad: float = 0.0) -> None:
+        self.total += n
+        self.bad += bad
+
+    def __call__(self):
+        return self.total, self.bad
+
+
+def make_monitor(objective=0.99, fast=2.0, slow=10.0, **kw):
+    """One probe-driven monitor on a fake clock (flight off — these
+    tests assert on state, not artifacts)."""
+    stream = _Stream()
+    clock = _FakeClock()
+    slo = SLO("s", "availability", objective=objective,
+              fast_window_s=fast, slow_window_s=slow, probe=stream,
+              **kw)
+    mon = SLOMonitor([slo], clock=clock, flight=False)
+    return mon, stream, clock
+
+
+class TestParseSlos:
+    def test_latency_ms_and_us(self):
+        ms, us = parse_slos("p99_ms=5,p95_us=800")
+        assert ms.kind == "latency" and ms.objective == 0.99
+        assert ms.threshold_us == 5000.0
+        assert us.objective == 0.95 and us.threshold_us == 800.0
+
+    def test_availability_and_freshness(self):
+        a, f, g = parse_slos(
+            "availability=99.9,freshness=600,"
+            "freshness:dlrm_checkpoint_age_s=30")
+        assert a.kind == "availability"
+        assert a.objective == pytest.approx(0.999)
+        assert f.kind == "freshness" and f.max_age_s == 600.0
+        assert f.gauge == "dlrm_strategy_age_s"  # the default
+        assert g.gauge == "dlrm_checkpoint_age_s" and g.max_age_s == 30.0
+
+    def test_window_kw_applies_to_every_slo(self):
+        for s in parse_slos("p99_ms=5,availability=99",
+                            fast_window_s=0.5, slow_window_s=2.0):
+            assert (s.fast_window_s, s.slow_window_s) == (0.5, 2.0)
+
+    def test_rejects_garbage(self):
+        for bad in ("p99=5", "qps=100", "p99_ms", ""):
+            with pytest.raises(ValueError):
+                parse_slos(bad)
+
+    def test_slo_validates_shape(self):
+        with pytest.raises(ValueError, match="kind"):
+            SLO("x", "latencies", 0.99, threshold_us=1.0)
+        with pytest.raises(ValueError, match="objective"):
+            SLO("x", "availability", 99.9)
+        with pytest.raises(ValueError, match="threshold_us"):
+            SLO("x", "latency", 0.99)
+        with pytest.raises(ValueError, match="window"):
+            SLO("x", "availability", 0.99, fast_window_s=5.0,
+                slow_window_s=5.0)
+
+
+class TestBurnRates:
+    def test_healthy_stream_never_trips(self):
+        mon, stream, clock = make_monitor()
+        try:
+            for _ in range(20):
+                stream.feed(100)
+                clock.step()
+                evs = mon.tick()
+                assert [e["phase"] for e in evs] == ["eval"]
+                assert evs[-1]["burn_fast"] == 0.0
+            assert not mon.breached()
+        finally:
+            mon.stop()
+
+    def test_fast_window_trips_before_slow_on_step_change(self):
+        """A step change must page via the FAST window while the slow
+        window is still diluting it — the point of the pair."""
+        mon, stream, clock = make_monitor(fast=2.0, slow=10.0)
+        try:
+            for _ in range(10):
+                stream.feed(100)
+                clock.step()
+                mon.tick()
+            stream.feed(100, bad=30)  # the step change
+            clock.step()
+            evs = mon.tick()
+            breach = [e for e in evs if e["phase"] == "breach"]
+            assert len(breach) == 1, "fast window did not trip in ONE tick"
+            # fast saw 30/200 = 15x budget; slow saw 30/1100 = ~2.7x
+            assert breach[0]["burn_fast"] >= 14.4
+            st = mon._state["s"]
+            assert st.burn_slow < 6.0, \
+                "slow window tripped simultaneously — windows not distinct"
+        finally:
+            mon.stop()
+
+    def test_recover_emits_once_below_both_thresholds(self):
+        mon, stream, clock = make_monitor(fast=2.0, slow=6.0)
+        try:
+            for _ in range(6):
+                stream.feed(100)
+                clock.step()
+                mon.tick()
+            stream.feed(100, bad=50)
+            clock.step()
+            assert any(e["phase"] == "breach" for e in mon.tick())
+            assert mon.breached() == ["s"]
+            phases = []
+            for _ in range(12):
+                stream.feed(100)
+                clock.step()
+                phases += [e["phase"] for e in mon.tick()]
+                if "recover" in phases:
+                    break
+            assert phases.count("recover") == 1
+            assert not mon.breached()
+            # latched: staying healthy emits eval only, no second recover
+            stream.feed(100)
+            clock.step()
+            assert [e["phase"] for e in mon.tick()] == ["eval"]
+        finally:
+            mon.stop()
+
+    def test_window_rotation_is_deterministic(self):
+        """The sample ring keeps exactly one snapshot at/older than the
+        slow window (full-width deltas), pruning the rest."""
+        mon, stream, clock = make_monitor(fast=2.0, slow=5.0)
+        try:
+            for _ in range(20):
+                stream.feed(10)
+                clock.step()
+                mon.tick()
+            samples = mon._state["s"].samples
+            assert samples[0][0] <= clock.t - 5.0
+            assert all(t > clock.t - 5.0 for t, _n, _b in samples[1:])
+            assert len(samples) == 6  # window-start anchor + 5 in-window
+        finally:
+            mon.stop()
+
+    def test_exact_budget_accounting(self):
+        """Lifetime budget since monitor start, computed exactly: 5 bad
+        in 1000 against a 1% budget = half the budget gone."""
+        mon, stream, clock = make_monitor(objective=0.99)
+        try:
+            clock.step()
+            mon.tick()  # baseline sample (0, 0)
+            stream.feed(1000, bad=5)
+            clock.step()
+            evs = mon.tick()
+            assert evs[-1]["budget_pct"] == pytest.approx(50.0)
+            assert mon.rows("budget_pct")["s"] == pytest.approx(50.0)
+            # drive the budget to exhaustion: >= 10 more bad pins at 0
+            stream.feed(1000, bad=100)
+            clock.step()
+            mon.tick()
+            assert mon.rows("budget_pct")["s"] == 0.0
+        finally:
+            mon.stop()
+
+    def test_no_traffic_is_not_an_error(self):
+        mon, stream, clock = make_monitor()
+        try:
+            for _ in range(5):
+                clock.step()
+                evs = mon.tick()  # probe total never moves
+                assert evs[-1]["burn_fast"] == 0.0
+            assert not mon.breached()
+        finally:
+            mon.stop()
+
+
+class TestEventsAndHealth:
+    def test_slo_events_validate_and_carry_windows(self):
+        log = EventLog()
+        prev = set_event_log(log)
+        mon, stream, clock = make_monitor()
+        try:
+            for bad in (0, 0, 50):
+                stream.feed(100, bad=bad)
+                clock.step()
+                mon.tick()
+        finally:
+            mon.stop()
+            set_event_log(prev)
+        evs = log.events("slo")
+        assert evs
+        for e in evs:
+            validate_event(e)
+        breach = [e for e in evs if e["phase"] == "breach"]
+        assert len(breach) == 1
+        assert breach[0]["window_s"] == 2.0
+        assert breach[0]["dominant"]  # attribution always present
+        assert {"eval", "breach"} <= {e["phase"] for e in evs}
+
+    def test_healthz_degrades_and_restores(self):
+        mon, stream, clock = make_monitor()
+        try:
+            stream.feed(100)
+            clock.step()
+            mon.tick()
+            assert exporter.health()["status"] == "ok"
+            stream.feed(100, bad=100)
+            clock.step()
+            mon.tick()
+            h = exporter.health()
+            assert h["status"] == "degraded" and "s" in h["reason"]
+        finally:
+            mon.stop()
+        assert exporter.health()["status"] == "ok"  # stop() restores
+
+    def test_gauge_rows_appear_and_vanish_with_monitor(self):
+        mon, stream, clock = make_monitor()
+        try:
+            stream.feed(100)
+            clock.step()
+            mon.tick()
+            assert tslo.gauge_rows("budget_pct")["s"] == 100.0
+            rendered = tmetrics.REGISTRY.render()
+            assert 'dlrm_slo_error_budget_pct{slo="s"}' in rendered
+            assert 'dlrm_slo_burn_rate{slo="s"}' in rendered
+        finally:
+            mon.stop()
+        assert "s" not in tslo.gauge_rows("budget_pct")
+
+    def test_schema_declares_slo_type(self):
+        spec = SCHEMA["slo"]
+        assert set(spec["phases"]) == {"eval", "breach", "recover"}
+        assert "slo" in spec["required"]
+
+    def test_burn_rate_gates_upward_in_regress(self):
+        assert lower_is_better("dlrm_slo_burn_rate") is True
+        assert lower_is_better("dlrm_slo_error_budget_pct") is False
+
+
+class _StubBatcher:
+    """batcher-shaped carrier for the metrics fold paths."""
+
+    def __init__(self):
+        import queue
+
+        self.stats = LatencyStats()
+        self._q = queue.Queue()
+
+
+class TestShedCauses:
+    def test_cause_split_folds_monotone_on_retire(self):
+        """The labelled shed counter must keep its per-cause counts
+        across a batcher retiring, and post-fold strays must land in
+        the retained base — never lost, never double-counted."""
+        stub = _StubBatcher()
+        tmetrics.track_batcher(stub)
+        stub.stats.record_reject(cause="queue_full")
+        stub.stats.record_reject(cause="queue_full")
+        stub.stats.record_deadline_miss()
+        before = tmetrics.SERVE_SHED.sample()
+        tmetrics.retire_batcher(stub)
+        after = tmetrics.SERVE_SHED.sample()
+        for cause in ("queue_full", "deadline"):
+            assert after.get(cause, 0) >= before.get(cause, 0), \
+                f"{cause} went backwards across retire"
+        # a submit racing close: the stray lands in the retained base
+        tmetrics.record_shed_late(stub.stats, cause="shutdown")
+        tmetrics.record_shed_late(stub.stats, kind="deadline")
+        final = tmetrics.SERVE_SHED.sample()
+        assert final["shutdown"] >= after.get("shutdown", 0) + 1
+        assert final["deadline"] >= after["deadline"] + 1
+
+    def test_exemplars_bounded_top_k(self):
+        stats = LatencyStats()
+        stats.tail_k = 4
+        for i in range(20):
+            stats.record_exemplar(bucket=8, lat_us=float(i),
+                                  trace_id=f"t{i}",
+                                  queue_wait_us=float(i))
+        rows = stats.tail_exemplars()
+        assert len(rows) == 4  # bounded per bucket
+        assert [r["lat_us"] for r in rows] == [19.0, 18.0, 17.0, 16.0]
+        assert all(r["dominant"] == "queue_wait" for r in rows)
+
+
+def _tail_events():
+    mk = lambda tid, lat, **kw: {  # noqa: E731 — table-building helper
+        "type": "serve", "phase": "tail", "ts": 0.0, "bucket": 8,
+        "lat_us": lat, "trace_id": tid, "queue_wait_us": 0.0,
+        "pad_us": 0.0, "compute_us": 0.0, "stall_us": 0.0, **kw}
+    return [mk("a", 100.0, compute_us=90.0),
+            mk("a", 300.0, queue_wait_us=250.0),  # re-emitted, slower
+            mk("b", 200.0, stall_us=150.0),
+            mk("", 50.0, pad_us=40.0)]            # anon: kept as-is
+
+
+class TestTailRows:
+    def test_dedup_keeps_slowest_per_trace(self):
+        rows = _tail_rows(_tail_events())
+        assert [r["lat_us"] for r in rows] == [300.0, 200.0, 50.0]
+        assert rows[0]["trace_id"] == "a"
+
+    def test_text_and_json_share_selection(self):
+        """`--format json` and the text table must agree on rows AND
+        order — both forms read one `_tail_rows` (the `_per_op_rows`
+        discipline)."""
+        events = _tail_events()
+        text = tail_summary(events)
+        data = report_data(events)["tail"]
+        assert text[0] == "== tail =="
+        json_lats = [r["lat_us"] for r in data["rows"]]
+        assert json_lats == [r["lat_us"] for r in _tail_rows(events)]
+        # each JSON row appears in the text table, same order
+        body = "\n".join(text)
+        pos = [body.index(f"{lat:10.1f}") for lat in json_lats]
+        assert pos == sorted(pos)
+        ranking = data["phase_ranking"]
+        assert ranking[0]["phase"] == "queue_wait"  # 250us dominates
+        assert "queue_wait" in text[1]
+
+    def test_slo_section_presence_identical(self):
+        ev = {"type": "slo", "ts": 1.0, "phase": "eval", "slo": "p99",
+              "budget_pct": 97.5, "burn_fast": 0.5, "burn_slow": 0.1}
+        data = report_data([ev])
+        assert data["slo"]["objectives"]["p99"]["budget_pct"] == 97.5
+        assert data["slo"]["breaches"] == 0
+        assert "tail" not in data  # no exemplars, no section — both forms
+
+
+class TestSmokeMatrix:
+    def test_check_slo_passes(self):
+        """The end-to-end acceptance pins live in scripts/check_slo.py:
+        planted 10x p99 trips the fast window within 2 intervals, one
+        flight record names the breached SLO, the healthy twin burns
+        <1% budget."""
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "check_slo.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+        assert "check_slo: OK (" in out.stdout
+
+    def test_check_telemetry_schema_passes(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "check_telemetry_schema.py")],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+
+
+class TestServeBenchFlag:
+    def test_slo_flag_parses_and_summarizes(self):
+        """serve_bench --slo wiring: parse_slos accepts the documented
+        spec with bench-scale windows (the full loop runs in
+        check_slo's serve_live scenario and the slow examples)."""
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import serve_bench
+        finally:
+            sys.path.pop(0)
+        slos = parse_slos("p99_ms=5,availability=99.9",
+                          fast_window_s=1.0, slow_window_s=5.0)
+        assert [s.kind for s in slos] == ["latency", "availability"]
+        # the flag surface exists with bench-scale defaults
+        p_src = open(os.path.join(REPO, "scripts",
+                                  "serve_bench.py")).read()
+        for flag in ("--slo", "--slo-interval", "--slo-fast-window",
+                     "--slo-slow-window"):
+            assert flag in p_src
+        assert hasattr(serve_bench, "main")
