@@ -6,6 +6,8 @@
     python -m dlrm_flexflow_tpu.analysis --changed-only          # vs HEAD
     python -m dlrm_flexflow_tpu.analysis --sarif out.sarif
     python -m dlrm_flexflow_tpu.analysis --update-baseline
+    python -m dlrm_flexflow_tpu.analysis --list-passes
+    python -m dlrm_flexflow_tpu.analysis --explain <waiver-key>
 
 Exit 0 when every finding is clean or waived AND no waiver is stale;
 1 otherwise; 2 on usage errors.  ``-o`` writes the JSON result as an
@@ -30,9 +32,9 @@ import subprocess
 import sys
 
 from .engine import (BaselineError, WAIVER_FILE, Waivers, WaiverError,
-                     all_passes, default_waivers, repo_root,
-                     run_analysis, update_baseline, write_json,
-                     write_sarif)
+                     all_passes, default_waivers, explain_key,
+                     repo_root, run_analysis, update_baseline,
+                     write_json, write_sarif)
 
 
 def changed_paths(repo: str, ref: str):
@@ -67,8 +69,16 @@ def main(argv=None) -> int:
     p.add_argument("--pass", dest="passes", action="append", default=None,
                    metavar="NAME",
                    help="run only this pass (repeatable; see --list)")
-    p.add_argument("--list", action="store_true",
-                   help="list available passes and exit")
+    p.add_argument("--list", "--list-passes", action="store_true",
+                   help="list available passes (name + description) "
+                        "and exit")
+    p.add_argument("--explain", default=None, metavar="WAIVER-KEY",
+                   help="report one waiver key's status (ACTIVE/"
+                        "WAIVED/STALE/UNKNOWN), the findings it "
+                        "matches, and the caller chain into the "
+                        "detail function with each call edge's "
+                        "resolution mechanism — the why behind "
+                        "waiver-key churn (docs/analysis.md)")
     p.add_argument("--format", choices=("text", "json"), default="text",
                    help="findings as text lines (default) or one JSON "
                         "object")
@@ -108,6 +118,15 @@ def main(argv=None) -> int:
     except (WaiverError, OSError) as e:
         print(f"ffcheck: bad waiver file: {e}", file=sys.stderr)
         return 2
+
+    if args.explain is not None:
+        try:
+            print(explain_key(args.explain, waivers=waivers,
+                              repo=repo, roots=args.roots or None))
+        except ValueError as e:
+            print(f"ffcheck: {e}", file=sys.stderr)
+            return 2
+        return 0
 
     if args.update_baseline and (args.passes or args.roots):
         # a subset run sees a subset of findings: every other pass's

@@ -753,6 +753,161 @@ def default_waivers(repo: Optional[str] = None) -> Optional[Waivers]:
     return Waivers.load(path) if os.path.exists(path) else None
 
 
+# ------------------------------------------------------------------ explain
+def _edge_resolution(index: FunctionIndex, caller: ast.AST,
+                     callee: ast.AST) -> Tuple[Optional[int], str]:
+    """(line, mechanism) of the first call in ``caller`` that resolves
+    to ``callee`` — the mechanism names WHY the edge exists, which is
+    exactly what churns waiver keys: a ``self.m()`` edge survives
+    anything outside the class; a project-unique edge dies the day a
+    second class grows a method of the same name; a
+    signature-narrowed edge flips when a call site gains or loses the
+    keyword that disambiguated it (docs/analysis.md "waiver churn")."""
+    mod, qual, cls, def_scope = index.owner[caller]
+    scope = def_scope + (qual.split(".")[-1],)
+    for call in iter_calls(caller):
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if index.resolve_name(mod, scope, fn.id) is callee:
+                return call.lineno, "lexical"
+        elif isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and cls is not None \
+                    and index.resolve_self_method(mod, cls,
+                                                  fn.attr) is callee:
+                return call.lineno, "self-method"
+            if index.resolve_unique_method(fn.attr, call) is callee:
+                cands = index._methods.get(fn.attr, ())
+                return call.lineno, ("project-unique" if len(cands) == 1
+                                     else "signature-narrowed")
+    return None, "lax-combinator"
+
+
+def explain_key(key: str,
+                modules: Optional[List[Module]] = None,
+                waivers: Optional[Waivers] = None,
+                repo: Optional[str] = None,
+                roots: Optional[Sequence[str]] = None) -> str:
+    """A human-readable report on one waiver key: its status
+    (ACTIVE / WAIVED / STALE / UNKNOWN), the findings it matches
+    today, and the reverse caller chain into the detail function with
+    each edge's resolution mechanism — the churn story.  For a key
+    that matches nothing, lists the nearest live keys (same
+    pass+path+code; same pass+detail) so a renamed helper or a
+    resolution flip is a one-look diagnosis.  Raises ValueError on a
+    malformed key or unknown pass."""
+    parts = key.split(":")
+    if len(parts) < 4:
+        raise ValueError(
+            f"malformed waiver key {key!r} (want pass:path:detail:code)")
+    pass_name, path = parts[0], parts[1]
+    code, detail = parts[-1], ":".join(parts[2:-1])
+    registry = all_passes()
+    if pass_name not in registry:
+        raise ValueError(
+            f"unknown pass {pass_name!r} (have: {sorted(registry)})")
+    if modules is None:
+        modules = load_modules(roots=roots, repo=repo)
+    index = FunctionIndex(modules)
+    findings = registry[pass_name]().run(modules, index)
+    matches = [f for f in findings if f.waiver_key == key]
+    if waivers is None:
+        waivers = default_waivers(repo)
+    entry = None
+    if waivers is not None:
+        for k, just, ln in waivers.entries:
+            if k == key:
+                entry = (just, ln)
+                break
+
+    if matches and entry:
+        status = "WAIVED"
+    elif matches:
+        status = "ACTIVE"
+    elif entry:
+        status = "STALE"
+    else:
+        status = "UNKNOWN"
+    lines = [f"{key}", f"  status: {status}"]
+    if entry is not None:
+        src = waivers.path or WAIVER_FILE
+        lines.append(f"  waiver: {src}:{entry[1]} | {entry[0]}")
+    for f in matches:
+        lines.append(f"  finding: {f.path}:{f.line} [{f.code}]")
+        lines.append(f"    {f.message}")
+
+    # the reverse caller chain into the detail function: who reaches
+    # it, one hop per line, each edge naming its resolution mechanism
+    cg = get_callgraph(modules, index)
+    rev: Dict[ast.AST, List[ast.AST]] = {}
+    for caller, edges in cg.edges.items():
+        for callee, _ln, _nm in edges:
+            rev.setdefault(callee, []).append(caller)
+    targets = [n for n, (m, q, _c, _s) in index.owner.items()
+               if q == detail and m.relpath == path]
+    if not targets:
+        targets = [n for n, (m, q, _c, _s) in index.owner.items()
+                   if m.relpath == path and q.endswith("." + detail)]
+    if not targets and "." in detail:
+        # growth/lifecycle details are Class.attr, not a function —
+        # fall back to the class's methods in that file that actually
+        # touch the attribute
+        clsname, _, attr = detail.partition(".")
+
+        def touches(n: ast.AST) -> bool:
+            return any(isinstance(x, ast.Attribute) and x.attr == attr
+                       for x in ast.walk(n))
+        targets = [n for n, (m, q, c, _s) in index.owner.items()
+                   if m.relpath == path and c == clsname and touches(n)]
+    def order(n):
+        m, q, _c, _s = index.owner[n]
+        return (m.relpath, getattr(n, "lineno", 0), q)
+    for t in sorted(targets, key=order)[:3]:
+        _m, tq, _c, _s = index.owner[t]
+        lines.append(f"  chain into {tq}:")
+        callers = sorted(set(rev.get(t, ())), key=order)
+        if not callers:
+            lines.append("    (no resolved callers — an entry point, "
+                         "or reached only as a thread/jit target)")
+        node, hops = t, 0
+        seen = {t}
+        while hops < 10:
+            cs = [c for c in sorted(set(rev.get(node, ())), key=order)
+                  if c not in seen]
+            if not cs:
+                break
+            if hops == 0 and len(callers) > 1:
+                for c in callers[1:][:4]:
+                    cm, cq, _cc, _cs2 = index.owner[c]
+                    ln, how = _edge_resolution(index, c, t)
+                    at = f"{cm.relpath}:{ln}" if ln else cm.relpath
+                    lines.append(f"    <- also called by {cq} "
+                                 f"({at}) [{how}]")
+            c = cs[0]
+            cm, cq, _cc, _cs2 = index.owner[c]
+            ln, how = _edge_resolution(index, c, node)
+            at = f"{cm.relpath}:{ln}" if ln else cm.relpath
+            lines.append(f"    <- called by {cq} ({at}) [{how}]")
+            seen.add(c)
+            node = c
+            hops += 1
+
+    if status in ("STALE", "UNKNOWN"):
+        near = sorted({f.waiver_key for f in findings
+                       if f.path == path and f.code == code})
+        same_detail = sorted({f.waiver_key for f in findings
+                              if f.detail == detail})
+        if not targets:
+            lines.append(f"  note: no function matching {detail!r} in "
+                         f"{path} — renamed, deleted, or the "
+                         f"resolution that reached it flipped")
+        for label, keys in (("nearest (same pass+path+code)", near),
+                            ("nearest (same pass+detail)", same_detail)):
+            for k in keys[:5]:
+                lines.append(f"  {label}: {k}")
+    return "\n".join(lines)
+
+
 def write_json(result: AnalysisResult, path: str) -> None:
     """One ``artifacts/analysis_*.json``-style sink the telemetry
     report CLI's ``== analysis ==`` section reads."""

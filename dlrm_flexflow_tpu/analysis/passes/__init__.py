@@ -3,9 +3,19 @@
 Each pass is one :class:`~..engine.AnalysisPass` subclass grounded in a
 real hazard this codebase has already hit in review:
 
-* ``lock-discipline``   — telemetry emits / blocking I/O / future
-  completion under a held lock, and inconsistent pairwise lock
-  acquisition order (deadlock potential);
+* ``lock-discipline``   — telemetry emits / future completion under a
+  held lock, and inconsistent pairwise lock acquisition order
+  (deadlock potential);
+* ``blocking-under-lock`` — device syncs, sleeps, queue/event waits,
+  and file/socket I/O while any lock is held, lock-held sets carried
+  through calls (the "dispatch under the lock, single wait outside
+  it" serving contract, enforced);
+* ``thread-lifecycle``  — class-owned threads/servers need a
+  reachable join/shutdown+server_close on the close path, non-daemon
+  threads need a join, weakref finalizers must not block;
+* ``bounded-growth``    — ``self.X.append/+=`` reachable from
+  serve/train/monitor loops with no cap/prune/rotate on the class
+  (ring buffer, top-K, keep_n are the sanctioned bounded shapes);
 * ``trace-purity``      — host syncs, side effects, and telemetry emits
   inside functions reachable from jit/AOT-compiled entry points;
 * ``trace-staleness``   — mutable state (self attrs, rebindable
@@ -38,14 +48,19 @@ The engine hands every pass the shared parsed modules, the
 FunctionIndex, and (via ``engine.get_callgraph`` /
 ``engine.get_value_taint``) the interprocedural CallGraph fixed point
 and taint summaries; the SPMD surface (shard_map sites, the
-inside-a-body relation, fence creators) is shared via ``_spmd.py`` —
+inside-a-body relation, fence creators) is shared via ``_spmd.py``;
+the concurrency surface (thread/server ctor sites via ``_threads.py``,
+the lock-held-set walker via ``_locked.py``) is shared the same way —
 build on those instead of re-walking.
 """
 
 from .barrier import BarrierProtocolPass
+from .blocking import BlockingUnderLockPass
 from .divergence import CollectiveDivergencePass
 from .donation import DonationSafetyPass
+from .growth import BoundedGrowthPass
 from .layering import ImportLayeringPass
+from .lifecycle import ThreadLifecyclePass
 from .locks import LockDisciplinePass
 from .meshaxis import MeshAxisPass
 from .purity import TracePurityPass
@@ -55,9 +70,12 @@ from .staleness import TraceStalenessPass
 
 PASSES = [
     LockDisciplinePass,
+    BlockingUnderLockPass,
     TracePurityPass,
     TraceStalenessPass,
     SharedStatePass,
+    ThreadLifecyclePass,
+    BoundedGrowthPass,
     RecompileHazardPass,
     DonationSafetyPass,
     ImportLayeringPass,
@@ -66,8 +84,9 @@ PASSES = [
     BarrierProtocolPass,
 ]
 
-__all__ = ["PASSES", "LockDisciplinePass", "TracePurityPass",
-           "TraceStalenessPass", "SharedStatePass",
+__all__ = ["PASSES", "LockDisciplinePass", "BlockingUnderLockPass",
+           "TracePurityPass", "TraceStalenessPass", "SharedStatePass",
+           "ThreadLifecyclePass", "BoundedGrowthPass",
            "RecompileHazardPass", "DonationSafetyPass",
            "ImportLayeringPass", "CollectiveDivergencePass",
            "MeshAxisPass", "BarrierProtocolPass"]

@@ -14,9 +14,6 @@ convention — earned through review fixes, see serving/batcher.py's
   ``set_result``/``set_exception`` wakes a waiter that may immediately
   call back into the subsystem (resubmit, close) and deadlock or
   contend on the very lock still held;
-* **no blocking calls under a lock** (``blocking-under-lock``): file
-  I/O, ``sleep``, ``Thread.join``, ``block_until_ready`` — anything
-  that parks the holder parks every other thread needing the lock;
 * **consistent pairwise acquisition order** (``lock-order``): if one
   code path takes A then B and another takes B then A, two threads can
   deadlock; the pass builds the acquired-while-holding graph (direct
@@ -26,7 +23,11 @@ Effects propagate through the engine's interprocedural
 :class:`~..engine.CallGraph` fixed point (bounded depth, cycle-safe):
 holding a lock while calling a helper whose helper's helper emits is
 the same bug as emitting inline, and is flagged at the outermost call
-site where the lock is held.
+site where the lock is held.  Blocking calls under a lock (sleep,
+device syncs, queue waits, file/socket I/O) moved to the dedicated
+``blocking-under-lock`` pass (``blocking.py``) in v4 — it reports at
+the blocking SITE with the caller's held set carried in, instead of at
+the outer call site.
 
 Lock identity: module-level locks are ``<module>.<name>``, instance
 locks are ``<Class>.<attr>`` (resolved via the enclosing class, or by
@@ -51,12 +52,6 @@ EMIT_NAMES = frozenset({"emit", "emit_summary", "sample_memory",
 #: attribute calls that complete a future / wake a waiter
 FUTURE_NAMES = frozenset({"set_result", "set_exception", "_set",
                           "_set_exception"})
-#: blocking calls (bare names)
-BLOCKING_NAMES = frozenset({"open", "print"})
-#: blocking calls (attribute names)
-BLOCKING_ATTRS = frozenset({"sleep", "write", "flush", "read", "join",
-                            "serve_forever", "block_until_ready",
-                            "readline"})
 
 
 def _short(modname: str) -> str:
@@ -154,31 +149,25 @@ class _Effects:
 
 
 def _classify_call(call: ast.Call) -> Optional[Tuple[str, str]]:
-    """(kind, what) when this call is emit/future/blocking, else None."""
+    """(kind, what) when this call is an emit / future completion,
+    else None (blocking calls are the blocking-under-lock pass's
+    domain now)."""
     fn = call.func
     if isinstance(fn, ast.Name):
         if fn.id in EMIT_NAMES:
             return "emit", f"{fn.id}()"
-        if fn.id in BLOCKING_NAMES:
-            return "blocking", f"{fn.id}()"
     elif isinstance(fn, ast.Attribute):
         if fn.attr in EMIT_NAMES:
             return "emit", f".{fn.attr}()"
         if fn.attr in FUTURE_NAMES:
             return "future", f".{fn.attr}()"
-        if fn.attr in BLOCKING_ATTRS:
-            # "sep".join(parts) is str.join, not Thread.join
-            if fn.attr == "join" and isinstance(fn.value, ast.Constant):
-                return None
-            return "blocking", f".{fn.attr}()"
     return None
 
 
 class LockDisciplinePass(AnalysisPass):
     name = "lock-discipline"
-    description = ("no telemetry emit / future completion / blocking "
-                   "call while a lock is held; consistent pairwise "
-                   "lock order")
+    description = ("no telemetry emit / future completion while a "
+                   "lock is held; consistent pairwise lock order")
 
     def run(self, modules: List[Module],
             index: FunctionIndex) -> List[Finding]:
@@ -244,8 +233,7 @@ class LockDisciplinePass(AnalysisPass):
                         continue
                     seen_kinds.add(kind)
                     verb = {"emit": "emits telemetry",
-                            "future": "completes a future",
-                            "blocking": "blocks"}[kind]
+                            "future": "completes a future"}[kind]
                     findings.append(self.finding(
                         mod.relpath, line, f"{kind}-under-lock",
                         f"call to {cname}() {verb} ({what}) while "

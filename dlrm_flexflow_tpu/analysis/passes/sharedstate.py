@@ -11,15 +11,16 @@ attribute shared between a thread body and the public API is either
 * a thread-safe primitive (``queue.Queue``, ``threading.Event``, ...),
 * or protected by ONE lock both sides hold.
 
-This pass machine-checks that: thread entry points are discovered from
-``threading.Thread(target=...)`` constructor sites (the target resolves
-like any call — ``self._loop``, a bare name, or a unique/signature-
-narrowed method), the attribute read/write sets reachable from them
-(interprocedural, lock-held sets carried through calls, reusing
-``locks.py``'s lock discovery) are compared against the sets reachable
-from the same classes' public methods, and an attribute touched on both
-sides — with at least one write — where some thread-side access and
-some public-side access hold NO common lock is a finding.
+This pass machine-checks that: thread entry points come from the
+shared ctor-site inventory (``_threads.py`` — the target resolves like
+any call: ``self._loop``, a bare name, or a unique/signature-narrowed
+method), the attribute read/write sets reachable from them
+(interprocedural, lock-held sets carried through calls via the shared
+``_locked.py`` walker over ``locks.py``'s lock discovery) are compared
+against the sets reachable from the same classes' public methods, and
+an attribute touched on both sides — with at least one write — where
+some thread-side access and some public-side access hold NO common
+lock is a finding.
 
 Code: ``unlocked-shared-attr``.  The deliberate exceptions (the
 engine's double-checked bucket-cache read, GIL-atomic by construction)
@@ -34,6 +35,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..engine import (AnalysisPass, Finding, FunctionIndex, Module,
                       get_callgraph)
+from ._locked import walk_under_locks
+from ._threads import thread_entry_notes
 from .locks import get_lock_table
 
 #: constructor callees whose instances are thread-safe by design — an
@@ -49,8 +52,6 @@ MUTATORS = frozenset({
     "append", "appendleft", "add", "update", "setdefault", "pop",
     "popleft", "clear", "extend", "remove", "discard", "insert",
     "sort"})
-
-_MAX_DEPTH = 8
 
 
 class _Access:
@@ -79,15 +80,16 @@ class SharedStatePass(AnalysisPass):
         self._locks = get_lock_table(modules, index)
         self._cg = get_callgraph(modules, index)
 
-        thread_entries = self._thread_entries(modules, index)
+        thread_entries = set(thread_entry_notes(modules, index))
         if not thread_entries:
             return []
 
         # accesses reachable from the thread targets
         thread_acc: List[_Access] = []
         seen: Set[Tuple[ast.AST, frozenset]] = set()
-        for entry in thread_entries:
-            self._collect(entry, frozenset(), 0, thread_acc, seen)
+        for entry in sorted(thread_entries,
+                            key=lambda n: getattr(n, "lineno", 0)):
+            self._collect(entry, thread_acc, seen)
 
         # the classes a thread touches; their public surface is the
         # other side of the race
@@ -99,7 +101,7 @@ class SharedStatePass(AnalysisPass):
         public_acc: List[_Access] = []
         seen = set()
         for entry in public_entries:
-            self._collect(entry, frozenset(), 0, public_acc, seen)
+            self._collect(entry, public_acc, seen)
 
         exempt = self._exempt_attrs(modules)
         by_key_t: Dict[Tuple[str, str], List[_Access]] = {}
@@ -144,45 +146,6 @@ class SharedStatePass(AnalysisPass):
         return findings
 
     # ------------------------------------------------------------ discovery
-    @staticmethod
-    def _is_thread_ctor(call: ast.Call) -> bool:
-        fn = call.func
-        return (isinstance(fn, ast.Attribute) and fn.attr == "Thread") \
-            or (isinstance(fn, ast.Name) and fn.id == "Thread")
-
-    def _thread_entries(self, modules: List[Module],
-                        index: FunctionIndex) -> Set[ast.AST]:
-        """Targets of every ``threading.Thread(target=...)`` site."""
-        entries: Set[ast.AST] = set()
-        for node, (mod, qual, cls, def_scope) in index.owner.items():
-            scope = def_scope + (qual.split(".")[-1],)
-            for call in ast.walk(node):
-                if not isinstance(call, ast.Call) \
-                        or not self._is_thread_ctor(call):
-                    continue
-                target = None
-                for kw in call.keywords:
-                    if kw.arg == "target":
-                        target = kw.value
-                if target is None and call.args:
-                    target = call.args[0]
-                if target is None:
-                    continue
-                t = None
-                if isinstance(target, ast.Name):
-                    t = index.resolve_name(mod, scope, target.id)
-                elif isinstance(target, ast.Attribute):
-                    if isinstance(target.value, ast.Name) \
-                            and target.value.id == "self" \
-                            and cls is not None:
-                        t = index.resolve_self_method(mod, cls,
-                                                      target.attr)
-                    if t is None:
-                        t = index.resolve_unique_method(target.attr)
-                if t is not None:
-                    entries.add(t)
-        return entries
-
     def _exempt_attrs(self, modules: List[Module]
                       ) -> Set[Tuple[str, str]]:
         """(class, attr) initialized to a thread-safe primitive."""
@@ -209,74 +172,45 @@ class SharedStatePass(AnalysisPass):
         return out
 
     # ----------------------------------------------------------- collection
-    def _collect(self, fn_node: ast.AST, inherited: frozenset,
-                 depth: int, out: List[_Access],
+    def _collect(self, fn_node: ast.AST, out: List[_Access],
                  seen: Set[Tuple[ast.AST, frozenset]]) -> None:
         """Record every ``self.X`` access reachable from ``fn_node``
-        with the lock set held at that point (caller-held locks carried
-        into callees — that is what makes the InferenceEngine's
-        under-lock write visible as locked even when the lock was taken
-        one frame up)."""
-        if depth > _MAX_DEPTH or (fn_node, inherited) in seen \
-                or fn_node not in self._index.owner:
-            return
-        seen.add((fn_node, inherited))
-        mod, qual, cls, def_scope = self._index.owner[fn_node]
-        if qual.split(".")[-1] in ("__init__", "__new__"):
-            return  # construction runs before any thread exists
-        scope = def_scope + (qual.split(".")[-1],)
+        with the lock set held at that point — the shared ``_locked``
+        walker carries caller-held locks into callees, which is what
+        makes the InferenceEngine's under-lock write visible as locked
+        even when the lock was taken one frame up."""
 
-        def visit(node, held: frozenset):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda, ast.ClassDef)):
-                return  # deferred body: runs later, locks released
-            if isinstance(node, ast.With):
-                cur = held
-                for item in node.items:
-                    lid = self._locks.resolve(item.context_expr, mod,
-                                              cls)
-                    if lid is not None:
-                        cur = cur | {lid}
-                    else:
-                        visit(item.context_expr, cur)
-                for stmt in node.body:
-                    visit(stmt, cur)
+        def on_node(node, held, _where, ctx):
+            _mod, qual, cls = ctx
+            if cls is None:
                 return
+            path = _mod.relpath
             if isinstance(node, ast.Attribute) \
                     and isinstance(node.value, ast.Name) \
-                    and node.value.id == "self" and cls is not None:
+                    and node.value.id == "self":
                 kind = "write" if isinstance(node.ctx,
                                              (ast.Store, ast.Del)) \
                     else "read"
-                out.append(_Access(cls, node.attr, kind, mod.relpath,
+                out.append(_Access(cls, node.attr, kind, path,
                                    node.lineno, qual, held))
             if isinstance(node, ast.Subscript) \
                     and isinstance(node.ctx, (ast.Store, ast.Del)) \
                     and isinstance(node.value, ast.Attribute) \
                     and isinstance(node.value.value, ast.Name) \
-                    and node.value.value.id == "self" \
-                    and cls is not None:
+                    and node.value.value.id == "self":
                 # self._cache[k] = v mutates the container
                 out.append(_Access(cls, node.value.attr, "write",
-                                   mod.relpath, node.lineno, qual,
-                                   held))
+                                   path, node.lineno, qual, held))
             if isinstance(node, ast.Call):
                 fn = node.func
                 if isinstance(fn, ast.Attribute) \
                         and fn.attr in MUTATORS \
                         and isinstance(fn.value, ast.Attribute) \
                         and isinstance(fn.value.value, ast.Name) \
-                        and fn.value.value.id == "self" \
-                        and cls is not None:
+                        and fn.value.value.id == "self":
                     # self._buf.append(x) mutates the container
                     out.append(_Access(cls, fn.value.attr, "write",
-                                       mod.relpath, node.lineno, qual,
-                                       held))
-                target = self._index.resolve_call(node, mod, scope, cls)
-                if target is not None and target is not fn_node:
-                    self._collect(target, held, depth + 1, out, seen)
-            for child in ast.iter_child_nodes(node):
-                visit(child, held)
+                                       path, node.lineno, qual, held))
 
-        for child in ast.iter_child_nodes(fn_node):
-            visit(child, inherited)
+        walk_under_locks(fn_node, self._index, self._locks, on_node,
+                         seen=seen, skip_init=True)

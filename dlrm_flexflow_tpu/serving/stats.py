@@ -253,11 +253,15 @@ class LatencyStats:
     def percentile(self, p: float) -> Optional[float]:
         """The p-th percentile (0..100) of recorded latencies in us, by
         linear interpolation between closest ranks; None with no
-        samples."""
+        samples.  The lock covers only the list snapshot — the numpy
+        conversion and rank math run outside it (ffcheck
+        blocking-under-lock: record() on the hot path must never wait
+        behind percentile arithmetic)."""
         with self._lock:
             if not self._lat_us:
                 return None
-            return float(np.percentile(np.asarray(self._lat_us), p))
+            lat = self._lat_us[:]
+        return float(np.percentile(np.asarray(lat), p))
 
     @property
     def mean_us(self) -> Optional[float]:
@@ -269,12 +273,13 @@ class LatencyStats:
     def summary(self, wall_s: Optional[float] = None) -> Dict[str, float]:
         """The ``serve`` summary-event payload: request count, QPS over
         ``wall_s`` (default: since construction), and the latency
-        percentiles.  ONE locked pass: counters and samples snapshot
+        percentiles.  ONE locked pass snapshots counters and samples
         together (a racing record() can't pair one instant's count with
-        another's percentiles) and the buffer converts once for all
-        three percentiles + the mean.  Fields with nothing to report
-        are absent — the telemetry layer drops None-valued fields the
-        same way."""
+        another's percentiles); the buffer then converts once for all
+        three percentiles + the mean OUTSIDE the lock (ffcheck
+        blocking-under-lock — percentile math must not park the hot
+        path's record()).  Fields with nothing to report are absent —
+        the telemetry layer drops None-valued fields the same way."""
         if wall_s is None:
             wall_s = time.perf_counter() - self._t0
         with self._lock:
@@ -286,11 +291,12 @@ class LatencyStats:
                 "rejected": int(self.rejected),
                 "deadline_misses": int(self.deadline_misses),
             }
-            if self._lat_us:
-                a = np.asarray(self._lat_us)
-                p50, p95, p99 = np.percentile(a, [50, 95, 99])
-                out.update(p50_us=float(p50), p95_us=float(p95),
-                           p99_us=float(p99), mean_us=float(a.mean()))
+            lat = self._lat_us[:]
+        if lat:
+            a = np.asarray(lat)
+            p50, p95, p99 = np.percentile(a, [50, 95, 99])
+            out.update(p50_us=float(p50), p95_us=float(p95),
+                       p99_us=float(p99), mean_us=float(a.mean()))
         return out
 
     def emit_summary(self, wall_s: Optional[float] = None,

@@ -240,6 +240,10 @@ class SLOMonitor:
     land (default: dump_flight_record's own artifacts/ policy);
     ``flight`` disables the dump entirely when False."""
 
+    #: in-memory breach flight-record paths retained (the newest); the
+    #: record FILES are never deleted — this bounds only the list
+    KEEP_FLIGHT_PATHS = 16
+
     def __init__(self, slos: List[SLO], interval_s: float = 1.0,
                  clock: Callable[[], float] = time.monotonic,
                  registry: Optional[tmetrics.MetricsRegistry] = None,
@@ -417,6 +421,12 @@ class SLOMonitor:
                     ev["flight"] = path
                     with self._lock:
                         self.flight_paths.append(path)
+                        # keep the recent records only: a flapping
+                        # objective breaches every tick for hours and
+                        # this list lives as long as the process
+                        # (ffcheck bounded-growth); the files stay on
+                        # disk, operators list flight_dir for history
+                        del self.flight_paths[:-self.KEEP_FLIGHT_PATHS]
             events.append(ev)
         for ev in events:
             emit("slo", **ev)

@@ -4,7 +4,7 @@ End-to-end scenarios for the static-analysis suite — the analysis
 analogue of ``check_serving.py``/``check_observability.py``
 (docs/analysis.md):
 
-  1. repo clean-or-waived — all 10 passes over the real tree with the
+  1. repo clean-or-waived — all 13 passes over the real tree with the
      committed ``ANALYSIS_WAIVERS.txt`` report zero unwaived findings
      and zero stale waivers (the CI gate);
   2. injected violation — an emit-under-lock snippet seeded into a
@@ -27,10 +27,19 @@ analogue of ``check_serving.py``/``check_observability.py``
      mesh-axis;
   9. injected barrier-protocol bugs — an unswept fence, a retry loop
      around the single-attempt barrier, and a non-process-0 manifest
-     write each fire, while the full podshard shape stays silent.
+     write each fire, while the full podshard shape stays silent;
+ 10. injected blocking-under-lock — a device sync reached through a
+     helper called under a lock fires at the blocking SITE, while the
+     dispatch-under-lock/wait-outside serving contract stays silent;
+ 11. injected thread-lifecycle — a started thread with no join on the
+     close path and a shutdown-only server both fire, while the
+     daemon-scrape-with-full-teardown shape stays silent;
+ 12. injected bounded-growth — an uncapped append on a thread-target
+     loop fires, while the deque(maxlen=) ring and the len-guard
+     reservoir stay silent.
 
-Exit 0 when every scenario passes; prints one line per scenario and
-exits 1 otherwise.
+(The clean-or-waived scenario runs all 13 passes.)  Exit 0 when every
+scenario passes; prints one line per scenario and exits 1 otherwise.
 """
 
 from __future__ import annotations
@@ -342,6 +351,158 @@ def scenario_injected_barrier() -> str:
     return ""
 
 
+#: a blocking call laundered through a helper under a lock, next to
+#: the sanctioned dispatch-under-lock/single-wait-outside contract
+BLOCKING_SNIPPET = '''\
+import threading
+
+
+class Broken:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _sync(self, y):
+        y.block_until_ready()
+
+    def step(self, y):
+        with self._lock:
+            self._sync(y)
+
+
+class Sanctioned:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = None
+
+    def step(self, x):
+        with self._lock:
+            self._out = x * 2
+            y = self._out
+        y.block_until_ready()
+        return y
+'''
+
+#: a joinless thread + a shutdown-only server, next to the full
+#: daemon-scrape teardown shape that must stay silent
+LIFECYCLE_SNIPPET = '''\
+import threading
+from http.server import ThreadingHTTPServer
+
+
+class Broken:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+        self._srv = ThreadingHTTPServer(("", 0), None)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._srv.shutdown()
+
+
+class Sanctioned:
+    def start(self):
+        self._srv = ThreadingHTTPServer(("", 0), None)
+        self._t = threading.Thread(target=self._srv.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._t.join(timeout=2.0)
+'''
+
+#: an uncapped append on a monitor-thread loop, next to the ring and
+#: reservoir shapes that must stay silent
+GROWTH_SNIPPET = '''\
+import threading
+from collections import deque
+
+
+class Broken:
+    def __init__(self):
+        self.paths = []
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        self.paths.append("x")
+
+    def stop(self):
+        self._t.join()
+
+
+class Sanctioned:
+    def __init__(self, cap):
+        self._ring = deque(maxlen=cap)
+        self._lat = []
+        self.cap = cap
+
+    def predict(self, v):
+        self._ring.append(v)
+        if len(self._lat) < self.cap:
+            self._lat.append(v)
+        else:
+            self._lat[0] = v
+'''
+
+
+def scenario_injected_blocking() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, BLOCKING_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["blocking-under-lock"])
+        hits = [f for f in res.findings if f.path == rel]
+        if [f.code for f in hits] != ["device-sync-under-lock"]:
+            return ("wanted exactly the laundered device sync, got "
+                    f"{[f.format() for f in res.findings]}")
+        if hits[0].line != 9 or hits[0].detail != "Broken._sync":
+            return (f"finding at line {hits[0].line} in "
+                    f"{hits[0].detail!r}; wanted the blocking SITE "
+                    f"(line 9, Broken._sync)")
+        if "Sanctioned" in "".join(f.detail for f in hits):
+            return "the dispatch/wait-outside contract fired"
+    return ""
+
+
+def scenario_injected_lifecycle() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, LIFECYCLE_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["thread-lifecycle"])
+        broken = sorted((f.code, f.line) for f in res.findings
+                        if f.path == rel and "Broken" in f.detail)
+        if broken != [("server-no-close", 9), ("thread-no-join", 7)]:
+            return ("Broken should fire thread-no-join@7 + "
+                    f"server-no-close@9, got {broken}")
+        good = [f for f in res.findings if "Sanctioned" in f.detail]
+        if good:
+            return ("the daemon-scrape teardown shape fired: "
+                    f"{[f.format() for f in good]}")
+    return ""
+
+
+def scenario_injected_growth() -> str:
+    with tempfile.TemporaryDirectory(prefix="ffcheck_smoke_") as root:
+        rel = _mini_tree(root, GROWTH_SNIPPET)
+        res = run_analysis(repo=root, roots=["dlrm_flexflow_tpu"],
+                           pass_names=["bounded-growth"])
+        hits = [f for f in res.findings if f.path == rel]
+        if [(f.code, f.line) for f in hits] != [("unbounded-growth",
+                                                 14)]:
+            return ("wanted exactly Broken.paths@14, got "
+                    f"{[f.format() for f in res.findings]}")
+        if hits[0].detail != "Broken.paths":
+            return (f"fired on {hits[0].detail!r} — the ring and "
+                    f"reservoir shapes must stay silent")
+    return ""
+
+
 SCENARIOS = [
     ("repo clean or waived", scenario_repo_clean),
     ("injected violation fires", scenario_injected_violation),
@@ -352,6 +513,9 @@ SCENARIOS = [
     ("injected divergence fires", scenario_injected_divergence),
     ("injected axis bugs fire", scenario_injected_axis),
     ("injected barrier bugs fire", scenario_injected_barrier),
+    ("injected blocking fires", scenario_injected_blocking),
+    ("injected lifecycle bugs fire", scenario_injected_lifecycle),
+    ("injected growth fires", scenario_injected_growth),
 ]
 
 

@@ -1,0 +1,92 @@
+"""ffcheck wall-clock budget gate (tier-1: tests/test_analysis.py).
+
+The analysis suite is a pre-commit/CI gate: it earns its keep only
+while a whole-tree run stays interactive.  This script times one full
+13-pass run over the real repo — shared parse, shared FunctionIndex,
+shared CallGraph, exactly what ``python -m dlrm_flexflow_tpu.analysis``
+does — and FAILS when it exceeds ``BUDGET_S``.  The per-pass breakdown
+prints every run, so the pass that regressed is named, not inferred:
+a new pass that re-walks the tree instead of reusing the cached
+surfaces (engine.get_callgraph, _spmd.py, _threads.py, _locked.py)
+shows up here as an outlier long before it annoys anyone at a prompt.
+
+Budget: 30s wall for everything — parse, index, all 13 passes, waiver
+matching — on the slowest machine tier-1 runs on (single-core CI
+containers; a dev laptop sits well under half of this).
+
+Exit 0 under budget (prints the breakdown), 1 over it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dlrm_flexflow_tpu.analysis import (FunctionIndex,  # noqa: E402
+                                        default_waivers, load_modules)
+from dlrm_flexflow_tpu.analysis.engine import all_passes  # noqa: E402
+
+#: whole-run wall budget, seconds (docs/analysis.md)
+BUDGET_S = 30.0
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    modules = load_modules(repo=REPO)
+    t_load = time.perf_counter() - t0
+
+    # per-pass timing over ONE shared index — the same sharing the
+    # real runner does, so the numbers are the numbers users see
+    index = FunctionIndex(modules)
+    registry = all_passes()
+    per_pass = []
+    findings = []
+    for name in sorted(registry):
+        t1 = time.perf_counter()
+        fs = registry[name]().run(modules, index)
+        per_pass.append((time.perf_counter() - t1, name, len(fs)))
+        findings.extend(fs)
+
+    # the waiver-matching tail of run_analysis, on the SAME findings
+    # (a second end-to-end run would just re-pay the pass sweep — the
+    # gate holds parse + index + every pass + matching, once)
+    t2 = time.perf_counter()
+    waivers = default_waivers(REPO)
+    active = [f for f in findings
+              if waivers is None or waivers.match(f) is None]
+    unused = waivers.unused() if waivers is not None else []
+    ok = not active and not unused
+    n_waived = len(findings) - len(active)
+    t_match = time.perf_counter() - t2
+    total = time.perf_counter() - t0
+
+    print(f"check_analysis_budget: parse+load {t_load:6.2f}s "
+          f"({len(modules)} modules)")
+    for dt, name, n in sorted(per_pass, reverse=True):
+        print(f"check_analysis_budget:   {name:22s} {dt:6.2f}s "
+              f"({n} raw finding(s))")
+    print(f"check_analysis_budget: waivers   {t_match:6.2f}s "
+          f"(ok={ok}, {n_waived} waived)")
+    print(f"check_analysis_budget: total     {total:6.2f}s "
+          f"(budget {BUDGET_S:.0f}s)")
+
+    if not ok:
+        print("check_analysis_budget: FAIL — the run is not "
+              "clean-or-waived; fix findings before timing them")
+        return 1
+    if total > BUDGET_S:
+        print(f"check_analysis_budget: FAIL — {total:.2f}s over the "
+              f"{BUDGET_S:.0f}s budget; the breakdown above names "
+              f"the regressing pass")
+        return 1
+    print(f"check_analysis_budget: OK ({total:.2f}s for "
+          f"{len(registry)} passes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,8 @@ from dlrm_flexflow_tpu.analysis import (BaselineError,  # noqa: E402
 from dlrm_flexflow_tpu.analysis.__main__ import main as cli_main  # noqa: E402
 from dlrm_flexflow_tpu.analysis.engine import get_value_taint  # noqa: E402
 from dlrm_flexflow_tpu.analysis.passes import (BarrierProtocolPass,  # noqa: E402
+                                               BlockingUnderLockPass,
+                                               BoundedGrowthPass,
                                                CollectiveDivergencePass,
                                                DonationSafetyPass,
                                                ImportLayeringPass,
@@ -37,6 +39,7 @@ from dlrm_flexflow_tpu.analysis.passes import (BarrierProtocolPass,  # noqa: E40
                                                MeshAxisPass,
                                                RecompileHazardPass,
                                                SharedStatePass,
+                                               ThreadLifecyclePass,
                                                TracePurityPass,
                                                TraceStalenessPass)
 from dlrm_flexflow_tpu.analysis.passes._spmd import (  # noqa: E402
@@ -49,10 +52,11 @@ from dlrm_flexflow_tpu.telemetry.report import (analysis_delta,  # noqa: E402
                                                 load_analysis,
                                                 report_data)
 
-ALL_PASSES = ["barrier-protocol", "collective-divergence",
+ALL_PASSES = ["barrier-protocol", "blocking-under-lock",
+              "bounded-growth", "collective-divergence",
               "donation-safety", "import-layering", "lock-discipline",
               "mesh-axis", "recompile-hazard", "shared-state",
-              "trace-purity", "trace-staleness"]
+              "thread-lifecycle", "trace-purity", "trace-staleness"]
 
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
 
@@ -115,7 +119,9 @@ class TestLockDiscipline:
         assert fs[0].line == 8 and fs[0].path == "pkg/a.py"
         assert "C._lock" in fs[0].message
 
-    def test_fires_future_and_blocking_under_module_lock(self, tmp_path):
+    def test_fires_future_under_module_lock(self, tmp_path):
+        # the sleep on the next line is blocking-under-lock's domain
+        # now (v4 split); lock-discipline must report ONLY the future
         fs = _run_pass(tmp_path, {"pkg/b.py": (
             "import threading, time\n"
             "_glock = threading.Lock()\n"
@@ -124,8 +130,8 @@ class TestLockDiscipline:
             "        fut.set_result(1)\n"
             "        time.sleep(0.1)\n"
         )}, LockDisciplinePass)
-        assert _codes(fs) == ["blocking-under-lock", "future-under-lock"]
-        assert {f.line for f in fs} == {5, 6}
+        assert _codes(fs) == ["future-under-lock"]
+        assert {f.line for f in fs} == {5}
 
     def test_fires_lock_order_inversion(self, tmp_path):
         fs = _run_pass(tmp_path, {"pkg/c.py": (
@@ -240,6 +246,352 @@ class TestLockDiscipline:
             "            s = ', '.join(['x'])\n"
             "    return s\n"
         )}, LockDisciplinePass)
+        assert fs == []
+
+
+# ------------------------------------------------------- blocking-under-lock
+class TestBlockingUnderLock:
+    def test_fires_sleep_and_io_with_exact_lines(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/a.py": (
+            "import threading, time\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._fh = open('/tmp/x', 'a')\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(0.1)\n"
+            "            self._fh.write('x')\n"
+        )}, BlockingUnderLockPass)
+        assert _codes(fs) == ["io-under-lock", "sleep-under-lock"]
+        assert {(f.line, f.code) for f in fs} == {
+            (8, "sleep-under-lock"), (9, "io-under-lock")}
+        assert all("C._lock" in f.message for f in fs)
+
+    def test_fires_interprocedural_device_sync(self, tmp_path):
+        # the block_until_ready lives two helpers below the with:
+        # flagged at the SITE, message naming the acquisition frame
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "import threading\n"
+            "_l = threading.Lock()\n"
+            "def inner(x):\n"
+            "    x.block_until_ready()\n"
+            "def helper(x):\n"
+            "    inner(x)\n"
+            "def f(x):\n"
+            "    with _l:\n"
+            "        helper(x)\n"
+        )}, BlockingUnderLockPass)
+        assert _codes(fs) == ["device-sync-under-lock"]
+        assert fs[0].line == 4 and fs[0].detail == "inner"
+        assert "(pkg/b.py:8)" in fs[0].message  # the acquisition site
+
+    def test_fires_queue_get_but_not_dict_get(self, tmp_path):
+        # .get() blocks only with queue-ctor evidence on the attr —
+        # the dict cache lookup next to it must stay silent
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "import threading, queue\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "        self._cache = {}\n"
+            "    def f(self, k):\n"
+            "        with self._lock:\n"
+            "            v = self._cache.get(k)\n"
+            "            return v or self._q.get()\n"
+        )}, BlockingUnderLockPass)
+        assert _codes(fs) == ["wait-under-lock"]
+        assert len(fs) == 1 and "self._q.get()" in fs[0].message
+
+    def test_silent_dispatch_under_lock_wait_outside(self, tmp_path):
+        # the serving contract: start work under the lock, do the one
+        # blocking wait after releasing it
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._out = None\n"
+            "    def f(self, x):\n"
+            "        with self._lock:\n"
+            "            self._out = x * 2\n"
+            "            y = self._out\n"
+            "        y.block_until_ready()\n"
+            "        return y\n"
+        )}, BlockingUnderLockPass)
+        assert fs == []
+
+    def test_silent_str_os_path_join_and_jnp_asarray(self, tmp_path):
+        # str.join / os.path.join never park a thread; jnp.asarray is
+        # traced, not a host sync — only plain-numpy aliases count
+        fs = _run_pass(tmp_path, {"pkg/e.py": (
+            "import os, threading\n"
+            "import jax.numpy as jnp\n"
+            "_l = threading.Lock()\n"
+            "def f(parts, x):\n"
+            "    with _l:\n"
+            "        s = ','.join(parts)\n"
+            "        p = os.path.join('/tmp', s)\n"
+            "        return jnp.asarray(x), p\n"
+        )}, BlockingUnderLockPass)
+        assert fs == []
+
+    def test_silent_callback_defined_under_lock(self, tmp_path):
+        # a def statement under a lock only binds a name — its sleep
+        # runs later, lock released
+        fs = _run_pass(tmp_path, {"pkg/g.py": (
+            "import threading, time\n"
+            "_l = threading.Lock()\n"
+            "def f():\n"
+            "    with _l:\n"
+            "        def cb():\n"
+            "            time.sleep(1.0)\n"
+            "    return cb\n"
+        )}, BlockingUnderLockPass)
+        assert fs == []
+
+
+# ---------------------------------------------------------- thread-lifecycle
+class TestThreadLifecycle:
+    def test_fires_thread_without_join_on_close_path(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/a.py": (
+            "import threading\n"
+            "class Worker:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        pass\n"
+            "    def stop(self):\n"
+            "        pass\n"
+        )}, ThreadLifecyclePass)
+        assert _codes(fs) == ["thread-no-join"]
+        assert fs[0].line == 4 and fs[0].detail == "Worker._t"
+
+    def test_fires_server_missing_server_close(self, tmp_path):
+        # shutdown() alone leaks the listening socket: BOTH calls are
+        # required on the close path
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "from http.server import ThreadingHTTPServer\n"
+            "class Exporter:\n"
+            "    def start(self):\n"
+            "        self._srv = ThreadingHTTPServer(('', 0), None)\n"
+            "    def stop(self):\n"
+            "        self._srv.shutdown()\n"
+        )}, ThreadLifecyclePass)
+        assert _codes(fs) == ["server-no-close"]
+        assert "server_close" in fs[0].message
+
+    def test_fires_local_non_daemon_thread_no_join(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "import threading\n"
+            "def kick(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+        )}, ThreadLifecyclePass)
+        assert _codes(fs) == ["non-daemon-thread"]
+        assert fs[0].line == 3 and fs[0].detail == "kick"
+
+    def test_fires_blocking_finalizer(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import time, weakref\n"
+            "def _cleanup(path):\n"
+            "    time.sleep(1.0)\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        weakref.finalize(self, _cleanup, '/tmp/x')\n"
+        )}, ThreadLifecyclePass)
+        assert _codes(fs) == ["blocking-finalizer"]
+        assert "_cleanup" in fs[0].message
+
+    def test_silent_daemon_scrape_thread_with_full_teardown(self,
+                                                            tmp_path):
+        # the MetricsServer shape: daemon scrape server + stop() doing
+        # shutdown + server_close + join — the sanctioned lifecycle
+        fs = _run_pass(tmp_path, {"pkg/e.py": (
+            "import threading\n"
+            "from http.server import ThreadingHTTPServer\n"
+            "class Metrics:\n"
+            "    def start(self):\n"
+            "        self._srv = ThreadingHTTPServer(('', 0), None)\n"
+            "        self._t = threading.Thread(\n"
+            "            target=self._srv.serve_forever, daemon=True)\n"
+            "        self._t.start()\n"
+            "    def stop(self):\n"
+            "        self._srv.shutdown()\n"
+            "        self._srv.server_close()\n"
+            "        self._t.join(timeout=2.0)\n"
+        )}, ThreadLifecyclePass)
+        assert fs == []
+
+    def test_silent_swap_alias_join_and_join_delegation(self, tmp_path):
+        # the watchdog idiom: close() swaps the handle into a local
+        # and joins the alias — and the join may live one call below
+        # the close-named method
+        fs = _run_pass(tmp_path, {"pkg/f.py": (
+            "import threading\n"
+            "class W:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._run,\n"
+            "                                   daemon=True)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        pass\n"
+            "    def stop(self):\n"
+            "        self._halt()\n"
+            "    def _halt(self):\n"
+            "        t, self._t = self._t, None\n"
+            "        if t is not None:\n"
+            "            t.join(timeout=1.0)\n"
+        )}, ThreadLifecyclePass)
+        assert fs == []
+
+    def test_silent_thread_list_joined_in_loop(self, tmp_path):
+        # the enqueuer shape: a comprehension of threads joined via
+        # `for t in self._threads:` on the close path
+        fs = _run_pass(tmp_path, {"pkg/g.py": (
+            "import threading\n"
+            "class Pool:\n"
+            "    def start(self, n):\n"
+            "        self._threads = [threading.Thread(target=self._run)\n"
+            "                         for _ in range(n)]\n"
+            "        for t in self._threads:\n"
+            "            t.start()\n"
+            "    def _run(self):\n"
+            "        pass\n"
+            "    def close(self):\n"
+            "        for t in self._threads:\n"
+            "            t.join()\n"
+        )}, ThreadLifecyclePass)
+        assert fs == []
+
+    def test_silent_non_blocking_finalizer(self, tmp_path):
+        fs = _run_pass(tmp_path, {"pkg/h.py": (
+            "import weakref\n"
+            "def _mark(reg, key):\n"
+            "    reg.discard(key)\n"
+            "class C:\n"
+            "    def __init__(self, reg):\n"
+            "        weakref.finalize(self, _mark, reg, id(self))\n"
+        )}, ThreadLifecyclePass)
+        assert fs == []
+
+
+# ------------------------------------------------------------ bounded-growth
+class TestBoundedGrowth:
+    def test_fires_append_on_monitor_thread_loop(self, tmp_path):
+        # the pre-v4 SLOMonitor.flight_paths shape: a thread-target
+        # loop appending to an uncapped list
+        fs = _run_pass(tmp_path, {"pkg/a.py": (
+            "import threading\n"
+            "class Mon:\n"
+            "    def __init__(self):\n"
+            "        self.paths = []\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        self.tick()\n"
+            "    def tick(self):\n"
+            "        self.paths.append('x')\n"
+            "    def stop(self):\n"
+            "        self._t.join()\n"
+        )}, BoundedGrowthPass)
+        assert _codes(fs) == ["unbounded-growth"]
+        assert fs[0].line == 11 and fs[0].detail == "Mon.paths"
+
+    def test_fires_list_augassign_from_serve_entry(self, tmp_path):
+        # += [x] is growth; the numeric counter next to it is not
+        fs = _run_pass(tmp_path, {"pkg/b.py": (
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.history = []\n"
+            "        self.n = 0\n"
+            "    def predict(self, x):\n"
+            "        self.record(x)\n"
+            "    def record(self, x):\n"
+            "        self.history += [x]\n"
+            "        self.n += 1\n"
+        )}, BoundedGrowthPass)
+        assert _codes(fs) == ["unbounded-growth"]
+        assert len(fs) == 1 and fs[0].detail == "Engine.history"
+
+    def test_silent_deque_maxlen_ring(self, tmp_path):
+        # the EventLog shape: AnnAssign deque(maxlen=) init sanctions
+        # every append to the ring
+        fs = _run_pass(tmp_path, {"pkg/c.py": (
+            "from collections import deque\n"
+            "from typing import Deque\n"
+            "class Log:\n"
+            "    def __init__(self, ring):\n"
+            "        self._ring: Deque = deque(maxlen=ring)\n"
+            "    def predict(self, ev):\n"
+            "        self._ring.append(ev)\n"
+        )}, BoundedGrowthPass)
+        assert fs == []
+
+    def test_silent_len_guard_reservoir(self, tmp_path):
+        # the LatencyStats shape: append below the cap, replace above
+        # it — the len(self.X) if-test sanctions the append under it
+        fs = _run_pass(tmp_path, {"pkg/d.py": (
+            "import random\n"
+            "class Stats:\n"
+            "    def __init__(self, cap):\n"
+            "        self._lat = []\n"
+            "        self.cap = cap\n"
+            "        self.count = 0\n"
+            "    def predict(self, v):\n"
+            "        self.count += 1\n"
+            "        if len(self._lat) < self.cap:\n"
+            "            self._lat.append(v)\n"
+            "        else:\n"
+            "            self._lat[random.randrange(self.cap)] = v\n"
+        )}, BoundedGrowthPass)
+        assert fs == []
+
+    def test_silent_keep_n_prune(self, tmp_path):
+        # the CheckpointManager shape: append then retention-sweep
+        # (del self.X[...] anywhere in the class is prune evidence)
+        fs = _run_pass(tmp_path, {"pkg/e.py": (
+            "class Ckpt:\n"
+            "    def __init__(self, keep_n):\n"
+            "        self._kept = []\n"
+            "        self.keep_n = keep_n\n"
+            "    def fit(self, path):\n"
+            "        self._kept.append(path)\n"
+            "        self._gc()\n"
+            "    def _gc(self):\n"
+            "        while len(self._kept) > self.keep_n:\n"
+            "            del self._kept[0]\n"
+        )}, BoundedGrowthPass)
+        assert fs == []
+
+    def test_silent_drain_swap_rotate(self, tmp_path):
+        # the ServeFuture._cbs shape: growth plus the tuple-target
+        # drain-swap `cbs, self._cbs = self._cbs, []` (rotate)
+        fs = _run_pass(tmp_path, {"pkg/f.py": (
+            "class Fut:\n"
+            "    def __init__(self):\n"
+            "        self._cbs = []\n"
+            "    def submit(self, cb):\n"
+            "        self._cbs.append(cb)\n"
+            "    def fire(self):\n"
+            "        cbs, self._cbs = self._cbs, []\n"
+            "        return cbs\n"
+        )}, BoundedGrowthPass)
+        assert fs == []
+
+    def test_silent_growth_off_the_loop_surface(self, tmp_path):
+        # growth in a method no serve/train/thread entry reaches is
+        # build-phase state, not a loop leak
+        fs = _run_pass(tmp_path, {"pkg/g.py": (
+            "class Model:\n"
+            "    def __init__(self):\n"
+            "        self.layers = []\n"
+            "    def add(self, op):\n"
+            "        self.layers.append(op)\n"
+        )}, BoundedGrowthPass)
         assert fs == []
 
 
@@ -1614,6 +1966,11 @@ class TestBaselineAndSarif:
             REPO, "ANALYSIS_WAIVERS.txt")).read()  # untouched
 
     def test_cli_changed_only_vs_head(self):
+        if (os.cpu_count() or 1) < 2:
+            pytest.skip(
+                "whole-repo 13-pass CLI run (~15s on a single host "
+                "core); the scope filter itself is pinned on fixture "
+                "trees above — keep tier-1 under its 870s window")
         # the real repo is a git checkout: whatever is currently
         # changed vs HEAD is clean-or-waived, so the gate passes and
         # the text names the scope
@@ -1621,6 +1978,11 @@ class TestBaselineAndSarif:
         assert rc == 0
 
     def test_cli_update_baseline_roundtrip(self, tmp_path, capsys):
+        if (os.cpu_count() or 1) < 2:
+            pytest.skip(
+                "whole-repo 13-pass CLI run (~20s on a single host "
+                "core); rewrite semantics are pinned on fixture trees "
+                "above — keep tier-1 under its 870s window")
         # regenerating against the committed tree is a no-op fixpoint:
         # same keys, same justifications (one full run — the content
         # comparison below proves the rewrite without a second one)
@@ -1773,6 +2135,42 @@ class TestCLI:
         assert rc == 2
         assert "unknown pass" in capsys.readouterr().err
 
+    def test_cli_list_passes_names_all_thirteen(self, capsys):
+        assert cli_main(["--list-passes"]) == 0
+        out = capsys.readouterr().out
+        for name in ALL_PASSES:
+            assert name in out
+        # name + description, one per line
+        assert "lock-held sets carried through calls" in out
+
+    def test_cli_explain_waived_key(self, capsys):
+        key = ("blocking-under-lock:dlrm_flexflow_tpu/telemetry/"
+               "events.py:EventLog.emit:io-under-lock")
+        assert cli_main(["--explain", key]) == 0
+        out = capsys.readouterr().out
+        assert "status: WAIVED" in out
+        assert "ANALYSIS_WAIVERS.txt" in out        # entry location
+        assert "chain into EventLog.emit" in out    # reverse callers
+        assert "[" in out                           # resolution kinds
+
+    def test_cli_explain_stale_and_malformed(self, tmp_path, capsys):
+        # a waiver whose detail function is gone: STALE + the nearest
+        # live keys so churn is a one-look diagnosis
+        _tree(tmp_path, TestWaivers.BAD)
+        w = tmp_path / "w.txt"
+        w.write_text("lock-discipline:pkg/a.py:C.gone:emit-under-lock"
+                     " | old entry\n")
+        rc = cli_main(["--explain",
+                       "lock-discipline:pkg/a.py:C.gone:emit-under-lock",
+                       "--root", str(tmp_path), "--waivers", str(w),
+                       "pkg"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "status: STALE" in out
+        assert "nearest (same pass+path+code)" in out
+        assert cli_main(["--explain", "garbage"]) == 2
+        assert "malformed waiver key" in capsys.readouterr().err
+
     def test_cli_fixture_violation_exits_nonzero(self, tmp_path):
         # THE subprocess test: `python -m dlrm_flexflow_tpu.analysis`
         # on a seeded violation exits nonzero naming path:line + pass
@@ -1793,7 +2191,20 @@ class TestCLI:
              os.path.join(REPO, "scripts", "check_analysis.py")],
             capture_output=True, text=True, env=ENV)
         assert r.returncode == 0, r.stdout + r.stderr
-        assert "OK (9 analysis paths)" in r.stdout
+        assert "OK (12 analysis paths)" in r.stdout
+
+    def test_check_analysis_budget_gate(self):
+        # the wall-clock gate: one full 13-pass repo run must stay
+        # interactive (<30s), with a per-pass breakdown naming any
+        # regressing pass
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_analysis_budget.py")],
+            capture_output=True, text=True, env=ENV)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "check_analysis_budget: OK" in r.stdout
+        for name in ALL_PASSES:   # the breakdown names every pass
+            assert name in r.stdout
 
 
 # ------------------------------------------------- telemetry report section
